@@ -1,0 +1,197 @@
+"""Runtime configuration & flag system.
+
+TPU-native analog of the reference's ``FFConfig`` (``include/flexflow/config.h:92-160``,
+parsed in ``src/runtime/model.cc:3566-3730``). Instead of querying Legion/Realm for
+nodes/GPUs, we query ``jax.devices()``; ``-ll:gpu`` becomes ``--tpus-per-node`` /
+the ambient device count. All reference flags are accepted (same spellings) so
+reference launch scripts port over directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # -------- training (reference: -e/-b/--lr/--wd/-p/-d) --------
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 1e-4
+    print_freq: int = 10
+    dataset_path: str = ""
+    # -------- machine --------
+    num_nodes: int = 1
+    workers_per_node: int = 0     # 0 = use all local devices
+    cpus_per_node: int = 1
+    # memory per device in MB (reference -ll:fsize); used by memory-aware search
+    device_mem_mb: int = 0        # 0 = query from device / default model
+    # -------- search (reference --budget/--alpha/...) --------
+    search_budget: int = -1
+    search_alpha: float = 1.2
+    only_data_parallel: bool = False
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_sample_parallel: bool = False
+    enable_propagation: bool = False
+    enable_inplace_optimizations: bool = False
+    search_overlap_backward_update: bool = False
+    search_num_nodes: int = -1
+    search_num_workers: int = -1
+    base_optimize_threshold: int = 10
+    enable_memory_search: bool = False
+    substitution_json_path: Optional[str] = None
+    # -------- simulator --------
+    simulator_workspace_mb: int = 2048
+    machine_model_version: int = 0
+    machine_model_file: str = ""
+    simulator_segment_size: int = 16777216
+    simulator_max_num_segments: int = 1
+    # -------- execution --------
+    perform_fusion: bool = False
+    allow_tensor_op_math_conversion: bool = True   # = allow bf16 matmul accum
+    computation_mode: str = "training"
+    profiling: bool = False
+    # -------- strategy import/export --------
+    export_strategy_file: str = ""
+    import_strategy_file: str = ""
+    export_strategy_task_graph_file: str = ""
+    export_strategy_computation_graph_file: str = ""
+    include_costs_dot_graph: bool = False
+    # -------- TPU-native --------
+    mesh_shape: Optional[Sequence[int]] = None     # explicit ICI mesh, else auto
+    use_bf16_compute: bool = True                  # matmuls in bf16, fp32 accum
+    seed: int = 0
+
+    def __post_init__(self):
+        self._devices = None
+
+    # ---- machine queries (lazy; avoids importing jax at flag-parse time) ----
+    @property
+    def devices(self):
+        if self._devices is None:
+            import jax
+            self._devices = jax.devices()
+        return self._devices
+
+    @property
+    def num_devices(self) -> int:
+        if self.workers_per_node:
+            return self.workers_per_node * self.num_nodes
+        return len(self.devices)
+
+    @property
+    def seq_length(self) -> int:  # reference FFIterationConfig::seq_length
+        return getattr(self, "_seq_length", -1)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def parse_args(cls, argv: Optional[List[str]] = None) -> "FFConfig":
+        """Parse reference-compatible command-line flags.
+
+        Mirrors ``FFConfig::parse_args`` (reference ``model.cc:3566-3730``).
+        Unknown flags are ignored (the reference forwards them to Legion).
+        """
+        cfg = cls()
+        args = list(sys.argv[1:] if argv is None else argv)
+        i = 0
+
+        def take() -> str:
+            nonlocal i
+            i += 1
+            return args[i]
+
+        while i < len(args):
+            a = args[i]
+            if a in ("-e", "--epochs"):
+                cfg.epochs = int(take())
+            elif a in ("-b", "--batch-size"):
+                cfg.batch_size = int(take())
+            elif a == "--lr" or a == "--learning-rate":
+                cfg.learning_rate = float(take())
+            elif a == "--wd" or a == "--weight-decay":
+                cfg.weight_decay = float(take())
+            elif a in ("-p", "--print-freq"):
+                cfg.print_freq = int(take())
+            elif a in ("-d", "--dataset"):
+                cfg.dataset_path = take()
+            elif a == "--budget" or a == "--search-budget":
+                cfg.search_budget = int(take())
+            elif a == "--alpha" or a == "--search-alpha":
+                cfg.search_alpha = float(take())
+            elif a == "--only-data-parallel":
+                cfg.only_data_parallel = True
+            elif a == "--enable-parameter-parallel":
+                cfg.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                cfg.enable_attribute_parallel = True
+            elif a == "--enable-sample-parallel":
+                cfg.enable_sample_parallel = True
+            elif a == "--enable-propagation":
+                cfg.enable_propagation = True
+            elif a == "--enable-inplace-optimizations":
+                cfg.enable_inplace_optimizations = True
+            elif a == "--overlap":
+                cfg.search_overlap_backward_update = True
+            elif a == "--search-num-nodes":
+                cfg.search_num_nodes = int(take())
+            elif a == "--search-num-workers":
+                cfg.search_num_workers = int(take())
+            elif a == "--base-optimize-threshold":
+                cfg.base_optimize_threshold = int(take())
+            elif a == "--memory-search":
+                cfg.enable_memory_search = True
+            elif a == "--substitution-json":
+                cfg.substitution_json_path = take()
+            elif a == "--simulator-workspace-size":
+                cfg.simulator_workspace_mb = int(take())
+            elif a == "--machine-model-version":
+                cfg.machine_model_version = int(take())
+            elif a == "--machine-model-file":
+                cfg.machine_model_file = take()
+            elif a == "--simulator-segment-size":
+                cfg.simulator_segment_size = int(take())
+            elif a == "--simulator-max-num-segments":
+                cfg.simulator_max_num_segments = int(take())
+            elif a == "--fusion":
+                cfg.perform_fusion = True
+            elif a == "--profiling":
+                cfg.profiling = True
+            elif a == "--allow-tensor-op-math-conversion":
+                cfg.allow_tensor_op_math_conversion = True
+            elif a == "--export" or a == "--export-strategy":
+                cfg.export_strategy_file = take()
+            elif a == "--import" or a == "--import-strategy":
+                cfg.import_strategy_file = take()
+            elif a == "--taskgraph":
+                cfg.export_strategy_task_graph_file = take()
+            elif a == "--compgraph":
+                cfg.export_strategy_computation_graph_file = take()
+            elif a == "--include-costs-dot-graph":
+                cfg.include_costs_dot_graph = True
+            elif a == "-ll:tpu" or a == "-ll:gpu":
+                cfg.workers_per_node = int(take())
+            elif a == "-ll:cpu":
+                cfg.cpus_per_node = int(take())
+            elif a == "-ll:fsize":
+                cfg.device_mem_mb = int(take())
+            elif a == "--nodes":
+                cfg.num_nodes = int(take())
+            elif a == "--mesh-shape":
+                cfg.mesh_shape = tuple(int(x) for x in take().split("x"))
+            elif a == "--seed":
+                cfg.seed = int(take())
+            # unknown flags: skip (reference forwards to Legion)
+            i += 1
+        return cfg
+
+
+@dataclasses.dataclass
+class FFIterationConfig:
+    """Per-iteration config (reference ``config.h:162-167``)."""
+    seq_length: int = -1
+
+    def reset(self):
+        self.seq_length = -1

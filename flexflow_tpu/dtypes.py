@@ -1,0 +1,45 @@
+"""DataType ↔ jnp dtype mapping.
+
+TPU-first policy: DT_HALF maps to bfloat16 (the MXU-native 16-bit type),
+not IEEE fp16; DT_DOUBLE falls back to float32 unless jax x64 is enabled.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ffconst import DataType
+
+_TO_JNP = {
+    DataType.DT_BOOLEAN: jnp.bool_,
+    DataType.DT_INT32: jnp.int32,
+    DataType.DT_INT64: jnp.int32,   # x64 disabled by default; widen if enabled
+    DataType.DT_HALF: jnp.bfloat16,
+    DataType.DT_BFLOAT16: jnp.bfloat16,
+    DataType.DT_FLOAT: jnp.float32,
+    DataType.DT_DOUBLE: jnp.float32,
+}
+
+_FROM_NP = {
+    np.dtype(np.bool_): DataType.DT_BOOLEAN,
+    np.dtype(np.int32): DataType.DT_INT32,
+    np.dtype(np.int64): DataType.DT_INT64,
+    np.dtype(np.float16): DataType.DT_HALF,
+    np.dtype(np.float32): DataType.DT_FLOAT,
+    np.dtype(np.float64): DataType.DT_DOUBLE,
+}
+
+
+def to_jnp(dt: DataType):
+    return _TO_JNP[DataType(dt)]
+
+
+def from_numpy_dtype(dtype) -> DataType:
+    dtype = np.dtype(dtype)
+    if dtype == jnp.bfloat16:
+        return DataType.DT_BFLOAT16
+    return _FROM_NP.get(dtype, DataType.DT_FLOAT)
+
+
+def itemsize(dt: DataType) -> int:
+    return np.dtype(to_jnp(dt)).itemsize

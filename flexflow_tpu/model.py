@@ -1,0 +1,635 @@
+"""FFModel: the model-building and training API.
+
+Reference parity: ``FFModel`` (``include/flexflow/model.h:326-958``,
+``src/runtime/model.cc``) — layer builder methods (dense/conv2d/embedding/
+multihead_attention/moe/...), ``compile`` (graph lowering + strategy
+search + executable build), ``fit``/``forward``/``backward``/``update``
+training drivers, and ``eval``.
+
+TPU-native differences:
+  - ``compile`` lowers the lazy Layer graph to a jitted SPMD step over a
+    device mesh instead of Legion index-space task launches;
+  - the parallelization strategy is a per-op PartitionSpec assignment found
+    by the search (search/), or canonical data-parallel with
+    ``--only-data-parallel``;
+  - backward is jax.grad; gradient sync is XLA collectives implied by
+    weight shardings (reference: per-view NCCL cliques, model.cc:3129).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .config import FFConfig, FFIterationConfig
+from .core.layer import Layer
+from .core.tensor import Tensor, WeightSpec
+from .dtypes import from_numpy_dtype, to_jnp
+from .executor import Executor, GraphProgram
+from .ffconst import (ActiMode, AggrMode, CompMode, DataType, InitializerType,
+                      LossType, MetricsType, OperatorType, ParameterSyncType,
+                      PoolType)
+from .ops import get_op_def
+from .parallel.machine import DeviceMesh, MachineSpec
+from .parallel.strategy import ShardingStrategy
+from .runtime.dataloader import SingleDataLoader
+from .runtime.metrics import PerfMetrics
+from .runtime.optimizers import AdamOptimizer, Optimizer, SGDOptimizer
+
+_LOSS_NAMES = {
+    "categorical_crossentropy": LossType.LOSS_CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy":
+        LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mse": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "identity": LossType.LOSS_IDENTITY,
+}
+
+_METRIC_NAMES = {
+    "accuracy": MetricsType.METRICS_ACCURACY,
+    "categorical_crossentropy": MetricsType.METRICS_CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy":
+        MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.METRICS_MEAN_SQUARED_ERROR,
+    "root_mean_squared_error": MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR,
+    "mean_absolute_error": MetricsType.METRICS_MEAN_ABSOLUTE_ERROR,
+}
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.layers: List[Layer] = []
+        self.input_tensors: List[Tensor] = []
+        self.graph_inputs: List[Tensor] = []
+        self.optimizer: Optional[Optimizer] = None
+        self.loss_type: Optional[LossType] = None
+        self.metrics: List[MetricsType] = []
+        self.label_tensor: Optional[Tensor] = None
+        self.executor: Optional[Executor] = None
+        self.dmesh: Optional[DeviceMesh] = None
+        self.strategy: Optional[ShardingStrategy] = None
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.iter_config = FFIterationConfig()
+        self._step = 0
+        self._output_tensor: Optional[Tensor] = None
+        self._dataloaders: List[Tuple[Tensor, np.ndarray]] = []
+        self._current_metrics: Optional[Dict[str, float]] = None
+
+    # ==================================================================
+    # graph construction helpers
+    # ==================================================================
+    def _add_layer(self, op_type: OperatorType, inputs: Sequence[Tensor],
+                   params: Dict[str, Any], name: Optional[str] = None
+                   ) -> Layer:
+        if name is not None:
+            # params/strategy dicts are name-keyed: uniquify collisions
+            used = {l.name for l in self.layers}
+            base, k = name, 1
+            while name in used:
+                name = f"{base}_{k}"
+                k += 1
+        layer = Layer(op_type, name, list(inputs), params)
+        op = get_op_def(op_type)
+        out_specs = op.infer(layer.params, [t.shape for t in inputs],
+                             [t.dtype for t in inputs])
+        for i, (shape, dt) in enumerate(out_specs):
+            layer.outputs.append(Tensor(shape, dt, layer, i,
+                                        name=f"{layer.name}:out{i}"))
+        self.layers.append(layer)
+        return layer
+
+    def _unary(self, op_type: OperatorType, x: Tensor, name=None, **params
+               ) -> Tensor:
+        return self._add_layer(op_type, [x], params, name).outputs[0]
+
+    def _binary(self, op_type: OperatorType, a: Tensor, b: Tensor, name=None
+                ) -> Tensor:
+        return self._add_layer(op_type, [a, b], {}, name).outputs[0]
+
+    # ==================================================================
+    # tensor creation (reference FFModel::create_tensor)
+    # ==================================================================
+    def create_tensor(self, dims: Sequence[int],
+                      dtype: DataType = DataType.DT_FLOAT,
+                      create_grad: bool = True, name: Optional[str] = None
+                      ) -> Tensor:
+        t = Tensor(dims, dtype, None, 0, name=name, create_grad=create_grad)
+        self.input_tensors.append(t)
+        return t
+
+    def create_constant(self, dims: Sequence[int], value: float,
+                        dtype: DataType = DataType.DT_FLOAT) -> Tensor:
+        t = self.create_tensor(dims, dtype, create_grad=False)
+        t.set_tensor(np.full(dims, value, dtype=np.dtype(to_jnp(dtype))))
+        return t
+
+    # ==================================================================
+    # layer builders (reference model.h:326-958)
+    # ==================================================================
+    def dense(self, input: Tensor, out_dim: int,
+              activation: ActiMode = ActiMode.AC_MODE_NONE,
+              use_bias: bool = True,
+              datatype: Optional[DataType] = None,
+              kernel_initializer=None, bias_initializer=None,
+              kernel_regularizer=None, name: Optional[str] = None) -> Tensor:
+        params = {"out_dim": out_dim, "activation": ActiMode(activation),
+                  "use_bias": use_bias}
+        if datatype is not None:
+            params["dtype"] = DataType(datatype)
+        if kernel_initializer is not None:
+            params["kernel_initializer"] = kernel_initializer
+        return self._add_layer(OperatorType.OP_LINEAR, [input], params,
+                               name).outputs[0]
+
+    def conv2d(self, input: Tensor, out_channels: int,
+               kernel_h: int, kernel_w: int, stride_h: int, stride_w: int,
+               padding_h: int, padding_w: int,
+               activation: ActiMode = ActiMode.AC_MODE_NONE,
+               groups: int = 1, use_bias: bool = True,
+               kernel_initializer=None, name: Optional[str] = None) -> Tensor:
+        params = {"out_channels": out_channels, "kernel_h": kernel_h,
+                  "kernel_w": kernel_w, "stride_h": stride_h,
+                  "stride_w": stride_w, "padding_h": padding_h,
+                  "padding_w": padding_w, "activation": ActiMode(activation),
+                  "groups": groups, "use_bias": use_bias}
+        if kernel_initializer is not None:
+            params["kernel_initializer"] = kernel_initializer
+        return self._add_layer(OperatorType.OP_CONV2D, [input], params,
+                               name).outputs[0]
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int,
+               stride_h: int, stride_w: int, padding_h: int, padding_w: int,
+               pool_type: PoolType = PoolType.POOL_MAX,
+               activation: ActiMode = ActiMode.AC_MODE_NONE,
+               name: Optional[str] = None) -> Tensor:
+        params = {"kernel_h": kernel_h, "kernel_w": kernel_w,
+                  "stride_h": stride_h, "stride_w": stride_w,
+                  "padding_h": padding_h, "padding_w": padding_w,
+                  "pool_type": PoolType(pool_type),
+                  "activation": ActiMode(activation)}
+        return self._add_layer(OperatorType.OP_POOL2D, [input], params,
+                               name).outputs[0]
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int,
+                  aggr: AggrMode = AggrMode.AGGR_MODE_NONE,
+                  dtype: DataType = DataType.DT_FLOAT,
+                  shared_op=None, kernel_initializer=None,
+                  name: Optional[str] = None) -> Tensor:
+        params = {"num_entries": num_entries, "out_dim": out_dim,
+                  "aggr": AggrMode(aggr), "dtype": DataType(dtype)}
+        if kernel_initializer is not None:
+            params["kernel_initializer"] = kernel_initializer
+        return self._add_layer(OperatorType.OP_EMBEDDING, [input], params,
+                               name).outputs[0]
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int,
+                            kdim: int = 0, vdim: int = 0, dropout: float = 0.0,
+                            bias: bool = True, add_bias_kv: bool = False,
+                            add_zero_attn: bool = False, causal: bool = False,
+                            kernel_initializer=None,
+                            name: Optional[str] = None) -> Tensor:
+        params = {"embed_dim": embed_dim, "num_heads": num_heads,
+                  "kdim": kdim, "vdim": vdim, "dropout": dropout,
+                  "bias": bias, "add_bias_kv": add_bias_kv,
+                  "add_zero_attn": add_zero_attn, "causal": causal}
+        return self._add_layer(OperatorType.OP_MULTIHEAD_ATTENTION,
+                               [query, key, value], params, name).outputs[0]
+
+    def batch_norm(self, input: Tensor, relu: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_BATCHNORM, input, name, relu=relu)
+
+    def layer_norm(self, input: Tensor, axes: Sequence[int],
+                   elementwise_affine: bool = True, eps: float = 1e-5,
+                   name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_LAYERNORM, input, name,
+                           axes=list(axes),
+                           elementwise_affine=elementwise_affine, eps=eps)
+
+    def rms_norm(self, input: Tensor, eps: float = 1e-6,
+                 name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_RMSNORM, input, name, eps=eps)
+
+    def batch_matmul(self, a: Tensor, b: Tensor,
+                     a_seq_length_dim: int = -1, b_seq_length_dim: int = -1,
+                     name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.OP_BATCHMATMUL, [a, b],
+                               {"a_seq_length_dim": a_seq_length_dim,
+                                "b_seq_length_dim": b_seq_length_dim},
+                               name).outputs[0]
+
+    def softmax(self, input: Tensor, axis: int = -1,
+                name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_SOFTMAX, input, name, axis=axis)
+
+    def dropout(self, input: Tensor, rate: float = 0.5, seed: int = 0,
+                name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_DROPOUT, input, name, rate=rate,
+                           seed=seed)
+
+    def flat(self, input: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_FLAT, input, name)
+
+    def concat(self, tensors: Sequence[Tensor], axis: int,
+               name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.OP_CONCAT, list(tensors),
+                               {"axis": axis}, name).outputs[0]
+
+    def split(self, input: Tensor, sizes: Union[int, Sequence[int]],
+              axis: int, name: Optional[str] = None) -> List[Tensor]:
+        if isinstance(sizes, int):
+            n = input.shape[axis % len(input.shape)] // sizes
+            sizes = [n] * sizes
+        return self._add_layer(OperatorType.OP_SPLIT, [input],
+                               {"sizes": list(sizes), "axis": axis},
+                               name).outputs
+
+    def reshape(self, input: Tensor, shape: Sequence[int],
+                name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_RESHAPE, input, name,
+                           shape=list(shape))
+
+    def transpose(self, input: Tensor, perm: Sequence[int],
+                  name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_TRANSPOSE, input, name,
+                           perm=list(perm))
+
+    def reverse(self, input: Tensor, axis: int,
+                name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_REVERSE, input, name, axis=axis)
+
+    # ---- elementwise binary ----
+    def add(self, x, y, name=None):
+        return self._binary(OperatorType.OP_EW_ADD, x, y, name)
+
+    def subtract(self, x, y, name=None):
+        return self._binary(OperatorType.OP_EW_SUB, x, y, name)
+
+    def multiply(self, x, y, name=None):
+        return self._binary(OperatorType.OP_EW_MUL, x, y, name)
+
+    def divide(self, x, y, name=None):
+        return self._binary(OperatorType.OP_EW_DIV, x, y, name)
+
+    def max(self, x, y, name=None):
+        return self._binary(OperatorType.OP_EW_MAX, x, y, name)
+
+    def min(self, x, y, name=None):
+        return self._binary(OperatorType.OP_EW_MIN, x, y, name)
+
+    # ---- elementwise unary ----
+    def relu(self, x, name=None):
+        return self._unary(OperatorType.OP_RELU, x, name)
+
+    def sigmoid(self, x, name=None):
+        return self._unary(OperatorType.OP_SIGMOID, x, name)
+
+    def tanh(self, x, name=None):
+        return self._unary(OperatorType.OP_TANH, x, name)
+
+    def elu(self, x, name=None):
+        return self._unary(OperatorType.OP_ELU, x, name)
+
+    def gelu(self, x, name=None):
+        return self._unary(OperatorType.OP_GELU, x, name)
+
+    def identity(self, x, name=None):
+        return self._unary(OperatorType.OP_IDENTITY, x, name)
+
+    def exp(self, x, name=None):
+        return self._unary(OperatorType.OP_EXP, x, name)
+
+    def log(self, x, name=None):
+        return self._unary(OperatorType.OP_LOG, x, name)
+
+    def sqrt(self, x, name=None):
+        return self._unary(OperatorType.OP_SQRT, x, name)
+
+    def rsqrt(self, x, name=None):
+        return self._unary(OperatorType.OP_RSQRT, x, name)
+
+    def sin(self, x, name=None):
+        return self._unary(OperatorType.OP_SIN, x, name)
+
+    def cos(self, x, name=None):
+        return self._unary(OperatorType.OP_COS, x, name)
+
+    def pow(self, x, exponent: float, name=None):
+        return self._unary(OperatorType.OP_POW, x, name, scalar=exponent)
+
+    def scalar_multiply(self, x, scalar: float, inplace=False, name=None):
+        return self._unary(OperatorType.OP_SCALAR_MULTIPLY, x, name,
+                           scalar=scalar)
+
+    def scalar_add(self, x, scalar: float, inplace=False, name=None):
+        return self._unary(OperatorType.OP_SCALAR_ADD, x, name, scalar=scalar)
+
+    def scalar_sub(self, x, scalar: float, inplace=False, name=None):
+        return self._unary(OperatorType.OP_SCALAR_SUB, x, name, scalar=scalar)
+
+    def scalar_true_divide(self, x, scalar: float, inplace=False, name=None):
+        return self._unary(OperatorType.OP_SCALAR_TRUE_DIV, x, name,
+                           scalar=scalar)
+
+    def cast(self, x, dtype: DataType, name=None):
+        return self._unary(OperatorType.OP_CAST, x, name,
+                           dtype=DataType(dtype))
+
+    def mean(self, x, dims: Sequence[int], keepdims: bool = False, name=None):
+        return self._unary(OperatorType.OP_MEAN, x, name, axes=list(dims),
+                           keepdims=keepdims)
+
+    def reduce_sum(self, x, axes: Sequence[int], keepdims: bool = False,
+                   name=None):
+        return self._unary(OperatorType.OP_REDUCE_SUM, x, name,
+                           axes=list(axes), keepdims=keepdims)
+
+    def gather(self, x: Tensor, index: Tensor, dim: int = 0, name=None):
+        return self._add_layer(OperatorType.OP_GATHER, [x, index],
+                               {"dim": dim}, name).outputs[0]
+
+    def top_k(self, input: Tensor, k: int, sorted: bool = False,
+              name: Optional[str] = None) -> List[Tensor]:
+        return self._add_layer(OperatorType.OP_TOPK, [input],
+                               {"k": k, "sorted": sorted}, name).outputs
+
+    # ---- MoE family (reference src/ops/moe.cc:20-44) ----
+    def group_by(self, input: Tensor, assign: Tensor, n: int,
+                 alpha: float = 1.0, name: Optional[str] = None
+                 ) -> List[Tensor]:
+        return self._add_layer(OperatorType.OP_GROUP_BY, [input, assign],
+                               {"n": n, "alpha": alpha}, name).outputs
+
+    def aggregate(self, inputs: Sequence[Tensor], n: int,
+                  lambda_bal: float = 0.0, name: Optional[str] = None
+                  ) -> Tensor:
+        return self._add_layer(OperatorType.OP_AGGREGATE, list(inputs),
+                               {"n": n, "lambda_bal": lambda_bal},
+                               name).outputs[0]
+
+    def aggregate_spec(self, inputs: Sequence[Tensor], n: int,
+                       lambda_bal: float = 0.0, name: Optional[str] = None
+                       ) -> Tensor:
+        return self._add_layer(OperatorType.OP_AGG_SPEC, list(inputs),
+                               {"n": n, "lambda_bal": lambda_bal},
+                               name).outputs[0]
+
+    def cache(self, input: Tensor, num_batches: int, score_fn=None,
+              name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.OP_CACHE, input, name,
+                           num_batches=num_batches)
+
+    def moe(self, input: Tensor, num_exp: int, num_select: int,
+            expert_hidden_size: int, alpha: float = 1.0,
+            lambda_bal: float = 0.0) -> Tensor:
+        """MoE composite — same wiring as reference ``FFModel::moe``
+        (``src/ops/moe.cc:20-44``)."""
+        gate_preds = self.dense(input, num_exp, ActiMode.AC_MODE_RELU)
+        topk_out = self.top_k(gate_preds, num_select, False)
+        exp_tensors = self.group_by(input, topk_out[1], num_exp, alpha)
+        agg_inputs = [self.softmax(topk_out[0]), topk_out[1], topk_out[1],
+                      gate_preds]
+        for i in range(num_exp):
+            exp_pred = self.dense(exp_tensors[i], expert_hidden_size,
+                                  ActiMode.AC_MODE_RELU)
+            agg_inputs.append(self.softmax(exp_pred))
+        return self.aggregate(agg_inputs, num_exp, lambda_bal)
+
+    # ==================================================================
+    # optimizer / compile / fit (reference model.cc:2803, cffi fit)
+    # ==================================================================
+    def set_optimizer(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+
+    optimizer_prop = property(lambda s: s.optimizer, set_optimizer)
+
+    def compile(self, optimizer: Optional[Optimizer] = None,
+                loss_type: Union[LossType, str, None] = None,
+                metrics: Optional[Sequence[Union[MetricsType, str]]] = None,
+                comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
+                machine_spec: Optional[MachineSpec] = None,
+                strategy: Optional[ShardingStrategy] = None,
+                output_tensor: Optional[Tensor] = None):
+        """Lower graph → (strategy, jitted step). Reference call stack:
+        ``FFModel::compile`` → graph_optimize → convert_graph_to_operators
+        → NCCL setup (``model.cc:2803-3168``)."""
+        if optimizer is not None:
+            self.optimizer = optimizer
+        if self.optimizer is None:
+            self.optimizer = SGDOptimizer(lr=self.config.learning_rate)
+        if isinstance(loss_type, str):
+            loss_type = _LOSS_NAMES[loss_type.lower()]
+        self.loss_type = LossType(loss_type) if loss_type is not None \
+            else LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
+        self.metrics = [
+            _METRIC_NAMES[m.lower()] if isinstance(m, str) else MetricsType(m)
+            for m in (metrics or [])]
+
+        # output tensor = last layer's first output unless specified
+        self._output_tensor = output_tensor or self.layers[-1].outputs[0]
+
+        # Partition created tensors into graph inputs (consumed by a layer)
+        # and the label tensor (created but unconsumed) — reference compile
+        # creates the label tensor itself (model.cc:3086).
+        consumed = {t.guid for l in self.layers for t in l.inputs}
+        self.graph_inputs = [t for t in self.input_tensors
+                             if t.guid in consumed]
+        unconsumed = [t for t in self.input_tensors if t.guid not in consumed]
+        if self.label_tensor is None and len(unconsumed) == 1:
+            self.label_tensor = unconsumed[0]
+
+        spec = machine_spec or MachineSpec.detect()
+        self.dmesh = DeviceMesh(spec, mesh_shape=self.config.mesh_shape)
+
+        if strategy is not None:
+            self.strategy = strategy
+        else:
+            self.strategy = self._optimize_strategy()
+
+        # label tensor adopts the final op's batch sharding
+        # (reference model.cc:3086-3124)
+        program = GraphProgram(self.layers, self.graph_inputs,
+                               [self._output_tensor])
+        self.executor = Executor(program, self.config, self.dmesh,
+                                 self.strategy, self.optimizer,
+                                 self.loss_type, self.metrics,
+                                 seed=self.config.seed)
+        self.params, self.state = self.executor.init_params_and_state()
+        self.opt_state = self.optimizer.init_state(self.params)
+        self._step = 0
+
+    def _optimize_strategy(self) -> ShardingStrategy:
+        """Strategy selection: search unless --only-data-parallel.
+        (Search lives in flexflow_tpu.search; canonical DP here.)"""
+        if self.config.only_data_parallel or self.dmesh.num_devices == 1:
+            return ShardingStrategy.data_parallel(
+                self.layers, self.graph_inputs, self.dmesh)
+        import importlib.util
+        if importlib.util.find_spec("flexflow_tpu.search") is None:
+            return ShardingStrategy.data_parallel(
+                self.layers, self.graph_inputs, self.dmesh)
+        from .search.optimizer import optimize_strategy
+        return optimize_strategy(self)
+
+    # ------------------------------------------------------------------
+    def create_data_loader(self, tensor: Tensor, data: np.ndarray):
+        """Reference ``FFModel.create_data_loader`` parity: registers the
+        full array for one tensor; fit() shards batches from it."""
+        data = np.ascontiguousarray(data)
+        self._dataloaders.append((tensor, data))
+        return (tensor, data)
+
+    def _combined_loader(self, x=None, y=None,
+                         batch_size: Optional[int] = None,
+                         shuffle: bool = True) -> SingleDataLoader:
+        bs = batch_size or self.config.batch_size
+        arrays: Dict[str, np.ndarray] = {}
+        graph_inputs = getattr(self, "graph_inputs", self.input_tensors)
+        if x is not None or y is not None:
+            xs = x if isinstance(x, (list, tuple)) else [x]
+            assert len(xs) == len(graph_inputs), \
+                f"{len(xs)} arrays for {len(graph_inputs)} inputs"
+            for t, arr in zip(graph_inputs, xs):
+                arrays[t.name] = np.ascontiguousarray(arr)
+            arrays["label"] = np.ascontiguousarray(y)
+        else:
+            gi_guids = {t.guid for t in graph_inputs}
+            for t, arr in self._dataloaders:
+                is_label = (t is self.label_tensor
+                            or t.guid not in gi_guids)
+                arrays["label" if is_label else t.name] = arr
+        shardings = {}
+        for t in graph_inputs:
+            if t.name in arrays:
+                shardings[t.name] = self.strategy.input_sharding(t.name)
+        out_sh = self.strategy.output_sharding(
+            self._output_tensor.owner_layer.name)
+        if out_sh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ospec = self.strategy.ops[self._output_tensor.owner_layer.name]\
+                .outputs[self._output_tensor.owner_idx]
+            batch_axes = ospec[0] if ospec and len(ospec) > 0 else None
+            shardings["label"] = NamedSharding(self.dmesh.mesh, P(batch_axes))
+        return SingleDataLoader(arrays, bs, shardings, shuffle=shuffle,
+                                seed=self.config.seed)
+
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: Optional[int] = None, callbacks=None, verbose=True):
+        """Training loop (reference ``flexflow_cffi.py:2062-2104``; Legion
+        trace ≙ jit cache)."""
+        assert self.executor is not None, "call compile() first"
+        epochs = epochs or self.config.epochs
+        loader = self._combined_loader(x, y, batch_size)
+        step_fn = self.executor.make_train_step()
+        history = []
+        for epoch in range(epochs):
+            pm = PerfMetrics()
+            t0 = time.perf_counter()
+            nb = 0
+            for batch in loader:
+                bm = self._run_train_step(step_fn, batch)
+                bsz = next(iter(batch.values())).shape[0]
+                pm.update({k: np.asarray(v) for k, v in bm.items()}, bsz)
+                nb += 1
+                if verbose and nb % self.config.print_freq == 0:
+                    rep = pm.report()
+                    msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
+                    print(f"epoch {epoch} iter {nb}/{loader.num_batches} {msg}")
+            dt = time.perf_counter() - t0
+            rep = pm.report()
+            rep["epoch_time_s"] = dt
+            rep["samples_per_sec"] = pm.train_all / dt if dt > 0 else 0.0
+            history.append(rep)
+            if verbose:
+                msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
+                print(f"epoch {epoch} done: {msg}")
+            if callbacks:
+                for cb in callbacks:
+                    cb.on_epoch_end(epoch, rep, self)
+        self._current_metrics = history[-1] if history else {}
+        return history
+
+    def _run_train_step(self, step_fn, batch):
+        self.params, self.opt_state, self.state, bm = step_fn(
+            self.params, self.opt_state, self.state,
+            jnp.int32(self._step), batch)
+        self._step += 1
+        return bm
+
+    # phase-level API parity (forward/backward/update as in model.cc)
+    def forward(self, batch=None, seq_length: int = -1):
+        fwd = self.executor.make_forward()
+        if batch is None:
+            batch = self._peek_batch()
+        self._last_fwd = fwd(self.params, self.state, batch)
+        return self._last_fwd
+
+    def zero_gradients(self):
+        pass  # grads are recomputed functionally each step
+
+    def backward(self, seq_length: int = -1):
+        pass  # fused into train step (jax.grad)
+
+    def update(self):
+        pass  # fused into train step
+
+    def _peek_batch(self):
+        loader = self._combined_loader()
+        loader.reset()
+        return loader.next_batch()
+
+    def eval(self, x=None, y=None, batch_size: Optional[int] = None,
+             verbose: bool = False) -> Dict[str, float]:
+        loader = self._combined_loader(x, y, batch_size, shuffle=False)
+        step_fn = self.executor.make_eval_step()
+        pm = PerfMetrics()
+        for batch in loader:
+            _, bm = step_fn(self.params, self.state, batch)
+            bsz = next(iter(batch.values())).shape[0]
+            pm.update({k: np.asarray(v) for k, v in bm.items()}, bsz)
+        rep = pm.report()
+        self._current_metrics = rep
+        if verbose:
+            print("eval:", rep)
+        return rep
+
+    # ------------------------------------------------------------------
+    def get_layer_by_name(self, name: str) -> Optional[Layer]:
+        for l in self.layers:
+            if l.name == name:
+                return l
+        return None
+
+    def get_layers(self) -> Dict[int, Layer]:
+        return dict(enumerate(self.layers))
+
+    def get_perf_metrics(self):
+        return self._current_metrics
+
+    # weights access (reference Parameter.get/set_weights NumPy round-trip)
+    def get_weights(self, layer_name: str, weight_name: str = "kernel"
+                    ) -> np.ndarray:
+        return np.asarray(self.params[layer_name][weight_name])
+
+    def set_weights(self, layer_name: str, weight_name: str,
+                    value: np.ndarray):
+        cur = self.params[layer_name][weight_name]
+        assert cur.shape == value.shape, (cur.shape, value.shape)
+        self.params[layer_name][weight_name] = jax.device_put(
+            jnp.asarray(value, cur.dtype), cur.sharding)
+
+    @property
+    def label_tensor_for_loaders(self) -> Tensor:
+        if self.label_tensor is None:
+            out = self._output_tensor or self.layers[-1].outputs[0]
+            self.label_tensor = Tensor(out.shape, DataType.DT_INT32,
+                                       name="label")
+        return self.label_tensor

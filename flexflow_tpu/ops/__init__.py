@@ -1,0 +1,7 @@
+"""Operator registry: import all op modules to populate OPS."""
+from .registry import OPS, EmitCtx, OpDef, get_op_def, matmul  # noqa: F401
+from . import nn_ops        # noqa: F401
+from . import element_ops   # noqa: F401
+from . import tensor_ops    # noqa: F401
+from . import moe_ops       # noqa: F401
+from . import parallel_ops  # noqa: F401

@@ -1,0 +1,194 @@
+"""Elementwise unary/binary/scalar and reduction operators.
+
+Reference parity: ``src/ops/element_unary.cc``, ``element_binary.cc``,
+``reduce.cc``, ``mean.cc``, ``cast.cc`` — all pure jnp; XLA fuses these into
+neighboring ops (the reference needed cuDNN OpTensor + custom kernels).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ffconst import DataType, OperatorType
+from ..dtypes import to_jnp
+from .registry import EmitCtx, OpDef, register
+
+_UNARY_FNS = {
+    OperatorType.OP_RELU: jax.nn.relu,
+    OperatorType.OP_SIGMOID: jax.nn.sigmoid,
+    OperatorType.OP_TANH: jnp.tanh,
+    OperatorType.OP_ELU: jax.nn.elu,
+    OperatorType.OP_GELU: jax.nn.gelu,
+    OperatorType.OP_IDENTITY: lambda x: x,
+    OperatorType.OP_EXP: jnp.exp,
+    OperatorType.OP_LOG: jnp.log,
+    OperatorType.OP_SQRT: jnp.sqrt,
+    OperatorType.OP_RSQRT: jax.lax.rsqrt,
+    OperatorType.OP_SIN: jnp.sin,
+    OperatorType.OP_COS: jnp.cos,
+    OperatorType.OP_CEIL: jnp.ceil,
+    OperatorType.OP_ROUND: jnp.round,
+    OperatorType.OP_LOGICAL_NOT: jnp.logical_not,
+}
+
+_SCALAR_FNS = {
+    OperatorType.OP_SCALAR_MULTIPLY: lambda x, s: x * s,
+    OperatorType.OP_SCALAR_ADD: lambda x, s: x + s,
+    OperatorType.OP_SCALAR_SUB: lambda x, s: x - s,
+    OperatorType.OP_SCALAR_TRUE_DIV: lambda x, s: x / s,
+    OperatorType.OP_SCALAR_FLOOR_DIV: lambda x, s: jnp.floor_divide(x, s),
+    OperatorType.OP_POW: lambda x, s: jnp.power(x, s),
+}
+
+_BINARY_FNS = {
+    OperatorType.OP_EW_ADD: jnp.add,
+    OperatorType.OP_EW_SUB: jnp.subtract,
+    OperatorType.OP_EW_MUL: jnp.multiply,
+    OperatorType.OP_EW_DIV: jnp.divide,
+    OperatorType.OP_EW_MAX: jnp.maximum,
+    OperatorType.OP_EW_MIN: jnp.minimum,
+    OperatorType.OP_EW_EQUAL: jnp.equal,
+    OperatorType.OP_EW_GREATER: jnp.greater,
+    OperatorType.OP_EW_LESS: jnp.less,
+}
+
+_CMP_OPS = {OperatorType.OP_EW_EQUAL, OperatorType.OP_EW_GREATER,
+            OperatorType.OP_EW_LESS}
+
+
+class _UnaryBase(OpDef):
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        fn = _UNARY_FNS.get(self.op_type)
+        if fn is not None:
+            return [fn(inputs[0])]
+        if self.op_type in _SCALAR_FNS:
+            return [_SCALAR_FNS[self.op_type](inputs[0],
+                                              params.get("scalar", 1.0))]
+        raise NotImplementedError(self.op_type)
+
+
+def _make_unary(op_t):
+    cls = type(f"Unary_{op_t.name}", (_UnaryBase,), {"op_type": op_t})
+    register(cls)
+
+
+for _t in list(_UNARY_FNS) + list(_SCALAR_FNS):
+    _make_unary(_t)
+
+
+@register
+class LeakyReluOp(_UnaryBase):
+    op_type = OperatorType.OP_LEAKYRELU
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jax.nn.leaky_relu(inputs[0],
+                                  params.get("negative_slope", 0.01))]
+
+
+@register
+class PReluOp(OpDef):
+    op_type = OperatorType.OP_PRELU
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        from ..core.tensor import WeightSpec
+        from ..ffconst import InitializerType
+        return [WeightSpec("alpha", (in_shapes[0][-1],), in_dtypes[0],
+                           InitializerType.CONSTANT, {"value": 0.25})]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        x = inputs[0]
+        return [jnp.where(x >= 0, x, weights["alpha"] * x)]
+
+
+class _BinaryBase(OpDef):
+    def infer(self, params, in_shapes, in_dtypes):
+        out = tuple(np.broadcast_shapes(in_shapes[0], in_shapes[1]))
+        dt = DataType.DT_BOOLEAN if self.op_type in _CMP_OPS else in_dtypes[0]
+        return [(out, dt)]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [_BINARY_FNS[self.op_type](inputs[0], inputs[1])]
+
+
+for _t in _BINARY_FNS:
+    register(type(f"Binary_{_t.name}", (_BinaryBase,), {"op_type": _t}))
+
+# OP_MUL is TASO's alias for elementwise multiply
+register(type("Binary_OP_MUL", (_BinaryBase,),
+              {"op_type": OperatorType.OP_MUL,
+               "emit": lambda self, params, inputs, weights, ctx, name:
+                   [jnp.multiply(inputs[0], inputs[1])]}))
+
+
+@register
+class CastOp(OpDef):
+    op_type = OperatorType.OP_CAST
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], DataType(params["dtype"]))]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [inputs[0].astype(to_jnp(params["dtype"]))]
+
+
+@register
+class WhereOp(OpDef):
+    op_type = OperatorType.OP_WHERE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        out = tuple(np.broadcast_shapes(*in_shapes))
+        return [(out, in_dtypes[1])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jnp.where(*inputs)]
+
+
+# ---------------------------------------------------------------------------
+class _ReduceBase(OpDef):
+    fn = None
+    arg = False
+
+    def infer(self, params, in_shapes, in_dtypes):
+        ish = in_shapes[0]
+        ndim = len(ish)
+        axes = sorted(a % ndim for a in params.get("axes", range(ndim)))
+        keep = params.get("keepdims", False)
+        if keep:
+            out = tuple(1 if i in axes else s for i, s in enumerate(ish))
+        else:
+            out = tuple(s for i, s in enumerate(ish) if i not in axes)
+        dt = DataType.DT_INT32 if self.arg else in_dtypes[0]
+        return [(out, dt)]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        x = inputs[0]
+        axes = tuple(a % x.ndim for a in params.get("axes", range(x.ndim)))
+        keep = params.get("keepdims", False)
+        if self.arg:
+            assert len(axes) == 1
+            return [type(self).fn(x, axis=axes[0], keepdims=keep)
+                    .astype(jnp.int32)]
+        return [type(self).fn(x, axis=axes, keepdims=keep)]
+
+
+for _t, _fn, _arg in [
+    (OperatorType.OP_REDUCE_SUM, jnp.sum, False),
+    (OperatorType.OP_REDUCE_MEAN, jnp.mean, False),
+    (OperatorType.OP_MEAN, jnp.mean, False),
+    (OperatorType.OP_REDUCE_MAX, jnp.max, False),
+    (OperatorType.OP_REDUCE_MIN, jnp.min, False),
+    (OperatorType.OP_REDUCE_PROD, jnp.prod, False),
+    (OperatorType.OP_REDUCE_ARGMAX, jnp.argmax, True),
+    (OperatorType.OP_REDUCE_ARGMIN, jnp.argmin, True),
+]:
+    register(type(f"Reduce_{_t.name}", (_ReduceBase,),
+                  {"op_type": _t, "fn": staticmethod(_fn), "arg": _arg}))

@@ -1,0 +1,151 @@
+"""Mixture-of-Experts operator family: TopK routing, Group_by dispatch,
+Aggregate combine, Cache.
+
+Reference parity: ``src/ops/{group_by,aggregate,aggregate_spec,cache}.cc``
+(custom expert-routing CUDA kernels, alpha capacity factor, lambda_bal
+load balancing). TPU-native design: GShard-style dense dispatch/combine
+einsums over a static capacity — one-hot matmuls ride the MXU, shapes stay
+static for XLA, and the expert dimension shards cleanly over a mesh axis
+(expert parallelism).
+
+Shapes (numpy order):
+  group_by:  input (B, D), assign (B, K) int  ->  n tensors (C, D),
+             C = ceil(alpha * K * B / n)
+  aggregate: [gate_preds (B,K), gate_assign (B,K), true_assign (B,K),
+              full_gate_preds (B,n), exp_pred_0 (C,Do), ... exp_pred_{n-1}]
+             -> (B, Do)
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ffconst import DataType, OperatorType
+from .registry import EmitCtx, OpDef, register
+
+
+def _capacity(params, batch: int, k: int) -> int:
+    n = params["n"]
+    alpha = params.get("alpha", 1.0)
+    return int(math.ceil(alpha * k * batch / n))
+
+
+def _dispatch_mask(assign, n: int, capacity: int):
+    """(B, K) int assignments -> (T=B*K, n, C) one-hot dispatch tensor.
+
+    Position of each (token, choice) within its expert's buffer is its
+    running count in flattened token order; overflow tokens are dropped —
+    matching the reference kernels' first-come capacity policy
+    (``group_by.cu`` expert_rows bound).
+    """
+    b, k = assign.shape
+    flat = assign.reshape(-1).astype(jnp.int32)          # (T,)
+    onehot = jax.nn.one_hot(flat, n, dtype=jnp.int32)    # (T, n)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1        # (T, n): slot per tok
+    in_cap = (pos < capacity) & (pos >= 0)
+    poscap = jnp.where(in_cap, pos, 0)
+    poshot = jax.nn.one_hot(poscap.sum(-1), capacity, dtype=jnp.float32)
+    mask = (onehot.astype(jnp.float32) * in_cap.astype(jnp.float32))
+    return mask[:, :, None] * poshot[:, None, :]          # (T, n, C)
+
+
+@register
+class GroupByOp(OpDef):
+    op_type = OperatorType.OP_GROUP_BY
+
+    def infer(self, params, in_shapes, in_dtypes):
+        (b, d), (b2, k) = in_shapes[0], in_shapes[1]
+        assert b == b2, (in_shapes,)
+        c = _capacity(params, b, k)
+        return [((c, d), in_dtypes[0])] * params["n"]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        x, assign = inputs
+        b, k = assign.shape
+        n = params["n"]
+        c = _capacity(params, b, k)
+        disp = _dispatch_mask(assign, n, c)               # (T, n, C)
+        xr = jnp.repeat(x, k, axis=0)                     # (T, D) token per slot
+        buf = jnp.einsum("tec,td->ecd", disp.astype(jnp.bfloat16),
+                         xr.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        buf = buf.astype(x.dtype)
+        return [buf[e] for e in range(n)]
+
+
+@register
+class AggregateOp(OpDef):
+    """Combine expert outputs weighted by gate probabilities; adds the
+    lambda_bal load-balancing auxiliary loss (the reference injects an
+    equivalent term directly into gate gradients in ``aggregate.cu``)."""
+    op_type = OperatorType.OP_AGGREGATE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        b = in_shapes[0][0]
+        out_dim = in_shapes[4][-1]
+        return [((b, out_dim), in_dtypes[4])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        gate_preds, gate_assign = inputs[0], inputs[1]
+        full_gate_preds = inputs[3]
+        exp_preds = inputs[4:]
+        n = params["n"]
+        b, k = gate_assign.shape
+        c = exp_preds[0].shape[0]
+        disp = _dispatch_mask(gate_assign, n, c)          # (T, n, C)
+        w = gate_preds.reshape(-1)                        # (T,)
+        combine = disp * w[:, None, None]
+        stacked = jnp.stack(exp_preds, axis=0)            # (n, C, Do)
+        out = jnp.einsum("tec,ecd->td", combine.astype(jnp.bfloat16),
+                         stacked.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(b, k, -1).sum(axis=1).astype(exp_preds[0].dtype)
+        # GShard-style load-balance aux loss: n * sum_e(frac_tokens_e * mean_gate_e)
+        lam = params.get("lambda_bal", 0.0)
+        if lam > 0.0 and full_gate_preds is not None:
+            frac = jnp.mean(
+                jax.nn.one_hot(gate_assign[:, 0], n, dtype=jnp.float32), axis=0)
+            mean_gate = jnp.mean(jax.nn.softmax(full_gate_preds, -1), axis=0)
+            ctx.aux_losses.append(lam * n * jnp.sum(frac * mean_gate))
+        return [out]
+
+
+@register
+class AggregateSpecOp(AggregateOp):
+    """Aggregate variant that ignores gate weighting for the expert pass-
+    through (reference ``aggregate_spec.cc`` — used with Cache for MoE
+    speculation). Same output shape as Aggregate."""
+    op_type = OperatorType.OP_AGG_SPEC
+
+    def emit(self, params, inputs, weights, ctx, name):
+        inputs = list(inputs)
+        inputs[0] = jnp.ones_like(inputs[0]) / inputs[0].shape[-1]
+        return super().emit(params, inputs, weights, ctx, name)
+
+
+@register
+class CacheOp(OpDef):
+    """Rolling tensor cache (reference ``src/ops/cache.cc``): stores the
+    input in the state collection; with a score trigger the runtime's
+    recompile hook can switch to serving the cached value."""
+    op_type = OperatorType.OP_CACHE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def state_spec(self, params, in_shapes, in_dtypes):
+        return {"cached": (in_shapes[0], in_dtypes[0])}
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        st = ctx.state.get(name)
+        use_cached = params.get("use_cached", False)
+        if st is not None:
+            ctx.new_state[name] = {"cached": x}
+            if use_cached and not ctx.training:
+                return [st["cached"]]
+        return [x]

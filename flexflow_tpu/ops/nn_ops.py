@@ -1,0 +1,487 @@
+"""Neural-net operators: dense, conv, pool, norms, attention, embedding, ...
+
+Reference parity: ``src/ops/{linear,conv_2d,pool_2d,batch_norm,layer_norm,
+softmax,dropout,embedding,attention,batch_matmul,flat}.cc`` — rebuilt as JAX
+emission (XLA handles kernel selection/fusion; bf16 matmuls target the MXU).
+Shape conventions follow the reference's Python API: images are NCHW,
+sequences are (batch, seq, hidden).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ffconst import (ActiMode, AggrMode, DataType, InitializerType,
+                       OperatorType, PoolType)
+from ..core.tensor import WeightSpec
+from ..dtypes import to_jnp
+from .registry import EmitCtx, OpDef, matmul, register
+
+
+def apply_activation(x, acti: ActiMode):
+    acti = ActiMode(acti)
+    if acti == ActiMode.AC_MODE_NONE:
+        return x
+    if acti == ActiMode.AC_MODE_RELU:
+        return jax.nn.relu(x)
+    if acti == ActiMode.AC_MODE_SIGMOID:
+        return jax.nn.sigmoid(x)
+    if acti == ActiMode.AC_MODE_TANH:
+        return jnp.tanh(x)
+    if acti == ActiMode.AC_MODE_GELU:
+        return jax.nn.gelu(x)
+    raise ValueError(acti)
+
+
+# ---------------------------------------------------------------------------
+@register
+class LinearOp(OpDef):
+    """Dense / fully-connected (reference ``src/ops/linear.cc``).
+
+    y = act(x @ kernel + bias); kernel (in_dim, out_dim). The reference's
+    cuBLAS GEMM + activation epilogue becomes one bf16 MXU matmul that XLA
+    fuses with the epilogue.
+    """
+    op_type = OperatorType.OP_LINEAR
+
+    def infer(self, params, in_shapes, in_dtypes):
+        (ish,) = in_shapes
+        out_dim = params["out_dim"]
+        out_dtype = params.get("dtype", in_dtypes[0])
+        return [(tuple(ish[:-1]) + (out_dim,), out_dtype)]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        in_dim = in_shapes[0][-1]
+        out_dim = params["out_dim"]
+        dt = params.get("dtype", in_dtypes[0])
+        ws = [WeightSpec("kernel", (in_dim, out_dim), dt,
+                         params.get("kernel_initializer",
+                                    InitializerType.GLOROT_UNIFORM))]
+        if params.get("use_bias", True):
+            ws.append(WeightSpec("bias", (out_dim,), dt, InitializerType.ZERO))
+        return ws
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        y = matmul(x, weights["kernel"])
+        if "bias" in weights:
+            y = y + weights["bias"]
+        y = apply_activation(y, params.get("activation",
+                                           ActiMode.AC_MODE_NONE))
+        if "dtype" in params:
+            y = y.astype(to_jnp(params["dtype"]))
+        return [y]
+
+    def flops(self, params, in_shapes, out_shapes):
+        batch = int(np.prod(in_shapes[0][:-1]))
+        return 2.0 * batch * in_shapes[0][-1] * params["out_dim"]
+
+    def backward_flops_factor(self):
+        return 2.0
+
+
+# ---------------------------------------------------------------------------
+@register
+class Conv2DOp(OpDef):
+    """2-D convolution, NCHW (reference ``src/ops/conv_2d.cc``)."""
+    op_type = OperatorType.OP_CONV2D
+
+    def infer(self, params, in_shapes, in_dtypes):
+        n, c, h, w = in_shapes[0]
+        kh, kw = params["kernel_h"], params["kernel_w"]
+        sh, sw = params["stride_h"], params["stride_w"]
+        ph, pw = params["padding_h"], params["padding_w"]
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        return [((n, params["out_channels"], oh, ow), in_dtypes[0])]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        c = in_shapes[0][1]
+        groups = params.get("groups", 1)
+        dt = in_dtypes[0]
+        ws = [WeightSpec("kernel",
+                         (params["out_channels"], c // groups,
+                          params["kernel_h"], params["kernel_w"]), dt,
+                         params.get("kernel_initializer",
+                                    InitializerType.GLOROT_UNIFORM))]
+        if params.get("use_bias", True):
+            ws.append(WeightSpec("bias", (params["out_channels"],), dt,
+                                 InitializerType.ZERO))
+        return ws
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        k = weights["kernel"]
+        cdt = x.dtype
+        if cdt == jnp.float32:
+            x16, k16 = x.astype(jnp.bfloat16), k.astype(jnp.bfloat16)
+        else:
+            x16, k16 = x, k
+        # No preferred_element_type here: its conv VJP emits a transposed
+        # conv with mismatched (f32 cotangent, bf16 kernel) dtypes. bf16
+        # in/out is fine — the MXU accumulates in f32 internally.
+        y = jax.lax.conv_general_dilated(
+            x16, k16,
+            window_strides=(params["stride_h"], params["stride_w"]),
+            padding=[(params["padding_h"], params["padding_h"]),
+                     (params["padding_w"], params["padding_w"])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=params.get("groups", 1))
+        y = y.astype(cdt)
+        if "bias" in weights:
+            y = y + weights["bias"][None, :, None, None]
+        return [apply_activation(y, params.get("activation",
+                                               ActiMode.AC_MODE_NONE))]
+
+    def flops(self, params, in_shapes, out_shapes):
+        n, co, oh, ow = out_shapes[0]
+        ci = in_shapes[0][1] // params.get("groups", 1)
+        return 2.0 * n * co * oh * ow * ci * params["kernel_h"] * params["kernel_w"]
+
+    def backward_flops_factor(self):
+        return 2.0
+
+
+# ---------------------------------------------------------------------------
+@register
+class Pool2DOp(OpDef):
+    """Max/avg pooling, NCHW (reference ``src/ops/pool_2d.cc``)."""
+    op_type = OperatorType.OP_POOL2D
+
+    def infer(self, params, in_shapes, in_dtypes):
+        n, c, h, w = in_shapes[0]
+        kh, kw = params["kernel_h"], params["kernel_w"]
+        sh, sw = params["stride_h"], params["stride_w"]
+        ph, pw = params["padding_h"], params["padding_w"]
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        return [((n, c, oh, ow), in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        kh, kw = params["kernel_h"], params["kernel_w"]
+        sh, sw = params["stride_h"], params["stride_w"]
+        ph, pw = params["padding_h"], params["padding_w"]
+        dims = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if PoolType(params.get("pool_type", PoolType.POOL_MAX)) == PoolType.POOL_MAX:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+        else:
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+            # count_include_pad=True matches cuDNN's default used by the reference
+            y = s / float(kh * kw)
+        return [apply_activation(y, params.get("activation",
+                                               ActiMode.AC_MODE_NONE))]
+
+
+# ---------------------------------------------------------------------------
+@register
+class FlatOp(OpDef):
+    """NCHW → (N, C*H*W) (reference ``src/ops/flat.cc``)."""
+    op_type = OperatorType.OP_FLAT
+
+    def infer(self, params, in_shapes, in_dtypes):
+        s = in_shapes[0]
+        return [((s[0], int(np.prod(s[1:]))), in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        return [x.reshape(x.shape[0], -1)]
+
+
+# ---------------------------------------------------------------------------
+@register
+class SoftmaxOp(OpDef):
+    op_type = OperatorType.OP_SOFTMAX
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        return [jax.nn.softmax(x, axis=params.get("axis", -1))]
+
+
+# ---------------------------------------------------------------------------
+@register
+class DropoutOp(OpDef):
+    op_type = OperatorType.OP_DROPOUT
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        rate = params.get("rate", 0.5)
+        if not ctx.training or rate <= 0.0:
+            return [x]
+        rng = ctx.rng_for(name)
+        assert rng is not None, f"dropout layer {name} needs an rng"
+        keep = 1.0 - rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, jnp.zeros_like(x))]
+
+
+# ---------------------------------------------------------------------------
+@register
+class BatchNormOp(OpDef):
+    """Batch norm over NCHW, with running stats in the state collection
+    (reference ``src/ops/batch_norm.cc``; cuDNN BN → jnp + state threading)."""
+    op_type = OperatorType.OP_BATCHNORM
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        c = in_shapes[0][1]
+        dt = in_dtypes[0]
+        return [WeightSpec("scale", (c,), dt, InitializerType.ONE),
+                WeightSpec("bias", (c,), dt, InitializerType.ZERO)]
+
+    def state_spec(self, params, in_shapes, in_dtypes):
+        c = in_shapes[0][1]
+        return {"mean": ((c,), DataType.DT_FLOAT),
+                "var": ((c,), DataType.DT_FLOAT)}
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        eps = params.get("eps", 1e-5)
+        momentum = params.get("momentum", 0.1)
+        axes = (0, 2, 3) if x.ndim == 4 else (0,)
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+        st = ctx.state.get(name, {})
+        if ctx.training or not st:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            if st:
+                ctx.new_state[name] = {
+                    "mean": (1 - momentum) * st["mean"] + momentum * mean,
+                    "var": (1 - momentum) * st["var"] + momentum * var,
+                }
+        else:
+            mean, var = st["mean"], st["var"]
+        inv = jax.lax.rsqrt(var + eps) * weights["scale"].astype(jnp.float32)
+        y = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape) \
+            + weights["bias"].astype(jnp.float32).reshape(bshape)
+        y = y.astype(x.dtype)
+        if params.get("relu", True):
+            y = jax.nn.relu(y)
+        return [y]
+
+
+# ---------------------------------------------------------------------------
+@register
+class LayerNormOp(OpDef):
+    """Layer norm (reference ``src/ops/layer_norm.cc`` — Welford kernels →
+    jnp mean/var which XLA fuses into one pass)."""
+    op_type = OperatorType.OP_LAYERNORM
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        if not params.get("elementwise_affine", True):
+            return []
+        axes = params.get("axes", [len(in_shapes[0]) - 1])
+        shape = tuple(in_shapes[0][a] for a in axes)
+        dt = in_dtypes[0]
+        return [WeightSpec("scale", shape, dt, InitializerType.ONE),
+                WeightSpec("bias", shape, dt, InitializerType.ZERO)]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        ndim = x.ndim
+        axes = tuple(a % ndim for a in params.get("axes", [ndim - 1]))
+        eps = params.get("eps", 1e-5)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps)
+        if "scale" in weights:
+            bshape = [x.shape[a] if a in axes else 1 for a in range(ndim)]
+            y = y * weights["scale"].astype(jnp.float32).reshape(bshape) \
+                + weights["bias"].astype(jnp.float32).reshape(bshape)
+        return [y.astype(x.dtype)]
+
+
+# ---------------------------------------------------------------------------
+@register
+class RMSNormOp(OpDef):
+    """RMSNorm — TPU-native addition (used by T5/LLaMA-style models; the
+    reference fuses T5LayerNorm patterns in its fx frontend)."""
+    op_type = OperatorType.OP_RMSNORM
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        return [WeightSpec("scale", (in_shapes[0][-1],), in_dtypes[0],
+                           InitializerType.ONE)]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        eps = params.get("eps", 1e-6)
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(ms + eps) * weights["scale"].astype(jnp.float32)
+        return [y.astype(x.dtype)]
+
+
+# ---------------------------------------------------------------------------
+@register
+class EmbeddingOp(OpDef):
+    """Embedding lookup with none/sum/avg aggregation
+    (reference ``src/ops/embedding.cc``: gather/scatter-add kernels →
+    jnp.take, which XLA lowers to TPU gather)."""
+    op_type = OperatorType.OP_EMBEDDING
+
+    def infer(self, params, in_shapes, in_dtypes):
+        ish = in_shapes[0]
+        out_dim = params["out_dim"]
+        dt = params.get("dtype", DataType.DT_FLOAT)
+        aggr = AggrMode(params.get("aggr", AggrMode.AGGR_MODE_NONE))
+        if aggr == AggrMode.AGGR_MODE_NONE:
+            return [(tuple(ish) + (out_dim,), dt)]
+        # sum/avg aggregate over the trailing (bag) dim
+        return [(tuple(ish[:-1]) + (out_dim,), dt)]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        dt = params.get("dtype", DataType.DT_FLOAT)
+        return [WeightSpec("kernel", (params["num_entries"], params["out_dim"]),
+                           dt, params.get("kernel_initializer",
+                                          InitializerType.GLOROT_UNIFORM))]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (ids,) = inputs
+        table = weights["kernel"]
+        aggr = AggrMode(params.get("aggr", AggrMode.AGGR_MODE_NONE))
+        out = jnp.take(table, ids.astype(jnp.int32), axis=0)
+        if aggr == AggrMode.AGGR_MODE_SUM:
+            out = jnp.sum(out, axis=-2)
+        elif aggr == AggrMode.AGGR_MODE_AVG:
+            out = jnp.mean(out, axis=-2)
+        return [out]
+
+
+# ---------------------------------------------------------------------------
+@register
+class MultiHeadAttentionOp(OpDef):
+    """Multi-head attention (reference ``src/ops/attention.cc`` wraps cuDNN
+    MHA; here: einsum attention, bf16 on the MXU, fp32 softmax).
+
+    Inputs: query (B, Lq, E), key (B, Lk, Ek), value (B, Lv, Ev).
+    Output: (B, Lq, E) after the output projection — matching
+    ``FFModel::multihead_attention`` (reference ``model.h``).
+    """
+    op_type = OperatorType.OP_MULTIHEAD_ATTENTION
+
+    def infer(self, params, in_shapes, in_dtypes):
+        q = in_shapes[0]
+        return [((q[0], q[1], params["embed_dim"]), in_dtypes[0])]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        e = params["embed_dim"]
+        h = params["num_heads"]
+        kdim = params.get("kdim", 0) or e
+        vdim = params.get("vdim", 0) or e
+        # qProjSize == kProjSize == kdim (reference attention.cc:182)
+        dt = in_dtypes[0]
+        qe, ke, ve = in_shapes[0][-1], in_shapes[1][-1], in_shapes[2][-1]
+        ws = [WeightSpec("wq", (qe, h, kdim // h), dt),
+              WeightSpec("wk", (ke, h, kdim // h), dt),
+              WeightSpec("wv", (ve, h, vdim // h), dt),
+              WeightSpec("wo", (h, vdim // h, e), dt)]
+        if params.get("bias", True):
+            ws += [WeightSpec("bq", (h, kdim // h), dt, InitializerType.ZERO),
+                   WeightSpec("bk", (h, kdim // h), dt, InitializerType.ZERO),
+                   WeightSpec("bv", (h, vdim // h), dt, InitializerType.ZERO),
+                   WeightSpec("bo", (e,), dt, InitializerType.ZERO)]
+        return ws
+
+    def emit(self, params, inputs, weights, ctx, name):
+        q, k, v = inputs
+        cdt = q.dtype
+        h = params["num_heads"]
+
+        def proj(x, w, b):
+            y = jnp.einsum("ble,ehd->blhd", x.astype(jnp.bfloat16),
+                           w.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)
+            if b is not None:
+                y = y + b.astype(jnp.float32)
+            return y
+
+        qh = proj(q, weights["wq"], weights.get("bq"))
+        kh = proj(k, weights["wk"], weights.get("bk"))
+        vh = proj(v, weights["wv"], weights.get("bv"))
+        scale = 1.0 / math.sqrt(qh.shape[-1])
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.bfloat16),
+                            kh.astype(jnp.bfloat16),
+                            preferred_element_type=jnp.float32) * scale
+        if params.get("causal", False):
+            lq, lk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), lk - lq)
+            logits = jnp.where(mask, logits, jnp.float32(-1e9))
+        probs = jax.nn.softmax(logits, axis=-1)
+        rate = params.get("dropout", 0.0)
+        if ctx.training and rate > 0.0:
+            rng = ctx.rng_for(name)
+            keep = 1.0 - rate
+            probs = jnp.where(jax.random.bernoulli(rng, keep, probs.shape),
+                              probs / keep, 0.0)
+        ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16),
+                          vh.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+        out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(jnp.bfloat16),
+                         weights["wo"].astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        if "bo" in weights:
+            out = out + weights["bo"].astype(jnp.float32)
+        return [out.astype(cdt)]
+
+    def flops(self, params, in_shapes, out_shapes):
+        b, lq, _ = in_shapes[0]
+        lk = in_shapes[1][1]
+        e = params["embed_dim"]
+        proj = 2.0 * b * (lq + 2 * lk) * e * e + 2.0 * b * lq * e * e
+        attn = 2.0 * b * lq * lk * e * 2
+        return proj + attn
+
+    def backward_flops_factor(self):
+        return 2.0
+
+
+# ---------------------------------------------------------------------------
+@register
+class BatchMatmulOp(OpDef):
+    """Batched matmul with optional seq-length masking
+    (reference ``src/ops/batch_matmul.cc``)."""
+    op_type = OperatorType.OP_BATCHMATMUL
+
+    def infer(self, params, in_shapes, in_dtypes):
+        a, b = in_shapes
+        assert a[:-2] == b[:-2], (a, b)
+        assert a[-1] == b[-2], (a, b)
+        return [(tuple(a[:-1]) + (b[-1],), in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        a, b = inputs
+        return [matmul(a, b)]
+
+    def flops(self, params, in_shapes, out_shapes):
+        a, b = in_shapes
+        return 2.0 * float(np.prod(a)) * b[-1]
+
+    def backward_flops_factor(self):
+        return 2.0
+
+
+@register
+class MatmulOp(BatchMatmulOp):
+    op_type = OperatorType.OP_MATMUL

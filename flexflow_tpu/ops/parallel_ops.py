@@ -1,0 +1,81 @@
+"""Parallel operators: communication reified as graph nodes.
+
+Reference parity: ``src/parallel_ops/{partition,combine,replicate,reduction,
+fused_parallel_op}.cc``. In the reference each is a Legion index launch with
+a custom CUDA copy kernel; here each is a *sharding transition*: the emitted
+value is (numerically) identity / reduction, and the executor attaches a
+``jax.lax.with_sharding_constraint`` for the target sharding so XLA inserts
+the matching ICI collective (all-to-all / all-gather / collective-permute /
+reduce-scatter). See parallel/strategy.py for the sharding attachment.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import OperatorType
+from .registry import EmitCtx, OpDef, register
+
+
+class _ShardingTransitionBase(OpDef):
+    """Identity at the value level; sharding change at the mesh level."""
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [inputs[0]]
+
+
+@register
+class RepartitionOp(_ShardingTransitionBase):
+    """Re-shard along dim `dim` with degree `degree` (scatter)."""
+    op_type = OperatorType.OP_REPARTITION
+
+
+@register
+class CombineOp(_ShardingTransitionBase):
+    """Inverse of repartition (gather along a dim)."""
+    op_type = OperatorType.OP_COMBINE
+
+
+@register
+class ReplicateOp(_ShardingTransitionBase):
+    """Replicate across `degree` devices (broadcast)."""
+    op_type = OperatorType.OP_REPLICATE
+
+
+@register
+class ReductionOp(_ShardingTransitionBase):
+    """Sum-combine `degree` replicas (all-reduce / reduce-scatter).
+
+    Value-level: with GSPMD the partial sums live in an unreduced sharding
+    only inside shard_map-style code; under pjit the producing op already
+    yields the full sum, so this is an identity plus a sharding constraint.
+    """
+    op_type = OperatorType.OP_REDUCTION
+
+
+@register
+class AllToAllOp(_ShardingTransitionBase):
+    """Resharding between two partitioned dims (sequence<->head parallax for
+    Ulysses-style sequence parallelism). TPU-native addition."""
+    op_type = OperatorType.OP_ALLTOALL
+
+
+@register
+class PipelineOp(_ShardingTransitionBase):
+    """Pipeline stage boundary marker (reference has only the enum,
+    ``ffconst.h:159`` — no implementation; we give it real semantics in the
+    pipeline executor: stage split point for lax.scan-based 1F1B/GPipe)."""
+    op_type = OperatorType.OP_PIPELINE
+
+
+@register
+class FusedParallelOp(_ShardingTransitionBase):
+    """A chain of parallel ops collapsed into one transition
+    (reference ``fused_parallel_op.cc``): the net effect is just the final
+    sharding, which is exactly what one with_sharding_constraint expresses."""
+    op_type = OperatorType.OP_FUSED_PARALLEL

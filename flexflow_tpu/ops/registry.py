@@ -1,0 +1,94 @@
+"""Operator registry.
+
+Analog of the reference's ``Op`` contract (``include/flexflow/operator.h:51``):
+each operator type registers an ``OpDef`` implementing
+
+  - ``infer``   : shape/dtype inference (compute-graph level)
+  - ``weights`` : declarative parameter specs (kernel/bias/...)
+  - ``emit``    : JAX emission — the forward computation. Backward comes from
+                  ``jax.grad`` over the whole graph (XLA fuses + schedules),
+                  replacing the reference's per-op ``backward_task`` bodies.
+  - ``flops`` / ``bytes`` : analytic cost hooks for the execution simulator
+                  (analog of ``measure_operator_cost``; real on-chip
+                  microbenchmarks refine these, see search/simulator.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ffconst import DataType, OperatorType
+from ..core.tensor import WeightSpec
+
+
+class EmitCtx:
+    """Per-trace emission context threaded through op emission."""
+
+    def __init__(self, training: bool, rngs: Optional[Dict[str, Any]] = None,
+                 state: Optional[Dict[str, Any]] = None, config=None,
+                 seq_length: int = -1):
+        self.training = training
+        self.rngs = rngs or {}
+        self.state = state or {}          # read-only collection (e.g. BN stats)
+        self.new_state: Dict[str, Any] = {}  # updated state written by ops
+        self.config = config
+        self.seq_length = seq_length
+        self.aux_losses: List[Any] = []  # e.g. MoE load-balancing terms
+
+    def rng_for(self, name: str):
+        return self.rngs.get(name)
+
+
+class OpDef:
+    op_type: OperatorType = OperatorType.OP_INVALID
+
+    # ---- graph level ----
+    def infer(self, params: Dict[str, Any],
+              in_shapes: Sequence[Tuple[int, ...]],
+              in_dtypes: Sequence[DataType]) -> List[Tuple[Tuple[int, ...], DataType]]:
+        raise NotImplementedError
+
+    def weights(self, params: Dict[str, Any],
+                in_shapes: Sequence[Tuple[int, ...]],
+                in_dtypes: Sequence[DataType]) -> List[WeightSpec]:
+        return []
+
+    # ---- execution level ----
+    def emit(self, params: Dict[str, Any], inputs: List[Any],
+             weights: Dict[str, Any], ctx: EmitCtx, name: str) -> List[Any]:
+        raise NotImplementedError
+
+    # ---- cost level (simulator) ----
+    def flops(self, params, in_shapes, out_shapes) -> float:
+        """Forward FLOPs estimate. Default: one op per output element."""
+        return float(sum(int(np.prod(s)) for s in out_shapes))
+
+    def backward_flops_factor(self) -> float:
+        """bwd/fwd FLOP ratio. 2.0 for matmul-like ops (dgrad+wgrad)."""
+        return 1.0
+
+
+OPS: Dict[OperatorType, OpDef] = {}
+
+
+def register(cls):
+    inst = cls()
+    assert inst.op_type != OperatorType.OP_INVALID, cls
+    OPS[inst.op_type] = inst
+    return cls
+
+
+def get_op_def(op_type: OperatorType) -> OpDef:
+    return OPS[OperatorType(op_type)]
+
+
+def matmul(a, b, *, prefer_bf16: bool = True, precision=None):
+    """MXU-friendly matmul: bf16 inputs, fp32 accumulation."""
+    import jax.numpy as jnp
+    if prefer_bf16 and a.dtype in (jnp.float32, jnp.bfloat16):
+        a16 = a.astype(jnp.bfloat16)
+        b16 = b.astype(jnp.bfloat16)
+        out = jnp.matmul(a16, b16, preferred_element_type=jnp.float32)
+        return out.astype(a.dtype) if a.dtype != jnp.float32 else out
+    return jnp.matmul(a, b)

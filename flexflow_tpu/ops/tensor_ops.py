@@ -1,0 +1,248 @@
+"""Tensor-layout operators: reshape/transpose/concat/split/pad/slice/topk/...
+
+Reference parity: ``src/ops/{reshape,transpose,reverse,concat,split,pad,
+topk,gather,noop}.cc`` — the reference needed custom copy/permute CUDA
+kernels (cuTT-style); on TPU these are XLA ops the compiler lays out.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ffconst import DataType, OperatorType
+from .registry import EmitCtx, OpDef, register
+
+
+@register
+class NoOp(OpDef):
+    op_type = OperatorType.OP_NOOP
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [inputs[0]]
+
+
+@register
+class InputOp(NoOp):
+    op_type = OperatorType.OP_INPUT
+
+
+@register
+class WeightOp(NoOp):
+    op_type = OperatorType.OP_WEIGHT
+
+
+@register
+class ReshapeOp(OpDef):
+    op_type = OperatorType.OP_RESHAPE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        shape = tuple(params["shape"])
+        vol_in = int(np.prod(in_shapes[0]))
+        if -1 in shape:
+            known = -int(np.prod(shape))
+            shape = tuple(vol_in // known if s == -1 else s for s in shape)
+        assert int(np.prod(shape)) == vol_in, (in_shapes[0], shape)
+        return [(shape, in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        shape = tuple(params["shape"])
+        return [inputs[0].reshape(shape)]
+
+
+@register
+class TransposeOp(OpDef):
+    op_type = OperatorType.OP_TRANSPOSE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        perm = params["perm"]
+        return [(tuple(in_shapes[0][p] for p in perm), in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jnp.transpose(inputs[0], params["perm"])]
+
+
+@register
+class ReverseOp(OpDef):
+    op_type = OperatorType.OP_REVERSE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[0], in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jnp.flip(inputs[0], axis=params["axis"])]
+
+
+@register
+class ConcatOp(OpDef):
+    op_type = OperatorType.OP_CONCAT
+
+    def infer(self, params, in_shapes, in_dtypes):
+        axis = params["axis"] % len(in_shapes[0])
+        out = list(in_shapes[0])
+        out[axis] = sum(s[axis] for s in in_shapes)
+        return [(tuple(out), in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jnp.concatenate(inputs, axis=params["axis"])]
+
+
+@register
+class SplitOp(OpDef):
+    op_type = OperatorType.OP_SPLIT
+
+    def infer(self, params, in_shapes, in_dtypes):
+        ish = in_shapes[0]
+        axis = params["axis"] % len(ish)
+        sizes = params["sizes"]
+        assert sum(sizes) == ish[axis], (sizes, ish, axis)
+        outs = []
+        for sz in sizes:
+            o = list(ish)
+            o[axis] = sz
+            outs.append((tuple(o), in_dtypes[0]))
+        return outs
+
+    def emit(self, params, inputs, weights, ctx, name):
+        x = inputs[0]
+        axis = params["axis"] % x.ndim
+        idx = np.cumsum(params["sizes"])[:-1].tolist()
+        return list(jnp.split(x, idx, axis=axis))
+
+
+@register
+class SqueezeOp(OpDef):
+    op_type = OperatorType.OP_SQUEEZE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        ish = in_shapes[0]
+        axes = [a % len(ish) for a in params["axes"]]
+        out = tuple(s for i, s in enumerate(ish) if i not in axes)
+        return [(out, in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        x = inputs[0]
+        return [jnp.squeeze(x, axis=tuple(a % x.ndim for a in params["axes"]))]
+
+
+@register
+class UnsqueezeOp(OpDef):
+    op_type = OperatorType.OP_UNSQUEEZE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        out = list(in_shapes[0])
+        for a in sorted(params["axes"]):
+            out.insert(a, 1)
+        return [(tuple(out), in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jnp.expand_dims(inputs[0], tuple(params["axes"]))]
+
+
+@register
+class PadOp(OpDef):
+    op_type = OperatorType.OP_PAD
+
+    def infer(self, params, in_shapes, in_dtypes):
+        pads = params["pads"]  # [(lo, hi)] * ndim
+        out = tuple(s + lo + hi for s, (lo, hi) in zip(in_shapes[0], pads))
+        return [(out, in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jnp.pad(inputs[0], params["pads"],
+                        constant_values=params.get("value", 0.0))]
+
+
+@register
+class SliceOp(OpDef):
+    op_type = OperatorType.OP_SLICE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        ish = in_shapes[0]
+        starts, ends = params["starts"], params["ends"]
+        axes = params.get("axes", list(range(len(starts))))
+        out = list(ish)
+        for s, e, a in zip(starts, ends, axes):
+            n = ish[a % len(ish)]
+            s = min(s if s >= 0 else s + n, n)
+            e = min(e if e >= 0 else e + n, n)
+            out[a % len(ish)] = max(0, e - s)
+        return [(tuple(out), in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        x = inputs[0]
+        starts, ends = params["starts"], params["ends"]
+        axes = params.get("axes", list(range(len(starts))))
+        idx = [slice(None)] * x.ndim
+        for s, e, a in zip(starts, ends, axes):
+            idx[a % x.ndim] = slice(s, e)
+        return [x[tuple(idx)]]
+
+
+@register
+class TopKOp(OpDef):
+    """TopK (reference ``src/ops/topk.cc`` heap kernels → jax.lax.top_k)."""
+    op_type = OperatorType.OP_TOPK
+
+    def infer(self, params, in_shapes, in_dtypes):
+        k = params["k"]
+        out = tuple(in_shapes[0][:-1]) + (k,)
+        return [(out, in_dtypes[0]), (out, DataType.DT_INT32)]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        vals, idx = jax.lax.top_k(inputs[0], params["k"])
+        return [vals, idx.astype(jnp.int32)]
+
+
+@register
+class GatherOp(OpDef):
+    """torch.gather semantics (reference ``src/ops/gather.cc``)."""
+    op_type = OperatorType.OP_GATHER
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(in_shapes[1], in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        x, index = inputs
+        dim = params.get("dim", 0) % x.ndim
+        return [jnp.take_along_axis(x, index.astype(jnp.int32), axis=dim)]
+
+
+@register
+class ShapeOp(OpDef):
+    op_type = OperatorType.OP_SHAPE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [((len(in_shapes[0]),), DataType.DT_INT32)]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jnp.asarray(inputs[0].shape, dtype=jnp.int32)]
+
+
+@register
+class SizeOp(OpDef):
+    op_type = OperatorType.OP_SIZE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [((), DataType.DT_INT32)]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jnp.asarray(inputs[0].size, dtype=jnp.int32)]
+
+
+@register
+class ResizeOp(OpDef):
+    """Nearest/linear image resize (ONNX Resize)."""
+    op_type = OperatorType.OP_RESIZE
+
+    def infer(self, params, in_shapes, in_dtypes):
+        return [(tuple(params["size"]), in_dtypes[0])]
+
+    def emit(self, params, inputs, weights, ctx, name):
+        return [jax.image.resize(inputs[0], tuple(params["size"]),
+                                 method=params.get("method", "nearest"))]

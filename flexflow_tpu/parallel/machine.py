@@ -1,0 +1,155 @@
+"""TPU machine model: device mesh + interconnect description.
+
+Replaces the reference's ``MachineView``/``MachineModel`` hierarchy
+(``include/flexflow/machine_view.h``, ``simulator.h:212-605``). The
+reference models sockets/PCIe/NVLink/NIC; a TPU slice is a torus of chips
+joined by ICI with DCN between slices, so the model is: per-axis ICI
+bandwidth/latency, DCN bandwidth, HBM capacity/bandwidth, and peak MXU
+FLOP/s — the constants the execution simulator uses to cost collectives.
+
+The mesh is factorized into *atomic axes* (prime factors of the device
+count). A search-assigned parallel degree d is realized as a subset of
+atomic axes whose sizes multiply to d; this is how a per-op "degree" in the
+reference maps onto one global GSPMD mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _prime_factors(n: int) -> List[int]:
+    out, p = [], 2
+    while p * p <= n:
+        while n % p == 0:
+            out.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        out.append(n)
+    return sorted(out, reverse=True)
+
+
+# Per-generation hardware constants (public figures; bf16 FLOP/s).
+TPU_GENERATIONS = {
+    # name: (peak bf16 TFLOP/s, HBM GiB, HBM GB/s, ICI GB/s per link (one dir))
+    "v4": (275.0, 32.0, 1228.0, 50.0),
+    "v5e": (197.0, 16.0, 819.0, 50.0),
+    "v5p": (459.0, 95.0, 2765.0, 100.0),
+    "v6e": (918.0, 32.0, 1640.0, 90.0),
+    "cpu-sim": (0.2, 8.0, 50.0, 5.0),
+}
+
+
+@dataclasses.dataclass
+class MachineSpec:
+    """Description of the target machine for both execution and simulation."""
+    num_devices: int = 1
+    generation: str = "v5e"
+    # physical ICI topology, e.g. (4, 8) for v5e-32; product may exceed
+    # num_devices for partial slices
+    ici_shape: Optional[Tuple[int, ...]] = None
+    num_slices: int = 1                     # multi-slice via DCN
+    dcn_bandwidth_gbps: float = 25.0        # per-host DCN
+    ici_latency_us: float = 1.0
+    dcn_latency_us: float = 10.0
+
+    @property
+    def peak_flops(self) -> float:
+        return TPU_GENERATIONS[self.generation][0] * 1e12
+
+    @property
+    def hbm_bytes(self) -> float:
+        return TPU_GENERATIONS[self.generation][1] * (1 << 30)
+
+    @property
+    def hbm_bandwidth(self) -> float:
+        return TPU_GENERATIONS[self.generation][2] * 1e9
+
+    @property
+    def ici_bandwidth(self) -> float:
+        return TPU_GENERATIONS[self.generation][3] * 1e9
+
+    @classmethod
+    def detect(cls, devices=None) -> "MachineSpec":
+        import jax
+        devices = devices or jax.devices()
+        kind = devices[0].device_kind.lower()
+        gen = "v5e"
+        for g in ("v6e", "v5p", "v5e", "v4"):
+            if g in kind.replace(" ", ""):
+                gen = g
+                break
+        if devices[0].platform == "cpu":
+            gen = "cpu-sim"
+        return cls(num_devices=len(devices), generation=gen)
+
+
+class DeviceMesh:
+    """Factorized global mesh. Axis names are ``x0, x1, ...`` sized by the
+    prime factorization of the device count (largest factor first)."""
+
+    def __init__(self, spec: MachineSpec, devices=None,
+                 mesh_shape: Optional[Sequence[int]] = None):
+        import jax
+        from jax.sharding import Mesh
+        self.spec = spec
+        devices = devices if devices is not None else jax.devices()
+        devices = devices[: spec.num_devices]
+        if mesh_shape is not None:
+            factors = [int(s) for s in mesh_shape if int(s) > 1] or [1]
+        else:
+            factors = _prime_factors(len(devices)) or [1]
+        self.axis_sizes: Dict[str, int] = {
+            f"x{i}": f for i, f in enumerate(factors)}
+        arr = np.asarray(devices).reshape(tuple(self.axis_sizes.values()))
+        self.mesh = Mesh(arr, tuple(self.axis_sizes.keys()))
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.axis_sizes.keys())
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(list(self.axis_sizes.values()))) if self.axis_sizes else 1
+
+    def allocate_axes(self, degree: int,
+                      used: Sequence[str]) -> Optional[Tuple[str, ...]]:
+        """Pick unused atomic axes whose sizes multiply to exactly `degree`.
+
+        Greedy largest-first subset-product; returns None if impossible.
+        This is the analog of the reference's machine-view enumeration
+        (``FFModel::register_all_machine_views``) constrained to one mesh.
+        """
+        if degree == 1:
+            return ()
+        avail = [(a, s) for a, s in self.axis_sizes.items() if a not in used]
+        picked: List[str] = []
+        rem = degree
+
+        def search(i: int, rem: int) -> bool:
+            if rem == 1:
+                return True
+            if i >= len(avail):
+                return False
+            a, s = avail[i]
+            if rem % s == 0:
+                picked.append(a)
+                if search(i + 1, rem // s):
+                    return True
+                picked.pop()
+            return search(i + 1, rem)
+
+        if search(0, rem):
+            return tuple(picked)
+        return None
+
+    def valid_degrees(self) -> List[int]:
+        """All degrees realizable as subset products of atomic axes."""
+        degs = {1}
+        for s in self.axis_sizes.values():
+            degs |= {d * s for d in degs}
+        return sorted(degs)

@@ -1,0 +1,3 @@
+from .optimizers import SGDOptimizer, AdamOptimizer, Optimizer  # noqa: F401
+from .dataloader import SingleDataLoader  # noqa: F401
+from .metrics import PerfMetrics  # noqa: F401

@@ -1,0 +1,53 @@
+"""Parameter initializers.
+
+Reference parity: ``src/runtime/initializer.cc`` + ``initializer_kernel.cu``
+(Glorot/Zero/Constant/Uniform/Normal as GPU tasks) — here pure jax.random,
+executed device-side at compile time with per-weight folded keys.
+"""
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import InitializerType
+
+
+def _fan_in_out(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv OIHW: fan_in = I*kh*kw, fan_out = O*kh*kw
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def initialize(spec, rng, jnp_dtype):
+    """Materialize one WeightSpec."""
+    kind = spec.initializer
+    shape = spec.shape
+    args = spec.init_args
+    if kind == InitializerType.ZERO:
+        return jnp.zeros(shape, jnp_dtype)
+    if kind == InitializerType.ONE:
+        return jnp.ones(shape, jnp_dtype)
+    if kind == InitializerType.CONSTANT:
+        return jnp.full(shape, args.get("value", 0.0), jnp_dtype)
+    if kind == InitializerType.UNIFORM:
+        lo, hi = args.get("min", -0.05), args.get("max", 0.05)
+        return jax.random.uniform(rng, shape, jnp_dtype, lo, hi)
+    if kind == InitializerType.NORMAL:
+        mean, std = args.get("mean", 0.0), args.get("stddev", 0.05)
+        return mean + std * jax.random.normal(rng, shape, jnp_dtype)
+    if kind == InitializerType.GLOROT_UNIFORM:
+        fan_in, fan_out = _fan_in_out(shape)
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, jnp_dtype, -limit, limit)
+    raise ValueError(kind)

@@ -1,0 +1,64 @@
+"""Loss functions.
+
+Reference parity: ``src/loss_functions/loss_functions.cc:41-160``. The
+reference computes the gradient of the final op's output directly (e.g.
+(probs - onehot)/B for softmax+CE). Here losses are scalar functions
+differentiated by jax.grad; when the graph ends in Softmax and the loss is
+cross-entropy, the executor passes the *logits* here and we use the fused
+stable form — the resulting gradient is identical to the reference's
+hand-written (probs - labels)/batch kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import LossType
+
+
+def compute_loss(loss_type: LossType, pred, label, *, logits: bool = False):
+    """Mean-reduced scalar loss. `pred` is the final op output (or pre-
+    softmax logits when logits=True and the loss is a cross-entropy)."""
+    loss_type = LossType(loss_type)
+    pred = pred.astype(jnp.float32)
+
+    if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+        label = label.reshape(pred.shape[:-1] + (-1,))[..., 0].astype(jnp.int32)
+        if logits:
+            logp = jax.nn.log_softmax(pred, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(pred, 1e-10, 1.0))
+        nll = -jnp.take_along_axis(logp, label[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    if loss_type == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
+        label = label.astype(jnp.float32)
+        if logits:
+            logp = jax.nn.log_softmax(pred, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(pred, 1e-10, 1.0))
+        # mean over batch rows, sum over classes (reference scale 1/batch)
+        batch = pred.size // pred.shape[-1]
+        return -jnp.sum(label * logp) / batch
+
+    if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE:
+        d = pred - label.astype(jnp.float32)
+        return jnp.mean(jnp.sum(d * d, axis=-1))
+
+    if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE:
+        d = pred - label.astype(jnp.float32)
+        # reference scale: 2/volume on grad == mean over all elements on loss
+        return jnp.mean(d * d)
+
+    if loss_type == LossType.LOSS_IDENTITY:
+        return jnp.mean(pred)
+
+    raise ValueError(loss_type)
+
+
+_CE_LOSSES = (LossType.LOSS_CATEGORICAL_CROSSENTROPY,
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+
+def wants_logits(loss_type: LossType) -> bool:
+    return LossType(loss_type) in _CE_LOSSES

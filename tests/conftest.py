@@ -1,0 +1,21 @@
+"""Test config: run on CPU with 8 virtual devices so multi-chip sharding
+logic is exercised without TPU hardware (the reference could only test
+multi-node on a real cluster; XLA's host-platform device simulation does
+better).
+
+Note: the ambient environment may force a TPU platform plugin (and ignore
+JAX_PLATFORMS), so we set the platform through jax.config after import —
+XLA_FLAGS must still be set before the CPU client initializes.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()

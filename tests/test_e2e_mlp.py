@@ -1,0 +1,69 @@
+"""End-to-end slice: MLP trains on random data, loss goes down.
+
+Mirrors the reference's hello-world gate (mnist_mlp via
+``tests/python_interface_test.sh``).
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import (ActiMode, AdamOptimizer, FFConfig, FFModel,
+                          SGDOptimizer)
+
+
+def make_blobs(n=512, d=20, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)) * 3.0
+    y = rng.integers(0, classes, size=n)
+    x = centers[y] + rng.normal(size=(n, d))
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def test_mlp_trains():
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 20), name="x")
+    t = ff.dense(x, 64, activation=ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 32, activation=ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 4)
+    out = ff.softmax(t)
+    ff.compile(SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+               ["accuracy"])
+
+    xs, ys = make_blobs(n=512)
+    hist = ff.fit(x=xs, y=ys, epochs=5, verbose=False)
+    assert hist[-1]["accuracy"] > 0.8, hist
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_mlp_eval_matches_train_metrics():
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 20), name="x")
+    t = ff.dense(x, 64, activation=ActiMode.AC_MODE_RELU)
+    out = ff.softmax(ff.dense(t, 4))
+    ff.compile(AdamOptimizer(0.01), "sparse_categorical_crossentropy",
+               ["accuracy"])
+    xs, ys = make_blobs(n=512)
+    ff.fit(x=xs, y=ys, epochs=3, verbose=False)
+    rep = ff.eval(x=xs, y=ys)
+    assert rep["accuracy"] > 0.8
+
+
+def test_mse_regression():
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 8), name="x")
+    out = ff.dense(ff.dense(x, 16, activation=ActiMode.AC_MODE_TANH), 1)
+    ff.compile(SGDOptimizer(lr=0.05), "mean_squared_error", [])
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(256, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 1)).astype(np.float32)
+    ys = xs @ w
+    hist = ff.fit(x=xs, y=ys, epochs=10, verbose=False)
+    assert hist[-1]["loss"] < 0.5 * hist[0]["loss"]

@@ -1,0 +1,80 @@
+"""Benchmark harness: prints ONE JSON line
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Measures the flagship training throughput (BERT-base train step,
+samples/sec/chip) on the available device(s). ``vs_baseline`` follows the
+reference's methodology (BASELINE.md): the ratio of the current strategy's
+throughput to pure data-parallel on the same hardware — on a single chip
+the canonical strategy IS data-parallel, so the ratio is computed against
+a stored reference measurement when present (bench_baseline.json), else
+against itself (1.0).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def bench_bert(batch=16, seq=128, steps=20, warmup=3):
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import BertConfig, build_bert
+
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    bcfg = BertConfig.base()
+    bcfg.max_position = seq
+    bcfg.dropout = 0.1
+    out = build_bert(ff, batch, seq, bcfg)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    rng = np.random.default_rng(0)
+    b = {"input_ids": rng.integers(0, bcfg.vocab_size,
+                                   size=(batch, seq)).astype(np.int32),
+         "position_ids": np.tile(np.arange(seq, dtype=np.int32),
+                                 (batch, 1)),
+         "label": rng.integers(0, 2, size=(batch, 1)).astype(np.int32)}
+    step = ff.executor.make_train_step()
+    for _ in range(warmup):
+        bm = ff._run_train_step(step, b)
+    # NOTE: block_until_ready does not synchronize on tunneled TPU
+    # backends; a device-to-host value fetch does. The chained params
+    # dependency forces all steps to complete before the final loss.
+    float(np.asarray(bm["loss"]))
+    import jax
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        bm = ff._run_train_step(step, b)
+    float(np.asarray(bm["loss"]))
+    dt = time.perf_counter() - t0
+    n_chips = max(1, len(jax.devices()))
+    return batch * steps / dt / n_chips
+
+
+def main():
+    value = bench_bert()
+    baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_baseline.json")
+    baseline = None
+    if os.path.exists(baseline_file):
+        try:
+            with open(baseline_file) as f:
+                baseline = json.load(f).get("bert_base_train_sps")
+        except Exception:
+            baseline = None
+    vs = value / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": "bert_base_train_samples_per_sec_per_chip",
+        "value": round(value, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -118,9 +118,9 @@ class Executor:
         state: Dict[str, Dict[str, Any]] = {}
         for li, layer in enumerate(self.program.layers):
             op = get_op_def(layer.op_type)
-            specs = op.weights(layer.params,
-                               [t.shape for t in layer.inputs],
-                               [t.dtype for t in layer.inputs])
+            specs = layer.weights or op.weights(
+                layer.params, [t.shape for t in layer.inputs],
+                [t.dtype for t in layer.inputs])
             layer.weights = specs
             if specs:
                 lp = {}

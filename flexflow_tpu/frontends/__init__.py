@@ -1,0 +1,5 @@
+"""ML frontends: torch.fx, ONNX, Keras-style (reference §2.7)."""
+from .torch_fx import PyTorchModel, torch_to_flexflow_graph  # noqa: F401
+# onnx_frontend and keras are imported lazily by users:
+#   from flexflow_tpu.frontends.onnx_frontend import ONNXModel
+#   from flexflow_tpu.frontends import keras

@@ -1,0 +1,82 @@
+"""Keras callbacks (reference ``python/flexflow/keras/callbacks.py``):
+Callback base, LearningRateScheduler, VerifyMetrics, EarlyStopping."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+
+class LearningRateScheduler(Callback):
+    """Adjusts the optimizer lr per epoch (reference parity)."""
+
+    def __init__(self, schedule):
+        self.schedule = schedule
+
+    def on_epoch_end(self, epoch, logs=None):
+        opt = self.model.ffmodel.optimizer
+        new_lr = self.schedule(epoch + 1)
+        if hasattr(opt, "lr"):
+            opt.lr = new_lr
+        if hasattr(opt, "alpha"):
+            opt.alpha = new_lr
+        # jitted step closes over python floats only through the optimizer
+        # object; rebuild the step so the new lr takes effect
+        self.model.ffmodel.executor._train_step = None
+
+
+class VerifyMetrics(Callback):
+    """Asserts the final metric meets a threshold — the reference CI's
+    accuracy assertion (``examples/python/keras/accuracy.py``)."""
+
+    def __init__(self, metric: str = "accuracy", threshold: float = 0.9):
+        self.metric = metric
+        self.threshold = threshold
+        self.last = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs and self.metric in logs:
+            self.last = logs[self.metric]
+
+    def on_train_end(self, logs=None):
+        assert self.last is not None, f"metric {self.metric} never reported"
+        assert self.last >= self.threshold, \
+            f"{self.metric}={self.last} < threshold {self.threshold}"
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor: str = "loss", patience: int = 3,
+                 min_delta: float = 0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best: Optional[float] = None
+        self.wait = 0
+        self.stopped_epoch: Optional[int] = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self.best is None or cur < self.best - self.min_delta:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = epoch

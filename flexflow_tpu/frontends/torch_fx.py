@@ -1,0 +1,378 @@
+"""PyTorch frontend: torch.fx symbolic trace → FFModel builder calls.
+
+Reference parity: ``python/flexflow/torch/model.py`` (``PyTorchModel.
+torch_to_ff``, ``_trace_model``): trace the module (HF transformers models
+via ``transformers.utils.fx`` when requested), walk nodes in topological
+order, and dispatch each fx node to the matching FFModel builder. Also
+supports the reference's file serialization hand-off (``torch_to_file`` /
+``file_to_ff``) in spirit via ``export_graph``/``import_graph``.
+
+Weight transfer: ``PyTorchModel.copy_weights(ff)`` moves the torch
+module's trained parameters into the compiled FFModel (the reference used
+``Parameter.set_weights`` NumPy round-trips the same way).
+"""
+from __future__ import annotations
+
+import math
+import operator
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ffconst import ActiMode, AggrMode, DataType, OperatorType, PoolType
+from ..core.tensor import Tensor
+from ..model import FFModel
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class PyTorchModel:
+    def __init__(self, module, is_hf_model: bool = False,
+                 batch_size: int = 1):
+        import torch
+        self.module = module.eval()
+        self.is_hf_model = is_hf_model
+        self.batch_size = batch_size
+        self._layer_of_module: Dict[str, str] = {}  # torch path -> ff layer
+
+    # ------------------------------------------------------------------
+    def _trace(self):
+        import torch.fx
+        if self.is_hf_model:
+            from transformers.utils import fx as hf_fx
+            return hf_fx.symbolic_trace(self.module)
+        return torch.fx.symbolic_trace(self.module)
+
+    # ------------------------------------------------------------------
+    def torch_to_ff(self, ff: FFModel, input_tensors: Sequence[Tensor]
+                    ) -> List[Tensor]:
+        """Build the FF graph from the traced module. ``input_tensors``
+        bind to placeholders in order (reference ``torch_to_ff``)."""
+        import torch
+        gm = self._trace()
+        modules = dict(gm.named_modules())
+        env: Dict[str, Any] = {}
+        inputs = list(input_tensors)
+        outputs: List[Tensor] = []
+
+        def val(x):
+            if isinstance(x, torch.fx.Node):
+                return env[x.name]
+            if isinstance(x, (list, tuple)):
+                return type(x)(val(v) for v in x)
+            return x
+
+        for node in gm.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = inputs.pop(0)
+            elif node.op == "get_attr":
+                t = self._get_attr(gm, node.target)
+                const = ff.create_tensor(tuple(t.shape), create_grad=False,
+                                         name=node.name)
+                const.set_tensor(t.detach().cpu().numpy())
+                env[node.name] = const
+            elif node.op == "call_module":
+                m = modules[node.target]
+                env[node.name] = self._module_to_ff(
+                    ff, m, node, [val(a) for a in node.args])
+            elif node.op == "call_function":
+                env[node.name] = self._function_to_ff(
+                    ff, node.target, node, [val(a) for a in node.args],
+                    {k: val(v) for k, v in node.kwargs.items()})
+            elif node.op == "call_method":
+                env[node.name] = self._method_to_ff(
+                    ff, node.target, node, [val(a) for a in node.args],
+                    {k: val(v) for k, v in node.kwargs.items()})
+            elif node.op == "output":
+                out = val(node.args[0])
+                outputs = list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+        return outputs
+
+    @staticmethod
+    def _get_attr(gm, target: str):
+        obj = gm
+        for part in target.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    # ------------------------------------------------------------------
+    def _module_to_ff(self, ff: FFModel, m, node, args):
+        import torch.nn as nn
+        x = args[0] if args else None
+        name = node.name
+        if isinstance(m, nn.Linear):
+            out = ff.dense(x, m.out_features, use_bias=m.bias is not None,
+                           name=name)
+        elif isinstance(m, nn.Conv2d):
+            kh, kw = _pair(m.kernel_size)
+            sh, sw = _pair(m.stride)
+            ph, pw = _pair(m.padding) if not isinstance(m.padding, str) \
+                else (0, 0)
+            out = ff.conv2d(x, m.out_channels, kh, kw, sh, sw, ph, pw,
+                            groups=m.groups, use_bias=m.bias is not None,
+                            name=name)
+        elif isinstance(m, nn.MaxPool2d):
+            kh, kw = _pair(m.kernel_size)
+            sh, sw = _pair(m.stride or m.kernel_size)
+            ph, pw = _pair(m.padding)
+            out = ff.pool2d(x, kh, kw, sh, sw, ph, pw, PoolType.POOL_MAX,
+                            name=name)
+        elif isinstance(m, (nn.AvgPool2d, nn.AdaptiveAvgPool2d)):
+            if isinstance(m, nn.AdaptiveAvgPool2d):
+                oh, ow = _pair(m.output_size)
+                ih, iw = x.shape[2], x.shape[3]
+                kh, kw = ih // oh, iw // ow
+                out = ff.pool2d(x, kh, kw, kh, kw, 0, 0, PoolType.POOL_AVG,
+                                name=name)
+            else:
+                kh, kw = _pair(m.kernel_size)
+                sh, sw = _pair(m.stride or m.kernel_size)
+                ph, pw = _pair(m.padding)
+                out = ff.pool2d(x, kh, kw, sh, sw, ph, pw,
+                                PoolType.POOL_AVG, name=name)
+        elif isinstance(m, nn.BatchNorm2d):
+            out = ff.batch_norm(x, relu=False, name=name)
+        elif isinstance(m, nn.LayerNorm):
+            axes = list(range(-len(m.normalized_shape), 0))
+            out = ff.layer_norm(x, axes, m.elementwise_affine, m.eps,
+                                name=name)
+        elif isinstance(m, nn.Embedding):
+            out = ff.embedding(x, m.num_embeddings, m.embedding_dim,
+                               AggrMode.AGGR_MODE_NONE, name=name)
+        elif isinstance(m, nn.EmbeddingBag):
+            aggr = {"sum": AggrMode.AGGR_MODE_SUM,
+                    "mean": AggrMode.AGGR_MODE_AVG}[m.mode]
+            out = ff.embedding(x, m.num_embeddings, m.embedding_dim, aggr,
+                               name=name)
+        elif isinstance(m, nn.MultiheadAttention):
+            q, k, v = args[0], args[1], args[2]
+            attn = ff.multihead_attention(q, k, v, m.embed_dim, m.num_heads,
+                                          dropout=m.dropout, name=name)
+            self._layer_of_module[node.target] = ff.layers[-1].name
+            # torch MHA returns (output, weights); traced graphs getitem(0)
+            return [attn, None]
+        elif isinstance(m, nn.ReLU):
+            out = ff.relu(x, name=name)
+        elif isinstance(m, nn.GELU):
+            out = ff.gelu(x, name=name)
+        elif isinstance(m, nn.Sigmoid):
+            out = ff.sigmoid(x, name=name)
+        elif isinstance(m, nn.Tanh):
+            out = ff.tanh(x, name=name)
+        elif isinstance(m, nn.ELU):
+            out = ff.elu(x, name=name)
+        elif isinstance(m, nn.LeakyReLU):
+            out = ff._unary(OperatorType.OP_LEAKYRELU, x, name,
+                            negative_slope=m.negative_slope)
+        elif isinstance(m, nn.Softmax):
+            out = ff.softmax(x, axis=m.dim if m.dim is not None else -1,
+                             name=name)
+        elif isinstance(m, nn.Dropout):
+            out = ff.dropout(x, m.p, name=name)
+        elif isinstance(m, nn.Flatten):
+            out = ff.flat(x, name=name)
+        elif isinstance(m, nn.Identity):
+            out = ff.identity(x, name=name)
+        elif isinstance(m, nn.Sequential):
+            out = x
+            for i, sub in enumerate(m):
+                # register under the true torch path so copy_weights finds it
+                fake = type("N", (), {
+                    "name": f"{name}_{i}",
+                    "target": f"{node.target}.{i}"})
+                out = self._module_to_ff(ff, sub, fake, [out])
+            return out
+        else:
+            raise NotImplementedError(
+                f"torch module {type(m).__name__} not supported")
+        self._layer_of_module[node.target if hasattr(node, 'target') else
+                              name] = ff.layers[-1].name
+        return out
+
+    # ------------------------------------------------------------------
+    def _function_to_ff(self, ff: FFModel, fn, node, args, kwargs):
+        import torch
+        import torch.nn.functional as F
+        name = node.name
+        if fn in (operator.add, torch.add):
+            return self._bin(ff, ff.add, args, name)
+        if fn in (operator.sub, torch.sub):
+            return self._bin(ff, ff.subtract, args, name)
+        if fn in (operator.mul, torch.mul):
+            return self._bin(ff, ff.multiply, args, name)
+        if fn in (operator.truediv, torch.div):
+            return self._bin(ff, ff.divide, args, name)
+        if fn in (torch.matmul, torch.bmm):
+            return ff.batch_matmul(args[0], args[1], name=name)
+        if fn is F.relu or fn is torch.relu:
+            return ff.relu(args[0], name=name)
+        if fn is F.gelu:
+            return ff.gelu(args[0], name=name)
+        if fn is F.silu:
+            return ff.multiply(args[0], ff.sigmoid(args[0]), name=name)
+        if fn is torch.sigmoid or fn is F.sigmoid:
+            return ff.sigmoid(args[0], name=name)
+        if fn is torch.tanh or fn is F.tanh:
+            return ff.tanh(args[0], name=name)
+        if fn is F.softmax or fn is torch.softmax:
+            axis = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            return ff.softmax(args[0], axis=axis, name=name)
+        if fn is F.dropout:
+            return ff.dropout(args[0], kwargs.get("p", 0.5), name=name)
+        if fn is torch.cat:
+            axis = kwargs.get("dim", args[1] if len(args) > 1 else 0)
+            return ff.concat(args[0], axis=axis, name=name)
+        if fn is torch.flatten:
+            return ff.flat(args[0], name=name)
+        if fn is torch.transpose:
+            d0, d1 = args[1], args[2]
+            r = len(args[0].shape)
+            perm = list(range(r))
+            perm[d0 % r], perm[d1 % r] = perm[d1 % r], perm[d0 % r]
+            return ff.transpose(args[0], perm, name=name)
+        if fn is torch.permute:
+            return ff.transpose(args[0], list(args[1]), name=name)
+        if fn is torch.reshape:
+            return ff.reshape(args[0], list(args[1]), name=name)
+        if fn is torch.exp:
+            return ff.exp(args[0], name=name)
+        if fn is torch.sqrt:
+            return ff.sqrt(args[0], name=name)
+        if fn is torch.rsqrt:
+            return ff.rsqrt(args[0], name=name)
+        if fn is torch.pow or fn is operator.pow:
+            return ff.pow(args[0], args[1], name=name)
+        if fn is torch.mean:
+            dims = args[1] if len(args) > 1 else kwargs.get("dim")
+            keep = kwargs.get("keepdim", False)
+            dims = [dims] if isinstance(dims, int) else list(dims)
+            return ff.mean(args[0], dims, keep, name=name)
+        if fn is operator.getitem:
+            x, idx = args
+            if isinstance(x, (list, tuple)):
+                return x[idx]
+            return self._getitem_tensor(ff, x, idx, name)
+        if fn is getattr:
+            return getattr(args[0], args[1])
+        raise NotImplementedError(f"torch function {fn} not supported")
+
+    @staticmethod
+    def _bin(ff, builder, args, name):
+        a, b = args[0], args[1]
+        if isinstance(b, (int, float)):
+            sc = {ff.add: ff.scalar_add, ff.subtract: ff.scalar_sub,
+                  ff.multiply: ff.scalar_multiply,
+                  ff.divide: ff.scalar_true_divide}[builder]
+            return sc(a, float(b), name=name)
+        return builder(a, b, name=name)
+
+    def _getitem_tensor(self, ff, x, idx, name):
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        starts, ends, axes, squeeze_axes = [], [], [], []
+        for d, i in enumerate(idx):
+            if isinstance(i, slice):
+                if i.start is None and i.stop is None:
+                    continue
+                starts.append(i.start or 0)
+                ends.append(i.stop if i.stop is not None else x.shape[d])
+                axes.append(d)
+            elif isinstance(i, int):
+                i = i % x.shape[d]  # negative index (e.g. x[:, -1])
+                starts.append(i)
+                ends.append(i + 1)
+                axes.append(d)
+                squeeze_axes.append(d)
+            elif i is Ellipsis:
+                continue
+        out = ff.slice_tensor(x, starts, ends, axes, name=name) \
+            if starts else x
+        if squeeze_axes:
+            out = ff.squeeze(out, squeeze_axes)
+        return out
+
+    # ------------------------------------------------------------------
+    def _method_to_ff(self, ff: FFModel, method: str, node, args, kwargs):
+        name = node.name
+        x = args[0]
+        if method == "view" or method == "reshape":
+            shape = args[1:] if not isinstance(args[1], (list, tuple)) \
+                else list(args[1])
+            return ff.reshape(x, [int(s) for s in shape], name=name)
+        if method == "flatten":
+            return ff.flat(x, name=name)
+        if method == "permute":
+            perm = args[1:] if not isinstance(args[1], (list, tuple)) \
+                else list(args[1])
+            return ff.transpose(x, [int(p) for p in perm], name=name)
+        if method == "transpose":
+            r = len(x.shape)
+            d0, d1 = args[1] % r, args[2] % r
+            perm = list(range(r))
+            perm[d0], perm[d1] = perm[d1], perm[d0]
+            return ff.transpose(x, perm, name=name)
+        if method == "contiguous" or method == "clone" or method == "detach":
+            return x
+        if method == "size":
+            return x.shape if len(args) == 1 else x.shape[args[1]]
+        if method == "mean":
+            dims = args[1] if len(args) > 1 else kwargs.get("dim")
+            dims = [dims] if isinstance(dims, int) else list(dims)
+            return ff.mean(x, dims, kwargs.get("keepdim", False), name=name)
+        if method == "softmax":
+            return ff.softmax(x, kwargs.get("dim", -1), name=name)
+        if method == "relu":
+            return ff.relu(x, name=name)
+        if method == "unsqueeze":
+            return ff.unsqueeze(x, [args[1]], name=name)
+        if method == "squeeze":
+            return ff.squeeze(x, [args[1]], name=name)
+        if method == "split":
+            return ff.split(x, args[1], kwargs.get("dim", 0), name=name)
+        raise NotImplementedError(f"torch method {method} not supported")
+
+    # ------------------------------------------------------------------
+    def copy_weights(self, ff: FFModel):
+        """Copy torch parameters into the compiled FFModel (transposing
+        Linear kernels: torch stores (out, in), FF stores (in, out))."""
+        import torch.nn as nn
+        for path, mod in self.module.named_modules():
+            lname = self._layer_of_module.get(path)
+            if lname is None or lname not in ff.params:
+                continue
+            if isinstance(mod, nn.Linear):
+                ff.set_weights(lname, "kernel",
+                               mod.weight.detach().cpu().numpy().T)
+                if mod.bias is not None:
+                    ff.set_weights(lname, "bias",
+                                   mod.bias.detach().cpu().numpy())
+            elif isinstance(mod, nn.Conv2d):
+                ff.set_weights(lname, "kernel",
+                               mod.weight.detach().cpu().numpy())
+                if mod.bias is not None:
+                    ff.set_weights(lname, "bias",
+                                   mod.bias.detach().cpu().numpy())
+            elif isinstance(mod, (nn.Embedding, nn.EmbeddingBag)):
+                ff.set_weights(lname, "kernel",
+                               mod.weight.detach().cpu().numpy())
+            elif isinstance(mod, nn.LayerNorm) and mod.elementwise_affine:
+                ff.set_weights(lname, "scale",
+                               mod.weight.detach().cpu().numpy())
+                ff.set_weights(lname, "bias",
+                               mod.bias.detach().cpu().numpy())
+            elif isinstance(mod, nn.BatchNorm2d):
+                ff.set_weights(lname, "scale",
+                               mod.weight.detach().cpu().numpy())
+                ff.set_weights(lname, "bias",
+                               mod.bias.detach().cpu().numpy())
+
+
+def torch_to_flexflow_graph(module, ff: FFModel,
+                            input_tensors: Sequence[Tensor],
+                            is_hf_model: bool = False):
+    """One-call convenience (reference ``fx.torch_to_flexflow``)."""
+    m = PyTorchModel(module, is_hf_model)
+    return m, m.torch_to_ff(ff, input_tensors)

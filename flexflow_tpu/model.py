@@ -97,11 +97,15 @@ class FFModel:
                 k += 1
         layer = Layer(op_type, name, list(inputs), params)
         op = get_op_def(op_type)
-        out_specs = op.infer(layer.params, [t.shape for t in inputs],
-                             [t.dtype for t in inputs])
+        in_shapes = [t.shape for t in inputs]
+        in_dtypes = [t.dtype for t in inputs]
+        out_specs = op.infer(layer.params, in_shapes, in_dtypes)
         for i, (shape, dt) in enumerate(out_specs):
             layer.outputs.append(Tensor(shape, dt, layer, i,
                                         name=f"{layer.name}:out{i}"))
+        # resolve weight specs now so the search's cost model sees
+        # weight memory + gradient-sync volumes (executor reuses these)
+        layer.weights = op.weights(layer.params, in_shapes, in_dtypes)
         self.layers.append(layer)
         return layer
 
@@ -352,6 +356,26 @@ class FFModel:
         return self._unary(OperatorType.OP_REDUCE_SUM, x, name,
                            axes=list(axes), keepdims=keepdims)
 
+    def slice_tensor(self, x: Tensor, starts: Sequence[int],
+                     ends: Sequence[int], axes: Optional[Sequence[int]] = None,
+                     name=None):
+        return self._unary(OperatorType.OP_SLICE, x, name,
+                           starts=list(starts), ends=list(ends),
+                           axes=list(axes) if axes is not None else
+                           list(range(len(starts))))
+
+    def squeeze(self, x: Tensor, axes: Sequence[int], name=None):
+        return self._unary(OperatorType.OP_SQUEEZE, x, name, axes=list(axes))
+
+    def unsqueeze(self, x: Tensor, axes: Sequence[int], name=None):
+        return self._unary(OperatorType.OP_UNSQUEEZE, x, name,
+                           axes=list(axes))
+
+    def pad(self, x: Tensor, pads: Sequence[Tuple[int, int]],
+            value: float = 0.0, name=None):
+        return self._unary(OperatorType.OP_PAD, x, name,
+                           pads=[tuple(p) for p in pads], value=value)
+
     def gather(self, x: Tensor, index: Tensor, dim: int = 0, name=None):
         return self._add_layer(OperatorType.OP_GATHER, [x, index],
                                {"dim": dim}, name).outputs[0]
@@ -528,9 +552,11 @@ class FFModel:
         assert self.executor is not None, "call compile() first"
         epochs = epochs or self.config.epochs
         loader = self._combined_loader(x, y, batch_size)
-        step_fn = self.executor.make_train_step()
         history = []
         for epoch in range(epochs):
+            # re-fetch per epoch: callbacks (e.g. LearningRateScheduler)
+            # may invalidate the jitted step to apply new hyperparams
+            step_fn = self.executor.make_train_step()
             pm = PerfMetrics()
             t0 = time.perf_counter()
             nb = 0
@@ -552,8 +578,12 @@ class FFModel:
                 msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
                 print(f"epoch {epoch} done: {msg}")
             if callbacks:
+                stop = False
                 for cb in callbacks:
                     cb.on_epoch_end(epoch, rep, self)
+                    stop = stop or getattr(cb, "stop_requested", False)
+                if stop:
+                    break
         self._current_metrics = history[-1] if history else {}
         return history
 

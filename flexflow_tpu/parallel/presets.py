@@ -1,0 +1,157 @@
+"""Hand-built strategy presets: data/tensor/sequence/expert parallel.
+
+These are the canonical strategies the search explores combinations of —
+direct analogs of the reference's programmatic parallelization xfers
+(``substitution.cc:61-110``: partition_linear_combine, partition_attention
+etc.), expressed as PartitionSpec assignments. They also serve as golden
+strategies for numerics tests (TP output must equal DP output).
+
+Megatron-style transformer sharding:
+  - attention: shard the head axis of wq/wk/wv (column-parallel), shard wo
+    on the head axis (row-parallel) → one all-reduce per attention block;
+  - FFN: column-parallel up-projection, row-parallel down-projection;
+  - sequence parallelism (optional): activations outside the matmuls are
+    sharded along the sequence dim over the tp axes.
+Expert parallelism: each expert's weights placed on its own mesh slice via
+sharding the (stacked) expert dim — here experts are separate Linear ops,
+so EP = round-robin weight placement + sharded group_by outputs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from jax.sharding import PartitionSpec as P
+
+from ..ffconst import OperatorType
+from .machine import DeviceMesh
+from .strategy import OpSharding, ShardingStrategy
+
+Axes = Union[str, Tuple[str, ...], None]
+
+
+def _norm(axes) -> Axes:
+    if axes is None:
+        return None
+    if isinstance(axes, str):
+        return axes
+    axes = tuple(axes)
+    if len(axes) == 0:
+        return None
+    return axes[0] if len(axes) == 1 else axes
+
+
+def _size(dmesh: DeviceMesh, axes: Axes) -> int:
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    s = 1
+    for a in axes:
+        s *= dmesh.axis_sizes[a]
+    return s
+
+
+def transformer_strategy(layers, input_tensors, dmesh: DeviceMesh,
+                         dp_axes, tp_axes, sp: bool = False
+                         ) -> ShardingStrategy:
+    """Megatron-style dp×tp (+optional sequence-parallel) strategy for
+    transformer-shaped graphs built from MHA + Linear + norms."""
+    dp, tp = _norm(dp_axes), _norm(tp_axes)
+    tp_size = _size(dmesh, tp)
+    st = ShardingStrategy(dmesh)
+    for t in input_tensors:
+        if t.shape and t.shape[0] % _size(dmesh, dp) == 0:
+            st.inputs[t.name] = P(dp)
+
+    prev_linear_col = False  # was the previous Linear column-parallel?
+    for layer in layers:
+        ot = layer.op_type
+        rank = len(layer.outputs[0].shape) if layer.outputs else 0
+        act_tail = [None] * max(rank - 1, 0)
+        act_spec = P(dp, *act_tail) if rank >= 1 else P()
+        seq_ok = (sp and rank >= 3 and layer.outputs
+                  and layer.outputs[0].shape[1] % tp_size == 0)
+        seq_spec = P(dp, tp, *act_tail[1:]) if seq_ok else act_spec
+        if ot == OperatorType.OP_MULTIHEAD_ATTENTION:
+            heads = layer.params["num_heads"]
+            if heads % tp_size == 0:
+                w = {"wq": P(None, tp, None), "wk": P(None, tp, None),
+                     "wv": P(None, tp, None), "wo": P(tp, None, None),
+                     "bq": P(tp, None), "bk": P(tp, None), "bv": P(tp, None),
+                     "bo": P()}
+            else:
+                w = {}
+            st.set_op(layer.name, [act_spec], w)
+            prev_linear_col = False
+        elif ot == OperatorType.OP_LINEAR:
+            out_dim = layer.params["out_dim"]
+            in_dim = layer.inputs[0].shape[-1]
+            col = (out_dim % tp_size == 0 and not prev_linear_col)
+            if col:
+                w = {"kernel": P(None, tp), "bias": P(tp)}
+                spec = P(dp, *act_tail[:-1], tp) if rank >= 2 else act_spec
+                st.set_op(layer.name, [spec], w)
+                prev_linear_col = True
+            else:
+                w = ({"kernel": P(tp, None), "bias": P()}
+                     if in_dim % tp_size == 0 else {})
+                st.set_op(layer.name, [act_spec], w)
+                prev_linear_col = False
+        elif ot == OperatorType.OP_EMBEDDING:
+            # column-shard the table's feature dim over tp
+            w = ({"kernel": P(None, tp)}
+                 if layer.params["out_dim"] % tp_size == 0 else {})
+            st.set_op(layer.name, [act_spec], w)
+            prev_linear_col = False
+        elif ot in (OperatorType.OP_LAYERNORM, OperatorType.OP_RMSNORM,
+                    OperatorType.OP_DROPOUT, OperatorType.OP_EW_ADD):
+            st.set_op(layer.name, [seq_spec], {})
+            prev_linear_col = False
+        else:
+            st.set_op(layer.name,
+                      [act_spec if o.shape and
+                       o.shape[0] % _size(dmesh, dp) == 0 else None
+                       for o in layer.outputs], {})
+            prev_linear_col = False
+    return st
+
+
+def expert_parallel_strategy(layers, input_tensors, dmesh: DeviceMesh,
+                             dp_axes, ep_axes) -> ShardingStrategy:
+    """DP + expert parallelism for MoE graphs built by ``FFModel.moe``:
+    expert Linears' weights are sharded over the ep axes on the output dim
+    (each device holds 1/ep of every expert — "expert-slicing"), and
+    group_by outputs stay replicated across dp so each expert shard sees
+    all its tokens. A placement-style EP (expert e on device e) needs
+    per-op device subsets, which arrive with the pipeline executor."""
+    dp, ep = _norm(dp_axes), _norm(ep_axes)
+    ep_size = _size(dmesh, ep)
+    st = ShardingStrategy(dmesh)
+    for t in input_tensors:
+        if t.shape and t.shape[0] % _size(dmesh, dp) == 0:
+            st.inputs[t.name] = P(dp)
+    for layer in layers:
+        rank = len(layer.outputs[0].shape) if layer.outputs else 0
+        tail = [None] * max(rank - 1, 0)
+        act_spec = P(dp, *tail) if rank >= 1 else P()
+        if layer.op_type == OperatorType.OP_GROUP_BY:
+            # expert buffers: replicated (each is (C, D), consumed by its
+            # expert's dense)
+            st.set_op(layer.name, [None] * len(layer.outputs), {})
+        elif (layer.op_type == OperatorType.OP_LINEAR
+              and layer.inputs[0].owner_layer is not None
+              and layer.inputs[0].owner_layer.op_type
+              == OperatorType.OP_GROUP_BY):
+            out_dim = layer.params["out_dim"]
+            w = {"kernel": P(None, ep), "bias": P(ep)} \
+                if out_dim % ep_size == 0 else {}
+            st.set_op(layer.name, [None], w)
+        elif layer.op_type in (OperatorType.OP_AGGREGATE,
+                               OperatorType.OP_AGG_SPEC):
+            st.set_op(layer.name, [act_spec], {})
+        else:
+            st.set_op(layer.name,
+                      [act_spec if o.shape and
+                       o.shape[0] % _size(dmesh, dp) == 0 else None
+                       for o in layer.outputs], {})
+    return st

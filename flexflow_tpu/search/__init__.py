@@ -1,0 +1,5 @@
+from .costmodel import CostMetrics, OpCostModel  # noqa: F401
+from .mcmc import (StrategySimulator, assignment_to_strategy,  # noqa: F401
+                   data_parallel_assignment, mcmc_search)
+from .optimizer import optimize_strategy  # noqa: F401
+from .serialization import load_strategy, save_strategy  # noqa: F401

@@ -1,0 +1,189 @@
+"""MCMC strategy search: simulated annealing over per-op sharding
+assignments.
+
+Analog of the reference's legacy search (``FFModel::mcmc_optimize``,
+``src/runtime/model.cc:3286-3357``): start from the canonical data-parallel
+assignment, randomly rewrite one op's parallel config, score with the
+simulator, accept with probability exp(-alpha * delta). The Unity
+substitution-DP search (search/unity.py) supersedes this but the MCMC
+remains the cheap robust fallback, exactly as in the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.layer import Layer
+from ..dtypes import itemsize
+from ..ffconst import OperatorType
+from ..parallel.machine import DeviceMesh
+from ..parallel.strategy import ShardingStrategy
+from .costmodel import CostMetrics, OpCostModel
+from .opshard import ShardOption, assignment_to_sharding, options_for
+
+
+@dataclasses.dataclass
+class GraphCost:
+    total: float
+    compute: float
+    xfer: float
+    sync: float
+    peak_memory: int
+
+
+class StrategySimulator:
+    """Scores a full per-op assignment (reference ``simulate_runtime`` in
+    its additive DP-search approximation)."""
+
+    def __init__(self, layers: Sequence[Layer], dmesh: DeviceMesh,
+                 cost_model: OpCostModel):
+        self.layers = list(layers)
+        self.dmesh = dmesh
+        self.cost = cost_model
+        self.options: Dict[str, List[ShardOption]] = {
+            l.name: options_for(l) for l in self.layers}
+
+    def _degrees_of(self, layer: Layer,
+                    assign: Dict[str, Tuple[int, ...]]) -> Dict[int, int]:
+        degs: Dict[int, int] = {}
+        for opt, d in zip(self.options[layer.name],
+                          assign.get(layer.name, ())):
+            if d > 1 and opt.out_dim >= 0:
+                degs[opt.out_dim] = d
+        return degs
+
+    def evaluate(self, assign: Dict[str, Tuple[int, ...]]) -> GraphCost:
+        compute = xfer = sync = 0.0
+        mem = 0
+        out_degrees: Dict[int, Dict[int, int]] = {}  # tensor guid -> degrees
+        for layer in self.layers:
+            opts = self.options[layer.name]
+            degs = self._degrees_of(layer, assign)
+            wdeg = 1
+            for opt, d in zip(opts, assign.get(layer.name, ())):
+                if d > 1 and opt.weight_dims:
+                    wdeg *= d
+            cm = self.cost.op_cost(layer, degs, wdeg)
+            compute += cm.forward_time + cm.backward_time
+            mem += cm.weights_memory + cm.outputs_memory
+            # input resharding: producer layout vs this op's batch layout
+            for t in layer.inputs:
+                src = out_degrees.get(t.guid, {})
+                dst = {d: v for d, v in degs.items()
+                       if d < len(t.shape) and t.shape[d] % v == 0} \
+                    if t.shape else {}
+                tb = int(np.prod(t.shape)) * itemsize(t.dtype) \
+                    if t.shape else 0
+                xfer += self.cost.resharding_cost(tb, src, dst)
+                # backward: cotangent moves the other way
+                xfer += self.cost.resharding_cost(tb, dst, src)
+            for o in layer.outputs:
+                out_degrees[o.guid] = degs
+            # gradient sync: weights replicated across the dp degree
+            dp_deg = self.dmesh.num_devices
+            for opt, d in zip(opts, assign.get(layer.name, ())):
+                if opt.weight_dims and d > 1:
+                    dp_deg //= d
+            if layer.weights:
+                wbytes = sum(int(np.prod(w.shape)) * itemsize(w.dtype)
+                             for w in layer.weights) // max(wdeg, 1)
+                sync += self.cost.weight_sync_cost(wbytes, dp_deg)
+        total = compute + xfer + sync
+        # memory feasibility: ~4x weights (param + grad + 2 Adam moments)
+        if mem * 4 > self.cost.spec.hbm_bytes:
+            total *= 100.0  # infeasible penalty (memory-aware search refines)
+        return GraphCost(total, compute, xfer, sync, mem)
+
+
+def data_parallel_assignment(layers: Sequence[Layer], dmesh: DeviceMesh,
+                             options: Dict[str, List[ShardOption]]
+                             ) -> Dict[str, Tuple[int, ...]]:
+    n = dmesh.num_devices
+    assign = {}
+    for l in layers:
+        degs = []
+        for opt in options[l.name]:
+            if opt.kind == "sample" and l.outputs and l.outputs[0].shape \
+                    and l.outputs[0].shape[opt.out_dim] % n == 0:
+                degs.append(n)
+            else:
+                degs.append(1)
+        assign[l.name] = tuple(degs)
+    return assign
+
+
+def mcmc_search(layers: Sequence[Layer], dmesh: DeviceMesh,
+                cost_model: OpCostModel, budget: int = 1000,
+                alpha: float = 0.05, seed: int = 0,
+                verbose: bool = False):
+    """Returns (best_assignment, best_cost, simulator)."""
+    rng = random.Random(seed)
+    sim = StrategySimulator(layers, dmesh, cost_model)
+    valid_degrees = dmesh.valid_degrees()
+    current = data_parallel_assignment(layers, dmesh, sim.options)
+    cur_cost = sim.evaluate(current).total
+    best, best_cost = dict(current), cur_cost
+    shardable = [l for l in layers if sim.options[l.name]]
+    if not shardable or budget <= 0:
+        return best, best_cost, sim
+    for it in range(budget):
+        layer = rng.choice(shardable)
+        opts = sim.options[layer.name]
+        oi = rng.randrange(len(opts))
+        old = current[layer.name]
+        # propose a new degree for this option; keep product ≤ num devices
+        choices = [d for d in valid_degrees
+                   if d * math.prod(old[:oi] + old[oi + 1:])
+                   <= dmesh.num_devices]
+        if not choices:
+            continue
+        new_deg = rng.choice(choices)
+        cand = old[:oi] + (new_deg,) + old[oi + 1:]
+        # realizability check (divisibility + axis allocation)
+        if assignment_to_sharding(layer, opts, cand, dmesh) is None:
+            continue
+        current[layer.name] = cand
+        new_cost = sim.evaluate(current).total
+        delta = new_cost - cur_cost
+        if delta < 0 or rng.random() < math.exp(-delta / max(
+                alpha * cur_cost, 1e-12)):
+            cur_cost = new_cost
+            if new_cost < best_cost:
+                best, best_cost = dict(current), new_cost
+                if verbose:
+                    print(f"  mcmc iter {it}: best {best_cost * 1e3:.3f} ms")
+        else:
+            current[layer.name] = old
+    return best, best_cost, sim
+
+
+def assignment_to_strategy(layers: Sequence[Layer], input_tensors,
+                           assign: Dict[str, Tuple[int, ...]],
+                           dmesh: DeviceMesh,
+                           sim: StrategySimulator) -> ShardingStrategy:
+    """Materialize an assignment as a ShardingStrategy (the searched
+    artifact — reference (PCG, MachineView map) analog)."""
+    from jax.sharding import PartitionSpec as P
+    st = ShardingStrategy(dmesh)
+    batch_sharding_axes = None
+    for layer in layers:
+        opts = sim.options[layer.name]
+        degs = assign.get(layer.name, ())
+        res = assignment_to_sharding(layer, opts, degs, dmesh)
+        if res is None:
+            continue
+        out_specs, wspecs = res
+        st.set_op(layer.name, out_specs, wspecs)
+        if batch_sharding_axes is None and out_specs and out_specs[0]:
+            first = out_specs[0][0] if len(out_specs[0]) > 0 else None
+            if first is not None:
+                batch_sharding_axes = first
+    for t in input_tensors:
+        if batch_sharding_axes is not None and t.shape and \
+                t.shape[0] % dmesh.num_devices == 0:
+            st.inputs[t.name] = P(batch_sharding_axes)
+    return st

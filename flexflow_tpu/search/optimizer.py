@@ -1,0 +1,56 @@
+"""Strategy optimization entry point: dispatches to the configured search.
+
+Analog of the reference's ``Graph::graph_optimize_task``
+(``src/runtime/graph.cc:2046``): builds the machine model + cost model,
+runs the search (Unity DP when available, MCMC otherwise — mirroring the
+reference's new/legacy pair), and returns the best strategy. Honors
+``--budget``, ``--only-data-parallel``, ``--import``/``--export``.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from ..parallel.machine import DeviceMesh, MachineSpec
+from ..parallel.strategy import ShardingStrategy
+from .costmodel import OpCostModel
+from .mcmc import (StrategySimulator, assignment_to_strategy,
+                   data_parallel_assignment, mcmc_search)
+from .serialization import load_strategy, save_strategy
+
+
+def optimize_strategy(ff) -> ShardingStrategy:
+    """ff: FFModel (post graph construction, pre executor build)."""
+    cfg = ff.config
+    dmesh = ff.dmesh
+    if cfg.import_strategy_file:
+        return load_strategy(cfg.import_strategy_file, ff.layers, dmesh)
+    spec = dmesh.spec
+    cost_model = OpCostModel(spec)
+    import jax
+    if jax.devices()[0].platform != "cpu":
+        # refine MXU efficiency with a real on-chip microbenchmark
+        # (analog of inner_measure_operator_cost; skipped on CPU sim
+        # where analytic constants already match cpu-sim MachineSpec)
+        cost_model.calibrate()
+    budget = cfg.search_budget if cfg.search_budget > 0 else 500
+    t0 = time.perf_counter()
+    best, best_cost, sim = mcmc_search(
+        ff.layers, dmesh, cost_model, budget=budget,
+        alpha=max(cfg.search_alpha - 1.0, 0.01), seed=cfg.seed,
+        verbose=cfg.profiling)
+    dp = data_parallel_assignment(ff.layers, dmesh, sim.options)
+    dp_cost = sim.evaluate(dp).total
+    strategy = assignment_to_strategy(ff.layers, ff.graph_inputs, best,
+                                      dmesh, sim)
+    if cfg.profiling:
+        print(f"search: {time.perf_counter() - t0:.2f}s, "
+              f"best {best_cost * 1e3:.3f} ms vs DP {dp_cost * 1e3:.3f} ms "
+              f"({dp_cost / max(best_cost, 1e-12):.2f}x)")
+    errs = strategy.validate()
+    assert not errs, errs
+    if cfg.export_strategy_file:
+        save_strategy(cfg.export_strategy_file, strategy, best,
+                      {"best_cost": best_cost, "dp_cost": dp_cost})
+    return strategy

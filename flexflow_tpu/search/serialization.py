@@ -1,0 +1,64 @@
+"""Strategy import/export (reference ``--export``/``--import``,
+``src/runtime/strategy.cc``): JSON with per-layer output/weight
+PartitionSpecs and the mesh axis sizes."""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.machine import DeviceMesh
+from ..parallel.strategy import OpSharding, ShardingStrategy
+
+
+def _spec_to_json(spec: Optional[P]):
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def _spec_from_json(j) -> Optional[P]:
+    if j is None:
+        return None
+    return P(*[tuple(e) if isinstance(e, list) else e for e in j])
+
+
+def save_strategy(path: str, strategy: ShardingStrategy,
+                  assignment: Optional[Dict] = None,
+                  meta: Optional[Dict] = None):
+    doc = {
+        "mesh_axes": dict(strategy.dmesh.axis_sizes),
+        "inputs": {k: _spec_to_json(v) for k, v in strategy.inputs.items()},
+        "ops": {
+            name: {
+                "outputs": [_spec_to_json(s) for s in os.outputs],
+                "weights": {w: _spec_to_json(s)
+                            for w, s in os.weights.items()},
+            } for name, os in strategy.ops.items()},
+        "assignment": {k: list(v) for k, v in (assignment or {}).items()},
+        "meta": meta or {},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
+    with open(path) as f:
+        doc = json.load(f)
+    saved_axes = doc.get("mesh_axes", {})
+    if dict(dmesh.axis_sizes) != saved_axes:
+        raise ValueError(
+            f"strategy was searched for mesh {saved_axes}, current mesh is "
+            f"{dict(dmesh.axis_sizes)}")
+    st = ShardingStrategy(dmesh)
+    for k, v in doc.get("inputs", {}).items():
+        sp = _spec_from_json(v)
+        if sp is not None:
+            st.inputs[k] = sp
+    for name, os in doc.get("ops", {}).items():
+        st.ops[name] = OpSharding(
+            [_spec_from_json(s) for s in os.get("outputs", [])],
+            {w: _spec_from_json(s) for w, s in os.get("weights", {}).items()
+             if s is not None})
+    return st

@@ -1,0 +1,225 @@
+"""Frontend tests: torch.fx import (numeric alignment with torch),
+Keras-style API end-to-end. (ONNX handlers are exercised only when the
+onnx package is present.)"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+
+def _cfg(bs=8):
+    c = FFConfig()
+    c.batch_size = bs
+    c.only_data_parallel = True
+    return c
+
+
+class SmallNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        x = torch.relu(self.fc1(x))
+        return torch.softmax(self.fc2(x), dim=-1)
+
+
+class SmallCNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(3, 8, 3, padding=1)
+        self.pool = nn.MaxPool2d(2)
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(8 * 8 * 8, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.conv(x))
+        x = self.pool(x)
+        return self.fc(self.flat(x))
+
+
+def test_torch_fx_mlp_alignment():
+    """Imported torch model + copied weights == torch forward (alignment
+    test, reference tests/align analog)."""
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+    net = SmallNet()
+    ff = FFModel(_cfg(8))
+    x = ff.create_tensor((8, 16), name="x")
+    m = PyTorchModel(net)
+    outs = m.torch_to_ff(ff, [x])
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=outs[0])
+    m.copy_weights(ff)
+    xs = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.from_numpy(xs)).numpy()
+    fwd = ff.executor.make_forward()
+    got = np.asarray(fwd(ff.params, ff.state, {"x": xs}))
+    np.testing.assert_allclose(ref, got, rtol=2e-2, atol=2e-3)
+
+
+def test_torch_fx_cnn_alignment():
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+    net = SmallCNN()
+    ff = FFModel(_cfg(4))
+    x = ff.create_tensor((4, 3, 16, 16), name="x")
+    m = PyTorchModel(net)
+    outs = m.torch_to_ff(ff, [x])
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=outs[0])
+    m.copy_weights(ff)
+    xs = np.random.default_rng(0).normal(size=(4, 3, 16, 16))\
+        .astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.from_numpy(xs)).numpy()
+    fwd = ff.executor.make_forward()
+    got = np.asarray(fwd(ff.params, ff.state, {"x": xs}))
+    np.testing.assert_allclose(ref, got, rtol=5e-2, atol=5e-3)
+
+
+def test_keras_sequential_trains():
+    from flexflow_tpu.frontends import keras
+    from flexflow_tpu.frontends.keras.callbacks import VerifyMetrics
+    model = keras.Sequential([
+        keras.Input((20,), name="x"),
+        keras.Dense(64, activation="relu"),
+        keras.Dense(4),
+        keras.Softmax(),
+    ])
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    model.compile("sgd", "sparse_categorical_crossentropy", ["accuracy"],
+                  config=cfg, batch_size=64)
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 20)) * 3
+    ys = rng.integers(0, 4, 512).astype(np.int32)
+    xs = (centers[ys] + rng.normal(size=(512, 20))).astype(np.float32)
+    model.ffmodel.optimizer.lr = 0.1
+    model.fit(xs, ys, epochs=4, verbose=False,
+              callbacks=[VerifyMetrics("accuracy", 0.8)])
+    rep = model.evaluate(xs, ys)
+    assert rep["accuracy"] > 0.8
+
+
+def test_keras_functional_multi_input():
+    from flexflow_tpu.frontends import keras
+    a = keras.Input((8,), name="a")
+    b = keras.Input((8,), name="b")
+    da = keras.Dense(16, activation="relu")(a.tensor)
+    db = keras.Dense(16, activation="relu")(b.tensor)
+    merged = keras.Concatenate()([da, db])
+    out = keras.Softmax()(keras.Dense(2)(merged))
+    model = keras.Model(inputs=[a, b], outputs=out)
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    model.compile("adam", "sparse_categorical_crossentropy", ["accuracy"],
+                  config=cfg, batch_size=32)
+    rng = np.random.default_rng(0)
+    xa = rng.normal(size=(128, 8)).astype(np.float32)
+    xb = rng.normal(size=(128, 8)).astype(np.float32)
+    ys = (xa.sum(-1) > xb.sum(-1)).astype(np.int32)
+    hist = model.fit([xa, xb], ys, epochs=3, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+class MHANet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.attn = nn.MultiheadAttention(16, 4, batch_first=True)
+        self.fc = nn.Linear(16, 2)
+
+    def forward(self, x):
+        a, _ = self.attn(x, x, x)
+        return self.fc(a[:, -1])
+
+
+def test_torch_fx_mha_and_negative_index():
+    """nn.MultiheadAttention tuple output + x[:, -1] lowering."""
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+    ff = FFModel(_cfg(4))
+    x = ff.create_tensor((4, 6, 16), name="x")
+    m = PyTorchModel(MHANet())
+    outs = m.torch_to_ff(ff, [x])
+    assert outs[0].shape == (4, 2), outs[0].shape
+
+
+class SeqNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.seq = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                 nn.Linear(16, 2))
+
+    def forward(self, x):
+        return self.seq(x)
+
+
+def test_torch_fx_sequential_weight_copy():
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+    net = SeqNet()
+    ff = FFModel(_cfg(4))
+    x = ff.create_tensor((4, 8), name="x")
+    m = PyTorchModel(net)
+    outs = m.torch_to_ff(ff, [x])
+    ff.compile(SGDOptimizer(0.01), "identity", [], output_tensor=outs[0])
+    m.copy_weights(ff)
+    xs = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.from_numpy(xs)).numpy()
+    got = np.asarray(ff.executor.make_forward()(
+        ff.params, ff.state, {"x": xs}))
+    np.testing.assert_allclose(ref, got, rtol=2e-2, atol=2e-3)
+
+
+def test_early_stopping_halts_fit():
+    from flexflow_tpu.frontends import keras
+    from flexflow_tpu.frontends.keras.callbacks import EarlyStopping
+    model = keras.Sequential([
+        keras.Input((8,), name="x"),
+        keras.Dense(4),
+        keras.Softmax(),
+    ])
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    model.compile("sgd", "sparse_categorical_crossentropy", [],
+                  config=cfg, batch_size=16)
+    model.ffmodel.optimizer.lr = 0.0  # loss plateaus immediately
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 8)).astype(np.float32)
+    ys = rng.integers(0, 4, 64).astype(np.int32)
+    hist = model.fit(xs, ys, epochs=10, verbose=False,
+                     callbacks=[EarlyStopping(patience=2)])
+    assert len(hist) < 10, len(hist)
+
+
+def test_lr_scheduler_takes_effect():
+    from flexflow_tpu.frontends import keras
+    from flexflow_tpu.frontends.keras.callbacks import LearningRateScheduler
+    model = keras.Sequential([
+        keras.Input((8,), name="x"),
+        keras.Dense(4),
+        keras.Softmax(),
+    ])
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    model.compile("sgd", "sparse_categorical_crossentropy", [],
+                  config=cfg, batch_size=16)
+    model.ffmodel.optimizer.lr = 0.5
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 8)).astype(np.float32)
+    ys = rng.integers(0, 4, 64).astype(np.int32)
+    w0 = model.ffmodel.get_weights(model.ffmodel.layers[0].name).copy()
+    # lr -> 0 after first epoch: weights must stop changing
+    model.fit(xs, ys, epochs=1, verbose=False)
+    w1 = model.ffmodel.get_weights(model.ffmodel.layers[0].name).copy()
+    assert not np.allclose(w0, w1)
+    model.fit(xs, ys, epochs=2, verbose=False,
+              callbacks=[LearningRateScheduler(lambda e: 0.0)])
+    # epoch 1 ran at 0.5 (schedule applies at epoch end), epoch 2 at 0.0
+    w2 = model.ffmodel.get_weights(model.ffmodel.layers[0].name).copy()
+    model.fit(xs, ys, epochs=1, verbose=False)  # lr now 0 via scheduler
+    w3 = model.ffmodel.get_weights(model.ffmodel.layers[0].name).copy()
+    assert np.allclose(w2, w3)

@@ -42,6 +42,7 @@ class FFConfig:
     search_num_workers: int = -1
     base_optimize_threshold: int = 10
     enable_memory_search: bool = False
+    search_algo: str = "unity"    # "unity" (substitution DP) | "mcmc" | "dp"
     substitution_json_path: Optional[str] = None
     # -------- simulator --------
     simulator_workspace_mb: int = 2048
@@ -143,6 +144,8 @@ class FFConfig:
                 cfg.base_optimize_threshold = int(take())
             elif a == "--memory-search":
                 cfg.enable_memory_search = True
+            elif a == "--search-algo":
+                cfg.search_algo = take()
             elif a == "--substitution-json":
                 cfg.substitution_json_path = take()
             elif a == "--simulator-workspace-size":

@@ -17,6 +17,15 @@ class ActiMode(enum.IntEnum):
     AC_MODE_TANH = 13
     AC_MODE_GELU = 14
 
+    @classmethod
+    def _missing_(cls, value):
+        if isinstance(value, str):
+            try:
+                return cls[f"AC_MODE_{value.upper()}"]
+            except KeyError:
+                pass
+        return None
+
 
 class RegularizerMode(enum.IntEnum):
     REG_MODE_NONE = 17

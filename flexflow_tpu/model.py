@@ -441,7 +441,8 @@ class FFModel:
                 comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
                 machine_spec: Optional[MachineSpec] = None,
                 strategy: Optional[ShardingStrategy] = None,
-                output_tensor: Optional[Tensor] = None):
+                output_tensor: Optional[Tensor] = None,
+                search_budget: Optional[int] = None):
         """Lower graph → (strategy, jitted step). Reference call stack:
         ``FFModel::compile`` → graph_optimize → convert_graph_to_operators
         → NCCL setup (``model.cc:2803-3168``)."""
@@ -472,16 +473,25 @@ class FFModel:
 
         spec = machine_spec or MachineSpec.detect()
         self.dmesh = DeviceMesh(spec, mesh_shape=self.config.mesh_shape)
+        if search_budget is not None:
+            self.config.search_budget = search_budget
 
+        exec_layers, exec_outputs = self.layers, [self._output_tensor]
         if strategy is not None:
             self.strategy = strategy
         else:
-            self.strategy = self._optimize_strategy()
+            self.strategy, program_info = self._optimize_strategy()
+            if program_info is not None:
+                # search rewrote the graph (inserted parallel ops) —
+                # reference convert_graph_to_operators (model.cc:2834)
+                exec_layers = program_info.layers
+                exec_outputs = program_info.output_tensors
+                self._output_tensor = exec_outputs[0]
 
         # label tensor adopts the final op's batch sharding
         # (reference model.cc:3086-3124)
-        program = GraphProgram(self.layers, self.graph_inputs,
-                               [self._output_tensor])
+        program = GraphProgram(exec_layers, self.graph_inputs,
+                               exec_outputs)
         self.executor = Executor(program, self.config, self.dmesh,
                                  self.strategy, self.optimizer,
                                  self.loss_type, self.metrics,
@@ -490,16 +500,18 @@ class FFModel:
         self.opt_state = self.optimizer.init_state(self.params)
         self._step = 0
 
-    def _optimize_strategy(self) -> ShardingStrategy:
+    def _optimize_strategy(self):
         """Strategy selection: search unless --only-data-parallel.
-        (Search lives in flexflow_tpu.search; canonical DP here.)"""
-        if self.config.only_data_parallel or self.dmesh.num_devices == 1:
+        Returns (strategy, program_info_or_None) — Unity search may rewrite
+        the executable graph."""
+        if self.config.only_data_parallel or self.dmesh.num_devices == 1 \
+                or self.config.search_algo == "dp":
             return ShardingStrategy.data_parallel(
-                self.layers, self.graph_inputs, self.dmesh)
+                self.layers, self.graph_inputs, self.dmesh), None
         import importlib.util
         if importlib.util.find_spec("flexflow_tpu.search") is None:
             return ShardingStrategy.data_parallel(
-                self.layers, self.graph_inputs, self.dmesh)
+                self.layers, self.graph_inputs, self.dmesh), None
         from .search.optimizer import optimize_strategy
         return optimize_strategy(self)
 

@@ -20,12 +20,18 @@ from .mcmc import (StrategySimulator, assignment_to_strategy,
 from .serialization import load_strategy, save_strategy
 
 
-def optimize_strategy(ff) -> ShardingStrategy:
-    """ff: FFModel (post graph construction, pre executor build)."""
+def optimize_strategy(ff):
+    """ff: FFModel (post graph construction, pre executor build).
+
+    Returns ``(strategy, program_info_or_None)``: Unity search may rewrite
+    the graph (inserting parallel ops), in which case ``program_info``
+    carries the new executable layer list — the analog of the reference's
+    ``convert_graph_to_operators`` output replacing the original operators.
+    """
     cfg = ff.config
     dmesh = ff.dmesh
     if cfg.import_strategy_file:
-        return load_strategy(cfg.import_strategy_file, ff.layers, dmesh)
+        return _import_strategy(ff, cfg.import_strategy_file, dmesh)
     spec = dmesh.spec
     cost_model = OpCostModel(spec)
     import jax
@@ -34,8 +40,10 @@ def optimize_strategy(ff) -> ShardingStrategy:
         # (analog of inner_measure_operator_cost; skipped on CPU sim
         # where analytic constants already match cpu-sim MachineSpec)
         cost_model.calibrate()
-    budget = cfg.search_budget if cfg.search_budget > 0 else 500
     t0 = time.perf_counter()
+    if cfg.search_algo == "unity":
+        return _unity(ff, cost_model, t0)
+    budget = cfg.search_budget if cfg.search_budget > 0 else 500
     best, best_cost, sim = mcmc_search(
         ff.layers, dmesh, cost_model, budget=budget,
         alpha=max(cfg.search_alpha - 1.0, 0.01), seed=cfg.seed,
@@ -53,4 +61,53 @@ def optimize_strategy(ff) -> ShardingStrategy:
     if cfg.export_strategy_file:
         save_strategy(cfg.export_strategy_file, strategy, best,
                       {"best_cost": best_cost, "dp_cost": dp_cost})
-    return strategy
+    return strategy, None
+
+
+def _unity(ff, cost_model: OpCostModel, t0: float):
+    """Unity substitution-DP search path (default)."""
+    from .unity import unity_search
+    cfg = ff.config
+    dmesh = ff.dmesh
+    budget = cfg.search_budget if cfg.search_budget > 0 else 32
+    mem_budget = None
+    if cfg.enable_memory_search:
+        mem_budget = (cfg.device_mem_mb * (1 << 20)
+                      if cfg.device_mem_mb > 0 else dmesh.spec.hbm_bytes)
+    info, strategy, gc, graph = unity_search(
+        ff.layers, ff.graph_inputs, [ff._output_tensor], dmesh, cost_model,
+        budget=budget, alpha=max(cfg.search_alpha, 1.0 + 1e-6),
+        mem_budget_bytes=mem_budget,
+        base_optimize_threshold=max(cfg.base_optimize_threshold, 2))
+    if cfg.profiling:
+        print(f"unity search: {time.perf_counter() - t0:.2f}s, "
+              f"cost {gc.total * 1e3:.3f} ms "
+              f"(compute {gc.compute * 1e3:.3f} xfer {gc.xfer * 1e3:.3f} "
+              f"sync {gc.sync * 1e3:.3f})")
+    if cfg.export_strategy_task_graph_file:
+        with open(cfg.export_strategy_task_graph_file, "w") as f:
+            f.write(graph.to_dot())
+    if cfg.export_strategy_file:
+        from .serialization import program_to_json
+        prog_doc = program_to_json(info.layers, ff.graph_inputs,
+                                   info.output_tensors[0])
+        save_strategy(cfg.export_strategy_file, strategy, None,
+                      {"best_cost": gc.total}, program=prog_doc)
+    return strategy, info
+
+
+def _import_strategy(ff, path: str, dmesh):
+    """--import: load a saved strategy; when it carries a serialized
+    rewritten program (Unity export), rebuild that program too so parallel
+    ops and layer names line up with the saved shardings."""
+    import json as _json
+    from ..pcg.graph import GraphProgramInfo
+    from .serialization import program_from_json
+    strategy = load_strategy(path, ff.layers, dmesh)
+    with open(path) as f:
+        doc = _json.load(f)
+    prog_doc = doc.get("program")
+    if not prog_doc:
+        return strategy, None
+    layers, out_t = program_from_json(prog_doc, ff.graph_inputs)
+    return strategy, GraphProgramInfo(layers, {}, [out_t])

@@ -1,13 +1,21 @@
 """Strategy import/export (reference ``--export``/``--import``,
 ``src/runtime/strategy.cc``): JSON with per-layer output/weight
-PartitionSpecs and the mesh axis sizes."""
+PartitionSpecs and the mesh axis sizes. Also serializes the searched
+*program* (the rewritten PCG as an executable layer list) so that an
+exported Unity strategy — whose graph contains inserted parallel ops —
+round-trips through ``--import`` (the analog of the reference's
+``GraphOptimalViewSerialized``, ``graph.cc:2162``)."""
 from __future__ import annotations
 
+import enum
 import json
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from jax.sharding import PartitionSpec as P
 
+from .. import ffconst
+from ..core.layer import Layer
+from ..core.tensor import Tensor
 from ..parallel.machine import DeviceMesh
 from ..parallel.strategy import OpSharding, ShardingStrategy
 
@@ -26,8 +34,10 @@ def _spec_from_json(j) -> Optional[P]:
 
 def save_strategy(path: str, strategy: ShardingStrategy,
                   assignment: Optional[Dict] = None,
-                  meta: Optional[Dict] = None):
+                  meta: Optional[Dict] = None,
+                  program: Optional[Dict] = None):
     doc = {
+        "program": program,
         "mesh_axes": dict(strategy.dmesh.axis_sizes),
         "inputs": {k: _spec_to_json(v) for k, v in strategy.inputs.items()},
         "ops": {
@@ -41,6 +51,97 @@ def save_strategy(path: str, strategy: ShardingStrategy,
     }
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
+
+
+# ---------------------------------------------------------------------------
+# Program (rewritten-graph) serialization
+# ---------------------------------------------------------------------------
+def _param_to_json(v: Any) -> Any:
+    if isinstance(v, enum.Enum):
+        return {"_enum": type(v).__name__, "v": int(v)}
+    if isinstance(v, (tuple, list)):
+        return {"_seq": [_param_to_json(x) for x in v]}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return {"_repr": repr(v)}   # non-serializable (e.g. initializer objects)
+
+
+def _param_from_json(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "_enum" in v:
+            return getattr(ffconst, v["_enum"])(v["v"])
+        if "_seq" in v:
+            return tuple(_param_from_json(x) for x in v["_seq"])
+        if "_repr" in v:
+            return None
+    return v
+
+
+def program_to_json(layers: List[Layer], graph_inputs: List[Tensor],
+                    output_tensor: Tensor) -> Dict:
+    """Serialize an executable layer list: each layer's op type, params,
+    and input references (graph input name or (producer layer, out idx))."""
+    producer: Dict[int, Tuple[str, int]] = {}
+    input_names = {t.guid: t.name for t in graph_inputs}
+    ser = []
+    for layer in layers:
+        ins = []
+        for t in layer.inputs:
+            if t.guid in producer:
+                ins.append({"op": producer[t.guid][0],
+                            "idx": producer[t.guid][1]})
+            elif t.guid in input_names:
+                ins.append({"input": input_names[t.guid]})
+            else:
+                ins.append({"input": t.name})
+        ser.append({
+            "name": layer.name,
+            "op_type": layer.op_type.name,
+            "params": {k: _param_to_json(v) for k, v in layer.params.items()},
+            "inputs": ins,
+            "trainable": layer.trainable,
+        })
+        for i, o in enumerate(layer.outputs):
+            producer[o.guid] = (layer.name, i)
+    out_ref = producer.get(output_tensor.guid)
+    return {"layers": ser, "output": {"op": out_ref[0], "idx": out_ref[1]}
+            if out_ref else None}
+
+
+def program_from_json(doc: Dict, graph_inputs: List[Tensor]):
+    """Rebuild (layers, output_tensor) from ``program_to_json`` output.
+    Output shapes/dtypes are re-inferred through the op registry."""
+    from ..ops import get_op_def
+    by_input_name = {t.name: t for t in graph_inputs}
+    by_layer: Dict[str, Layer] = {}
+    layers: List[Layer] = []
+    for ls in doc["layers"]:
+        ins: List[Tensor] = []
+        for ref in ls["inputs"]:
+            if "input" in ref:
+                t = by_input_name.get(ref["input"])
+                if t is None:
+                    raise ValueError(
+                        f"program references unknown input {ref['input']}")
+                ins.append(t)
+            else:
+                ins.append(by_layer[ref["op"]].outputs[ref["idx"]])
+        params = {k: _param_from_json(v) for k, v in ls["params"].items()}
+        op_type = ffconst.OperatorType[ls["op_type"]]
+        layer = Layer(op_type, None, ins, params)
+        layer.name = ls["name"]
+        layer.trainable = ls.get("trainable", True)
+        op = get_op_def(op_type)
+        for (shape, dtype) in op.infer(params, [t.shape for t in ins],
+                                       [t.dtype for t in ins]):
+            layer.outputs.append(Tensor(shape, dtype, owner_layer=layer,
+                                        owner_idx=len(layer.outputs)))
+        by_layer[layer.name] = layer
+        layers.append(layer)
+    out_ref = doc.get("output")
+    out_t = by_layer[out_ref["op"]].outputs[out_ref["idx"]] if out_ref \
+        else layers[-1].outputs[0]
+    return layers, out_t
 
 
 def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:

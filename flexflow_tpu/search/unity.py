@@ -1,0 +1,596 @@
+"""Unity auto-parallelization search: best-first substitution search with
+alpha pruning, recursive sequence-split DP with memoization, and
+memory-aware multi-objective search.
+
+Reference analogs:
+  - ``base_optimize`` ≙ ``GraphSearchHelper::base_optimize``
+    (``substitution.cc:2229``): cost-ordered priority queue of candidate
+    graphs, pop best, apply every xfer, keep candidates within
+    ``alpha``× best, stop at ``budget`` expansions.
+  - ``sequence_optimize`` ≙ ``generic_sequence_optimize``
+    (``substitution.cc:2572``): split at a bottleneck (post-dominator of
+    all sources), DP over the cut tensor's layout (the analog of the
+    (source view, sink view) machine-view pairs), memoized by
+    ``dp_state_hash`` (``graph.cc:1863``).
+  - ``graph_optimize_with_memory`` ≙ ``substitution.cc:1960`` +
+    ``try_one_lambda`` (``graph.cc:1883``): binary search on lambda
+    weighting per-device memory against the HBM budget.
+
+The evaluator's execution model is TPU-SPMD: every op runs on the whole
+mesh (sharded by its annotation), so graph run time is additive over nodes
+(unlike the reference's per-view concurrent placement — that role is played
+by pipeline parallelism, handled separately).
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.layer import Layer
+from ..core.tensor import Tensor
+from ..dtypes import itemsize
+from ..ffconst import OperatorType, PARALLEL_OPS
+from ..parallel.machine import DeviceMesh
+from ..parallel.strategy import ShardingStrategy
+from ..pcg.graph import Graph, GraphProgramInfo, ParAnn, PNode
+from .costmodel import OpCostModel
+from .mcmc import GraphCost
+from .substitution import GraphXfer, generate_all_pcg_xfers
+
+Layout = Tuple[Tuple[int, int], ...]       # sorted ((dim, degree), ...)
+
+
+def _layout(d: Dict[int, int]) -> Layout:
+    return tuple(sorted((k, v) for k, v in d.items() if v > 1))
+
+
+def _bytes_of(t: Tensor) -> int:
+    return int(np.prod(t.shape)) * itemsize(t.dtype) if t.shape else 0
+
+
+# ---------------------------------------------------------------------------
+# Graph cost evaluation
+# ---------------------------------------------------------------------------
+def propagate_layouts(graph: Graph,
+                      in_pins: Optional[Dict[int, Layout]] = None
+                      ) -> Dict[Tuple[int, int], Layout]:
+    """(node guid, out_idx) -> layout. Parallel ops transform their
+    input layout; compute ops emit their annotation's layout."""
+    lay: Dict[Tuple[int, int], Layout] = {}
+    in_pins = in_pins or {}
+    for n in graph.topo_order():
+        t = n.op_type
+        in_lay: Layout = ()
+        e = graph.producer(n, 0)
+        if e is not None:
+            in_lay = lay[(e.src.guid, e.src_idx)]
+        else:
+            for s, tens in graph.external_inputs.get(n.guid, ()):
+                if s == 0 and tens.guid in in_pins:
+                    in_lay = in_pins[tens.guid]
+        if t == OperatorType.OP_REPARTITION:
+            d = dict(in_lay)
+            dim = n.layer.params["dim"]
+            d[dim] = d.get(dim, 1) * n.layer.params["degree"]
+            out = _layout(d)
+        elif t == OperatorType.OP_COMBINE:
+            d = dict(in_lay)
+            d.pop(n.layer.params["dim"], None)
+            out = _layout(d)
+        elif t in (OperatorType.OP_REPLICATE, OperatorType.OP_REDUCTION,
+                   OperatorType.OP_NOOP, OperatorType.OP_PIPELINE,
+                   OperatorType.OP_FUSED_PARALLEL, OperatorType.OP_INPUT):
+            out = in_lay
+        else:
+            out = _layout(n.ann.out_degrees(0))
+        for i in range(max(len(n.layer.outputs), 1)):
+            lay[(n.guid, i)] = out if i == 0 else _layout(
+                n.ann.out_degrees(i))
+    return lay
+
+
+class GraphCostEvaluator:
+    """Scores a PCG: additive node costs + reified communication costs +
+    gradient-sync costs + per-device peak memory."""
+
+    def __init__(self, cost_model: OpCostModel, dmesh: DeviceMesh,
+                 mem_lambda: float = 0.0):
+        self.cost = cost_model
+        self.dmesh = dmesh
+        self.mem_lambda = mem_lambda  # $/byte weighting for memory-aware DP
+        self._cache: Dict[Tuple, GraphCost] = {}
+
+    # -- expected input layout of a compute node ----------------------------
+    def _expected_input(self, node: PNode, in_idx: int,
+                        in_shape: Tuple[int, ...]) -> Layout:
+        ann = node.ann
+        if ann.is_trivial():
+            return ()
+        if ann.replicate is not None:
+            return ()
+        if ann.reduce is not None and in_idx == 0 and in_shape:
+            return _layout({len(in_shape) - 1: ann.degree_of(ann.reduce)})
+        degs = {d: v for d, v in ann.out_degrees(0).items()
+                if in_shape and d < len(in_shape)
+                and in_shape[d] % v == 0}
+        # parameter-dim placements don't constrain the input
+        out_shape = node.layer.outputs[0].shape
+        if in_shape and out_shape and in_shape[-1] != out_shape[-1] \
+                and len(in_shape) - 1 in degs:
+            degs.pop(len(in_shape) - 1, None)
+        return _layout(degs)
+
+    # -- cost ---------------------------------------------------------------
+    def graph_cost(self, graph: Graph,
+                   in_pins: Optional[Dict[int, Layout]] = None,
+                   out_pin: Optional[Layout] = None) -> GraphCost:
+        key = (graph.hash(),
+               tuple(sorted((in_pins or {}).items())),
+               out_pin, self.mem_lambda)
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        lay = propagate_layouts(graph, in_pins)
+        compute = xfer = sync = 0.0
+        mem = 0
+        n_dev = self.dmesh.num_devices
+        for n in graph.topo_order():
+            t = n.op_type
+            in_bytes = 0
+            in_lay: Layout = ()
+            e0 = graph.producer(n, 0)
+            if e0 is not None:
+                src_t = e0.src.layer.outputs[e0.src_idx]
+                in_bytes = _bytes_of(src_t)
+                in_lay = lay[(e0.src.guid, e0.src_idx)]
+            elif n.layer.inputs:
+                in_bytes = _bytes_of(n.layer.inputs[0])
+                for s, tens in graph.external_inputs.get(n.guid, ()):
+                    if s == 0 and in_pins and tens.guid in in_pins:
+                        in_lay = in_pins[tens.guid]
+            if t in (OperatorType.OP_INPUT, OperatorType.OP_NOOP,
+                     OperatorType.OP_WEIGHT):
+                continue
+            if t == OperatorType.OP_REPARTITION:
+                dim = n.layer.params["dim"]
+                deg = n.layer.params["degree"]
+                dst = dict(in_lay)
+                dst[dim] = dst.get(dim, 1) * deg
+                xfer += self.cost.resharding_cost(in_bytes, dict(in_lay),
+                                                  dst)
+                # backward: cotangent moves the other way
+                xfer += self.cost.resharding_cost(in_bytes, dst,
+                                                  dict(in_lay))
+                continue
+            if t == OperatorType.OP_COMBINE:
+                deg = n.layer.params["degree"]
+                xfer += self.cost.xfer_cost(in_bytes, "all_gather", deg)
+                xfer += self.cost.xfer_cost(in_bytes, "all_to_all", deg)
+                continue
+            if t == OperatorType.OP_REPLICATE:
+                deg = n.layer.params["degree"]
+                # fwd free under SPMD when input already replicated;
+                # bwd: all-reduce of input cotangent across the group
+                xfer += self.cost.xfer_cost(in_bytes, "all_reduce", deg)
+                continue
+            if t == OperatorType.OP_REDUCTION:
+                deg = n.layer.params["degree"]
+                xfer += self.cost.xfer_cost(in_bytes, "all_reduce", deg)
+                continue
+            if t in (OperatorType.OP_PIPELINE,
+                     OperatorType.OP_FUSED_PARALLEL):
+                continue
+            # ---- compute node ----
+            ann = n.ann
+            scale_groups = {g for (_, _, g) in ann.out}
+            if ann.reduce:
+                scale_groups.add(ann.reduce)
+            scale = 1
+            for g in scale_groups:
+                scale *= ann.degree_of(g)
+            degs = {0: scale} if scale > 1 else {}
+            cm = self.cost.op_cost(n.layer, degs, ann.weight_degree())
+            compute += cm.forward_time + cm.backward_time
+            mem += cm.weights_memory * 4 + cm.outputs_memory
+            # input mismatch safety net
+            for e in graph.in_edges[n]:
+                src_lay = lay[(e.src.guid, e.src_idx)]
+                src_t = e.src.layer.outputs[e.src_idx]
+                want = self._expected_input(n, e.dst_idx, src_t.shape)
+                if src_lay != want:
+                    xfer += self.cost.resharding_cost(
+                        _bytes_of(src_t), dict(src_lay), dict(want))
+            # gradient sync for weights: all-reduce over the mesh part not
+            # sharding the weight
+            wdeg = ann.weight_degree()
+            wbytes = sum(_bytes_of_spec(w) for w in n.layer.weights)
+            if wbytes:
+                dp_deg = max(1, n_dev // max(wdeg, 1))
+                sync += self.cost.weight_sync_cost(wbytes // max(wdeg, 1),
+                                                   dp_deg)
+        # output pin: resharding from final layout to the pinned layout
+        if out_pin is not None and graph.outputs:
+            n0, i0 = graph.outputs[0]
+            fin = lay.get((n0.guid, i0), ())
+            if fin != out_pin:
+                xfer += self.cost.resharding_cost(
+                    _bytes_of(n0.layer.outputs[i0]), dict(fin),
+                    dict(out_pin))
+        total = compute + xfer + sync + self.mem_lambda * mem
+        gc = GraphCost(total, compute, xfer, sync, mem)
+        self._cache[key] = gc
+        return gc
+
+
+def _bytes_of_spec(w) -> int:
+    return int(np.prod(w.shape)) * itemsize(w.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Best-first substitution search (base_optimize)
+# ---------------------------------------------------------------------------
+def base_optimize(graph: Graph, xfers: Sequence[GraphXfer],
+                  evaluator: GraphCostEvaluator, budget: int = 32,
+                  alpha: float = 1.05, max_num_ops: int = 512,
+                  in_pins: Optional[Dict[int, Layout]] = None,
+                  out_pin: Optional[Layout] = None
+                  ) -> Tuple[Graph, float]:
+    """Cost-ordered best-first search over rewrites
+    (reference ``base_optimize``, ``substitution.cc:2229``)."""
+    counter = itertools.count()
+    start_cost = evaluator.graph_cost(graph, in_pins, out_pin).total
+    best, best_cost = graph, start_cost
+    heap: List[Tuple[float, int, Graph]] = [(start_cost, next(counter),
+                                            graph)]
+    seen = {graph.hash()}
+    expansions = 0
+    while heap and expansions < budget:
+        cost, _, g = heapq.heappop(heap)
+        if cost > alpha * best_cost:
+            continue  # alpha-pruned
+        expansions += 1
+        for xfer in xfers:
+            for g2 in xfer.run(g, max_num_ops):
+                h = g2.hash()
+                if h in seen:
+                    continue
+                seen.add(h)
+                c2 = evaluator.graph_cost(g2, in_pins, out_pin).total
+                if c2 < best_cost:
+                    best, best_cost = g2, c2
+                if c2 <= alpha * best_cost:
+                    heapq.heappush(heap, (c2, next(counter), g2))
+    return best, best_cost
+
+
+# ---------------------------------------------------------------------------
+# Unity sequence-split DP
+# ---------------------------------------------------------------------------
+class UnitySearch:
+    def __init__(self, evaluator: GraphCostEvaluator,
+                 xfers: Sequence[GraphXfer], budget: int = 32,
+                 alpha: float = 1.05, base_optimize_threshold: int = 12,
+                 max_num_ops: int = 512):
+        self.ev = evaluator
+        self.xfers = list(xfers)
+        self.budget = budget
+        self.alpha = alpha
+        self.threshold = base_optimize_threshold
+        self.max_num_ops = max_num_ops
+        self._memo: Dict[Tuple, Tuple[Graph, float]] = {}
+
+    def _cut_layout_candidates(self, t: Tensor) -> List[Layout]:
+        """Candidate layouts of the cut tensor — the analog of enumerating
+        the bottleneck node's machine views."""
+        cands: List[Layout] = [()]
+        if not t.shape:
+            return cands
+        for d in self.ev.dmesh.valid_degrees():
+            if d <= 1:
+                continue
+            if t.shape[0] % d == 0:
+                cands.append(_layout({0: d}))
+            if len(t.shape) > 1 and t.shape[-1] % d == 0:
+                cands.append(_layout({len(t.shape) - 1: d}))
+        return list(dict.fromkeys(cands))
+
+    def optimize(self, graph: Graph,
+                 in_pins: Optional[Dict[int, Layout]] = None,
+                 out_pin: Optional[Layout] = None, depth: int = 0
+                 ) -> Tuple[Graph, float]:
+        """``generic_sequence_optimize``: recursively split at a bottleneck
+        with DP over cut layouts; base case: best-first rewrite search."""
+        in_pins = in_pins or {}
+        key = (graph.hash(), tuple(sorted(in_pins.items())), out_pin)
+        hit = self._memo.get(key)
+        if hit is not None:
+            return hit
+        order = graph.topo_order()
+        interior = [n for n in graph.bottlenecks()
+                    if graph.in_edges[n] and graph.out_edges[n]
+                    and n.op_type not in PARALLEL_OPS
+                    and n is not order[-1]]
+        if graph.num_nodes() <= self.threshold or not interior or depth > 6:
+            res = base_optimize(graph, self.xfers, self.ev, self.budget,
+                                self.alpha, self.max_num_ops, in_pins,
+                                out_pin)
+            self._memo[key] = res
+            return res
+        # split at the middle bottleneck (reference splits at each
+        # bottleneck recursively; the midpoint halves the DP depth)
+        b = interior[len(interior) // 2]
+        pre, post = graph.split_at(b)
+        # crossing tensors, positionally aligned with pre.outputs —
+        # substitutions may replace the producing node (fresh output
+        # Tensors), but graph.outputs positions are rewired in place,
+        # so index k of the optimized pre's outputs still corresponds
+        # to original cut tensor k
+        cut_tensors = [n.layer.outputs[i] for n, i in pre.outputs]
+        cut_t = b.layer.outputs[0]
+        best_pair: Optional[Tuple[Graph, Graph]] = None
+        best_cost = float("inf")
+        for L in self._cut_layout_candidates(cut_t):
+            g1, c1 = self.optimize(pre, in_pins, L, depth + 1)
+            if c1 >= best_cost:
+                continue
+            pins2 = dict(in_pins)
+            pins2[cut_t.guid] = L
+            g2, c2 = self.optimize(post, pins2, out_pin, depth + 1)
+            if c1 + c2 < best_cost:
+                best_cost = c1 + c2
+                best_pair = (g1, g2)
+        assert best_pair is not None
+        merged = _merge_split(best_pair[0], best_pair[1], graph,
+                              [t.guid for t in cut_tensors])
+        res = (merged, best_cost)
+        self._memo[key] = res
+        return res
+
+
+def _merge_split(pre: Graph, post: Graph, original: Graph,
+                 cut_guids: Sequence[int]) -> Graph:
+    """Stitch optimized halves back into one graph: reconnect post's
+    external inputs that are pre's outputs. ``cut_guids[k]`` is the
+    ORIGINAL tensor guid of pre's k-th output — after substitutions the
+    producing node (and its output Tensor) may be new, so the mapping is
+    positional, not by the optimized node's tensor guid."""
+    g = Graph()
+    for part in (pre, post):
+        for n in part.in_edges:
+            g.add_node(n)
+        for edges in part.in_edges.values():
+            for e in edges:
+                g.add_edge(e.src, e.dst, e.src_idx, e.dst_idx)
+    # pre's declared outputs by ORIGINAL crossing-tensor guid (positional)
+    assert len(cut_guids) == len(pre.outputs), \
+        f"cut arity changed: {len(cut_guids)} vs {len(pre.outputs)}"
+    pre_out: Dict[int, Tuple[PNode, int]] = {}
+    for guid, (n, i) in zip(cut_guids, pre.outputs):
+        pre_out[guid] = (n, i)
+        pre_out.setdefault(n.layer.outputs[i].guid, (n, i))
+    for n in post.in_edges:
+        ext = post.external_inputs.get(n.guid, ())
+        keep = []
+        for slot, t in ext:
+            if t.guid in pre_out:
+                src, si = pre_out[t.guid]
+                g.add_edge(src, n, si, slot)
+            else:
+                keep.append((slot, t))
+        if keep:
+            g.external_inputs[n.guid] = keep
+    for n in pre.in_edges:
+        if n.guid in pre.external_inputs:
+            g.external_inputs[n.guid] = list(pre.external_inputs[n.guid])
+    g.input_tensors = list(original.input_tensors)
+    g.outputs = list(post.outputs)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# Memory-aware search (lambda binary search)
+# ---------------------------------------------------------------------------
+def graph_optimize_with_memory(graph: Graph, xfers: Sequence[GraphXfer],
+                               cost_model: OpCostModel, dmesh: DeviceMesh,
+                               mem_budget_bytes: float, budget: int = 32,
+                               alpha: float = 1.05, iters: int = 6,
+                               base_optimize_threshold: int = 12
+                               ) -> Tuple[Graph, GraphCost]:
+    """Binary search on the memory weight lambda until the best strategy
+    fits per-device HBM (reference ``graph_optimize_with_memory`` +
+    ``try_one_lambda``, ``substitution.cc:1960``, ``graph.cc:1883``)."""
+    def run(lam: float) -> Tuple[Graph, GraphCost]:
+        ev = GraphCostEvaluator(cost_model, dmesh, mem_lambda=lam)
+        search = UnitySearch(ev, xfers, budget=budget, alpha=alpha,
+                             base_optimize_threshold=base_optimize_threshold)
+        g, _ = search.optimize(graph)
+        pure = GraphCostEvaluator(cost_model, dmesh)
+        return g, pure.graph_cost(g)
+
+    g0, c0 = run(0.0)
+    per_dev = c0.peak_memory / max(dmesh.num_devices, 1)
+    if per_dev <= mem_budget_bytes:
+        return g0, c0
+    lo, hi = 0.0, 1e-6
+    best_feasible: Optional[Tuple[Graph, GraphCost]] = None
+    for _ in range(iters):
+        g, c = run(hi)
+        if c.peak_memory / max(dmesh.num_devices, 1) <= mem_budget_bytes:
+            best_feasible = (g, c)
+            break
+        hi *= 10
+    for _ in range(iters):
+        mid = (lo + hi) / 2
+        g, c = run(mid)
+        if c.peak_memory / max(dmesh.num_devices, 1) <= mem_budget_bytes:
+            best_feasible = (g, c)
+            hi = mid
+        else:
+            lo = mid
+    return best_feasible if best_feasible is not None else (g0, c0)
+
+
+# ---------------------------------------------------------------------------
+# Strategy extraction: optimized PCG -> executable program + shardings
+# ---------------------------------------------------------------------------
+def _allocate_group_axes(graph: Graph, dmesh: DeviceMesh
+                         ) -> Dict[str, Tuple[str, ...]]:
+    """Assign disjoint-where-needed atomic mesh axes to each annotation
+    group, consistently across the whole graph (the analog of the
+    reference's per-op MachineView assignment)."""
+    co: Dict[str, set] = {}
+    degrees: Dict[str, int] = {}
+    for n in graph.in_edges:
+        gs = [g for g, _ in n.ann.groups]
+        for g, d in n.ann.groups:
+            degrees[g] = d
+            co.setdefault(g, set()).update(x for x in gs if x != g)
+    assign: Dict[str, Tuple[str, ...]] = {}
+    for g in sorted(degrees, key=lambda g: (-degrees[g], g)):
+        used: List[str] = []
+        for other in co.get(g, ()):
+            used.extend(assign.get(other, ()))
+        axes = dmesh.allocate_axes(degrees[g], used)
+        if axes is None:
+            axes = dmesh.allocate_axes(degrees[g], [])
+        assign[g] = axes or ()
+    return assign
+
+
+def extract_strategy(graph: Graph, info: GraphProgramInfo,
+                     dmesh: DeviceMesh) -> ShardingStrategy:
+    """Convert the optimized PCG into the executable ShardingStrategy."""
+    from jax.sharding import PartitionSpec as P
+
+    st = ShardingStrategy(dmesh)
+    axes_of = _allocate_group_axes(graph, dmesh)
+    lay = propagate_layouts(graph)
+
+    # group axes by (dim -> axes) for a node's layout: we need group names,
+    # so rebuild specs from annotations for compute nodes and from layouts
+    # (with deterministic axis choice) for parallel ops.
+    def spec_from_groups(placements: Dict[int, Tuple[str, ...]], rank: int
+                         ) -> Optional[P]:
+        if not placements:
+            return None
+        entries = []
+        for d in range(rank):
+            ax = placements.get(d)
+            if not ax:
+                entries.append(None)
+            else:
+                entries.append(ax[0] if len(ax) == 1 else tuple(ax))
+        return P(*entries)
+
+    def axes_for_layout(layout: Layout) -> Dict[int, Tuple[str, ...]]:
+        used: List[str] = []
+        placements: Dict[int, Tuple[str, ...]] = {}
+        for dim, deg in layout:
+            ax = dmesh.allocate_axes(deg, used)
+            if ax is None:
+                continue
+            used.extend(ax)
+            placements[dim] = ax
+        return placements
+
+    for n in graph.topo_order():
+        exec_layer = info.node_to_layer.get(n.guid)
+        if exec_layer is None or n.op_type == OperatorType.OP_INPUT:
+            continue
+        rank = len(exec_layer.outputs[0].shape) if exec_layer.outputs else 0
+        ann = n.ann
+        if not ann.is_trivial() and n.op_type not in PARALLEL_OPS:
+            placements: Dict[int, Tuple[str, ...]] = {}
+            valid = True
+            for oi, dim, g in ann.out:
+                if oi != 0:
+                    continue
+                ax = axes_of.get(g, ())
+                if not ax:
+                    valid = False
+                    continue
+                placements[dim] = placements.get(dim, ()) + ax
+            out_spec = spec_from_groups(placements, rank) if valid else None
+            wspecs: Dict[str, P] = {}
+            wplace: Dict[str, Dict[int, Tuple[str, ...]]] = {}
+            for wname, wdim, g in ann.weights:
+                ax = axes_of.get(g, ())
+                if ax:
+                    wplace.setdefault(wname, {})[wdim] = ax
+            for wname, pl in wplace.items():
+                wrank = max(pl.keys()) + 1
+                for w in exec_layer.weights:
+                    if w.name == wname:
+                        wrank = len(w.shape)
+                        break
+                sp = spec_from_groups(pl, wrank)
+                if sp is not None:
+                    wspecs[wname] = sp
+            outs = [out_spec] + [None] * (len(exec_layer.outputs) - 1)
+            st.set_op(exec_layer.name, outs, wspecs)
+        else:
+            # parallel ops / unannotated ops: constrain to the propagated
+            # layout so XLA materializes the intended collective
+            layout = lay.get((n.guid, 0), ())
+            pl = axes_for_layout(layout)
+            sp = spec_from_groups(pl, rank)
+            outs = [sp] + [None] * (max(len(exec_layer.outputs), 1) - 1)
+            st.set_op(exec_layer.name, outs, {})
+
+    # inputs: batch-shard when the first consumer's layout says so
+    first_layouts: Dict[int, Layout] = {}
+    for n in graph.topo_order():
+        for s, t in graph.external_inputs.get(n.guid, ()):
+            if t.guid not in first_layouts:
+                lay_n = lay.get((n.guid, 0), ())
+                first_layouts[t.guid] = lay_n
+    for t in graph.input_tensors:
+        L = first_layouts.get(t.guid, ())
+        d0 = dict(L).get(0)
+        if d0 and t.shape and t.shape[0] % d0 == 0:
+            ax = dmesh.allocate_axes(d0, [])
+            if ax:
+                st.inputs[t.name] = P(ax[0] if len(ax) == 1 else tuple(ax))
+    errs = st.validate()
+    if errs:
+        for name in {e.split(":")[0] for e in errs}:
+            st.ops.pop(name, None)
+    return st
+
+
+# ---------------------------------------------------------------------------
+# Top-level entry
+# ---------------------------------------------------------------------------
+def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
+                 output_tensors: Sequence[Tensor], dmesh: DeviceMesh,
+                 cost_model: OpCostModel, budget: int = 32,
+                 alpha: float = 1.05,
+                 mem_budget_bytes: Optional[float] = None,
+                 base_optimize_threshold: int = 12,
+                 xfers: Optional[Sequence[GraphXfer]] = None
+                 ) -> Tuple[GraphProgramInfo, ShardingStrategy, GraphCost,
+                            Graph]:
+    """Full Unity pipeline: Layer graph -> PCG -> substitution/DP search ->
+    executable program + ShardingStrategy (reference
+    ``Graph::graph_optimize_task``, ``graph.cc:2046``)."""
+    graph = Graph.from_layers(layers, input_tensors, output_tensors)
+    degrees = [d for d in dmesh.valid_degrees() if d > 1]
+    if xfers is None:
+        xfers = generate_all_pcg_xfers(degrees)
+    if mem_budget_bytes is not None:
+        g, gc = graph_optimize_with_memory(
+            graph, xfers, cost_model, dmesh, mem_budget_bytes, budget,
+            alpha, base_optimize_threshold=base_optimize_threshold)
+    else:
+        ev = GraphCostEvaluator(cost_model, dmesh)
+        search = UnitySearch(ev, xfers, budget=budget, alpha=alpha,
+                             base_optimize_threshold=base_optimize_threshold)
+        g, _ = search.optimize(graph)
+        gc = ev.graph_cost(g)
+    info = g.to_program()
+    strategy = extract_strategy(g, info, dmesh)
+    return info, strategy, gc, g

@@ -64,6 +64,9 @@ class FFConfig:
     # -------- TPU-native --------
     mesh_shape: Optional[Sequence[int]] = None     # explicit ICI mesh, else auto
     use_bf16_compute: bool = True                  # matmuls in bf16, fp32 accum
+    # "auto": Pallas flash attention when compiled on TPU; "true": always
+    # (interpret mode off-TPU — slow, test-only); "false": plain XLA attention
+    use_flash_attention: str = "auto"
     seed: int = 0
 
     def __post_init__(self):

@@ -1,0 +1,28 @@
+"""Pallas TPU kernels — the hand-written hot-op layer.
+
+The reference backs its hot ops with cuDNN/cuBLAS kernels (e.g. attention
+via ``cudnnMultiHeadAttnForward``, ``src/ops/attention.cu:35``). Here XLA
+covers most of that ground; this package holds the kernels XLA needs help
+with:
+
+  - ``flash_attention``: fused, tiled, online-softmax attention (fwd+bwd)
+    that never materializes the (seq, seq) score matrix in HBM.
+  - ``ring_attention``: sequence/context-parallel attention over a sharded
+    sequence axis (a capability the reference LACKS — SURVEY.md §5
+    "Long-context / sequence parallelism: not present").
+  - ``ulysses_attention``: all-to-all (DeepSpeed-Ulysses style) sequence
+    parallelism: swap seq-sharding for head-sharding around local flash
+    attention.
+
+All kernels run compiled on TPU and in Pallas interpret mode on CPU, so the
+test suite exercises them without hardware.
+"""
+from .flash_attention import flash_attention, mha_reference
+from .ring_attention import ring_attention, ulysses_attention
+
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "ring_attention",
+    "ulysses_attention",
+]

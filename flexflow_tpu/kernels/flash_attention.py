@@ -1,0 +1,412 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+Replaces the reference's cuDNN multi-head attention kernels
+(``src/ops/attention.cu:35,105,128``) with a TPU-native tiled kernel:
+online-softmax accumulation in VMEM scratch so the (seq_q, seq_k) score
+matrix never hits HBM, bf16/f32 matmuls on the MXU with f32 accumulation,
+and a custom VJP whose dq and dk/dv passes are separate Pallas kernels
+(the standard split so each pass has a sequential accumulation grid).
+
+Attention-probability dropout (the reference's cuDNN attnDropout) runs
+in-kernel: each (bh, q-block, k-block) tile seeds the per-core PRNG with
+(seed, tile coords), so the backward kernels regenerate the identical keep
+mask without storing it. The PRNG primitives only exist compiled-on-TPU,
+so dropout > 0 requires TPU; interpret mode (CPU tests) covers rate == 0.
+
+Layout: (batch, heads, seq, head_dim), batch*heads collapsed into one grid
+axis. Sequence/head dims are padded to block/lane multiples; the padded-key
+mask is baked in statically (shapes are static under jit). TPU grids
+execute sequentially over the last grid axis, which is what makes the VMEM
+scratch accumulators correct; interpret mode preserves that, so the same
+kernel is unit-testable on CPU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _pad_to(x, mult, axis):
+    rem = x.shape[axis] % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, mult - rem)
+    return jnp.pad(x, pad)
+
+
+def _key_mask(iq, ik, block_q, block_k, kv_len, causal):
+    """Validity mask for one (q block, k block) tile; kv_len is static."""
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < kv_len
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = jnp.logical_and(mask, k_pos <= q_pos)
+    return mask
+
+
+def _tile_keep_mask(seed_ref, b, iq, ik, block_q, block_k, rate):
+    """Regenerable dropout keep-mask for one tile (rate is static)."""
+    pltpu.prng_seed(seed_ref[0, 0], b, iq, ik)
+    bits = pltpu.bitcast(pltpu.prng_random_bits((block_q, block_k)),
+                         jnp.uint32)
+    thresh = min(int(rate * 4294967296.0), 4294967295)
+    return bits >= jnp.uint32(thresh)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: grid (bh, nq, nk), accumulate over the nk axis in scratch
+# ---------------------------------------------------------------------------
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, sm_scale, causal,
+                kv_len, block_q, block_k, dropout_rate):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    # causal: the kv block is live iff its first key is visible to the last
+    # query of this q block
+    live = (ik * block_k <= (iq + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                      # (block_q, d)
+        k = k_ref[0]                      # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where(_key_mask(iq, ik, block_q, block_k, kv_len, causal),
+                      s, NEG_INF)
+        m_prev = m_sc[:, :1]                            # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                          # (block_q, block_k)
+        alpha = jnp.exp(m_prev - m_new)
+        # softmax denominator uses UNdropped p; dropout only scales the
+        # numerator (matches dropout-on-probs semantics)
+        l_new = l_sc[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _tile_keep_mask(seed_ref, pl.program_id(0), iq, ik,
+                                   block_q, block_k, dropout_rate)
+            p_eff = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        else:
+            p_eff = p
+        pv = jax.lax.dot_general(
+            p_eff.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + pv
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse = m_sc[:, :1] + jnp.log(l_safe)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_sc, *, sm_scale, causal, kv_len, block_q,
+                   block_k, dropout_rate):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    live = (ik * block_k <= (iq + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0][:, :1]              # (block_q, 1)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where(_key_mask(iq, ik, block_q, block_k, kv_len, causal),
+                      s, NEG_INF)
+        p = jnp.exp(s - lse)                 # (block_q, block_k)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _tile_keep_mask(seed_ref, pl.program_id(0), iq, ik,
+                                   block_q, block_k, dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta) * sm_scale
+        dq_sc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, sm_scale,
+                    causal, kv_len, block_q, block_k, dropout_rate):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    # causal: the q block is live iff its last query can see the first key
+    live = ((iq + 1) * block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where(_key_mask(iq, ik, block_q, block_k, kv_len, causal),
+                      s, NEG_INF)
+        p = jnp.exp(s - lse)                             # (bq, bk)
+        if dropout_rate > 0.0:
+            # same (seed, b, iq, ik) tuple as forward → identical mask
+            keep = _tile_keep_mask(seed_ref, pl.program_id(0), iq, ik,
+                                   block_q, block_k, dropout_rate)
+            p_eff = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        else:
+            keep = None
+            p_eff = p
+        dv_sc[:] += jax.lax.dot_general(
+            p_eff.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if keep is not None:
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta) * sm_scale                 # (bq, bk)
+        dk_sc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# flat (BH, S, D) custom-vjp core
+# ---------------------------------------------------------------------------
+_SEED_SPEC = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                          memory_space=pltpu.SMEM)
+
+
+def _q_spec(block_q, d):
+    return pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+
+
+def _k_spec(block_k, d):
+    return pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+
+
+def _row_spec(block_q):
+    return pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+
+
+def _fwd_call(q, k, v, seed, kv_len, sm_scale, causal, block_q, block_k,
+              dropout_rate, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        kv_len=kv_len, block_q=block_q, block_k=block_k,
+        dropout_rate=dropout_rate)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[_SEED_SPEC, _q_spec(block_q, d), _k_spec(block_k, d),
+                  _k_spec(block_k, d)],
+        out_specs=[_q_spec(block_q, d), _row_spec(block_q)],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed, q, k, v)
+    return o, lse[:, :, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, seed, kv_len, sm_scale, causal, block_q, block_k,
+           dropout_rate, interpret):
+    o, _ = _fwd_call(q, k, v, seed, kv_len, sm_scale, causal, block_q,
+                     block_k, dropout_rate, interpret)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, seed, kv_len, sm_scale, causal, block_q,
+                    block_k, dropout_rate, interpret):
+    o, lse = _fwd_call(q, k, v, seed, kv_len, sm_scale, causal, block_q,
+                       block_k, dropout_rate, interpret)
+    return o, (q, k, v, seed, o, lse)
+
+
+def _flash_bwd_rule(kv_len, sm_scale, causal, block_q, block_k,
+                    dropout_rate, interpret, res, do):
+    q, k, v, seed, o, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse_b = jnp.broadcast_to(lse[:, :, None], (bh, sq, 128))
+    delta_b = jnp.broadcast_to(delta[:, :, None], (bh, sq, 128))
+    row = _row_spec(block_q)
+    qs, ks = _q_spec(block_q, d), _k_spec(block_k, d)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          kv_len=kv_len, block_q=block_q, block_k=block_k,
+                          dropout_rate=dropout_rate),
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[_SEED_SPEC, qs, ks, ks, qs, row, row],
+        out_specs=qs,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(seed, q, k, v, do, lse_b, delta_b)
+
+    # dkv grid: (bh, nk, nq) — index maps swap the roles of grid axes 1/2
+    seed2 = pl.BlockSpec((1, 1), lambda b, j, i: (0, 0),
+                         memory_space=pltpu.SMEM)
+    qs2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    ks2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    row2 = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          kv_len=kv_len, block_q=block_q, block_k=block_k,
+                          dropout_rate=dropout_rate),
+        grid=(bh, sk // block_k, sq // block_q),
+        in_specs=[seed2, qs2, ks2, ks2, qs2, row2, row2],
+        out_specs=[ks2, ks2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed, q, k, v, do, lse_b, delta_b)
+    return dq, dk, dv, np.zeros(seed.shape, dtype=jax.dtypes.float0)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+def flash_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_seed=None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Tiled flash attention. q: (b, h, sq, d); k, v: (b, h, sk, d).
+
+    Pads seq dims to block multiples and head_dim to the 128 lane width
+    (padded keys masked, padded head dims sliced off), runs the Pallas
+    kernels, and is differentiable via the custom VJP. ``dropout_rate`` > 0
+    applies in-kernel dropout to the attention probabilities (TPU-compiled
+    only; requires ``dropout_seed``, an int32 scalar)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    if dropout_rate > 0.0:
+        if interpret:
+            raise NotImplementedError(
+                "in-kernel dropout requires compiled TPU execution "
+                "(pltpu PRNG has no interpret-mode lowering)")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if causal and sq != sk:
+        raise NotImplementedError("causal flash requires sq == sk")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    # clamp blocks to (hardware-aligned) sequence sizes: sublane mult of 8,
+    # lane mult of 128
+    block_q = min(block_q, -(-sq // 8) * 8)
+    block_k = min(block_k, -(-sk // 128) * 128)
+
+    qp = _pad_to(_pad_to(q, block_q, 2), 128, 3)
+    kp = _pad_to(_pad_to(k, block_k, 2), 128, 3)
+    vp = _pad_to(_pad_to(v, block_k, 2), 128, 3)
+    sq_p, d_p = qp.shape[2], qp.shape[3]
+    sk_p = kp.shape[2]
+
+    if dropout_seed is None:
+        seed = jnp.zeros((1, 1), jnp.int32)
+    else:
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)
+    o = _flash(qp.reshape(b * h, sq_p, d_p),
+               kp.reshape(b * h, sk_p, d_p),
+               vp.reshape(b * h, sk_p, d_p),
+               seed, sk, sm_scale, causal, block_q, block_k,
+               float(dropout_rate), interpret)
+    return o.reshape(b, h, sq_p, d_p)[:, :, :sq, :d]
+
+
+def mha_reference(q, k, v, *, causal: bool = False,
+                  sm_scale: Optional[float] = None):
+    """Plain-XLA attention used as the numerics golden for the kernels.
+    Same layout as :func:`flash_attention`."""
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = np.tril(np.ones((sq, sk), dtype=bool), sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v).astype(q.dtype)

@@ -404,6 +404,17 @@ class MultiHeadAttentionOp(OpDef):
                    WeightSpec("bo", (e,), dt, InitializerType.ZERO)]
         return ws
 
+    @staticmethod
+    def _flash_enabled(ctx) -> bool:
+        mode = getattr(getattr(ctx, "config", None), "use_flash_attention",
+                       "auto")
+        if mode == "false":
+            return False
+        if mode == "true":
+            return True
+        import jax as _jax
+        return _jax.default_backend() == "tpu"
+
     def emit(self, params, inputs, weights, ctx, name):
         q, k, v = inputs
         cdt = q.dtype
@@ -420,6 +431,35 @@ class MultiHeadAttentionOp(OpDef):
         qh = proj(q, weights["wq"], weights.get("bq"))
         kh = proj(k, weights["wk"], weights.get("bk"))
         vh = proj(v, weights["wv"], weights.get("bv"))
+        rate = params.get("dropout", 0.0) if ctx.training else 0.0
+
+        if self._flash_enabled(ctx):
+            # Pallas flash kernel ((b,h,s,d) layout); in-kernel prob dropout
+            # only when compiled on TPU — interpret mode falls back to XLA
+            from ..kernels import flash_attention
+            on_tpu = jax.default_backend() == "tpu"
+            if rate > 0.0 and not on_tpu:
+                pass  # fall through to the XLA path below
+            else:
+                seed = None
+                if rate > 0.0:
+                    seed = jax.random.randint(ctx.rng_for(name), (),
+                                              0, 2 ** 31 - 1, jnp.int32)
+                o = flash_attention(
+                    jnp.swapaxes(qh, 1, 2).astype(jnp.bfloat16),
+                    jnp.swapaxes(kh, 1, 2).astype(jnp.bfloat16),
+                    jnp.swapaxes(vh, 1, 2).astype(jnp.bfloat16),
+                    causal=params.get("causal", False),
+                    dropout_rate=rate, dropout_seed=seed,
+                    interpret=None if on_tpu else True)
+                ctxv = jnp.swapaxes(o, 1, 2).astype(jnp.float32)
+                out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(jnp.bfloat16),
+                                 weights["wo"].astype(jnp.bfloat16),
+                                 preferred_element_type=jnp.float32)
+                if "bo" in weights:
+                    out = out + weights["bo"].astype(jnp.float32)
+                return [out.astype(cdt)]
+
         scale = 1.0 / math.sqrt(qh.shape[-1])
         logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.bfloat16),
                             kh.astype(jnp.bfloat16),

@@ -1,0 +1,281 @@
+"""ctypes bindings for the native (C++) runtime library.
+
+The reference implements its runtime core in C++ (simulator, dataloader,
+graph machinery — SURVEY.md §2.1/§2.3); this package is the TPU rebuild's
+native layer: ``native/src/ffruntime.cc`` compiled to ``libffruntime.so``.
+
+``ensure_built()`` compiles the library on first use (g++, no external
+deps); every entry point has a pure-Python fallback so the framework works
+even without a toolchain, and the tests assert C++ == Python semantics.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SO = os.path.join(_HERE, "libffruntime.so")
+_SRC = os.path.join(_REPO, "native", "src", "ffruntime.cc")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def ensure_built(force: bool = False) -> bool:
+    """Compile libffruntime.so if missing. Returns True if available."""
+    global _build_failed
+    if os.path.exists(_SO) and not force:
+        return True
+    if _build_failed and not force:
+        return False
+    if not os.path.exists(_SRC):
+        _build_failed = True
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+             "-shared", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        _build_failed = True
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not ensure_built():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.ffsim_simulate.restype = ctypes.c_double
+        lib.ffsim_simulate.argtypes = [
+            ctypes.c_int32, i32p, f64p, ctypes.c_int64, i32p, i32p,
+            ctypes.c_int32, f64p]
+        lib.ffsim_critical_path.restype = ctypes.c_double
+        lib.ffsim_critical_path.argtypes = [
+            ctypes.c_int32, f64p, ctypes.c_int64, i32p, i32p]
+        lib.ffdl_gather.restype = None
+        lib.ffdl_gather.argtypes = [u8p, u8p, i64p, ctypes.c_int64,
+                                    ctypes.c_int64, ctypes.c_int32]
+        lib.ffgraph_closure.restype = ctypes.c_int32
+        lib.ffgraph_closure.argtypes = [ctypes.c_int32, ctypes.c_int64,
+                                        i32p, i32p, u64p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _as(arr, dtype):
+    return np.ascontiguousarray(np.asarray(arr, dtype=dtype))
+
+
+# ---------------------------------------------------------------------------
+# task-graph simulation
+# ---------------------------------------------------------------------------
+def simulate(proc: Sequence[int], duration: Sequence[float],
+             edges: Sequence[Tuple[int, int]], n_procs: int,
+             want_starts: bool = False):
+    """Event-driven task-graph simulation (reference
+    ``Simulator::simulate_runtime``). Returns makespan, or (makespan,
+    starts). Uses the C++ engine when available, else the Python fallback."""
+    lib = get_lib()
+    if lib is None:
+        return simulate_py(proc, duration, edges, n_procs, want_starts)
+    proc_a = _as(proc, np.int32)
+    dur_a = _as(duration, np.float64)
+    n = len(proc_a)
+    e = np.asarray(edges, dtype=np.int32).reshape(-1, 2)
+    esrc = _as(e[:, 0], np.int32)
+    edst = _as(e[:, 1], np.int32)
+    starts = np.zeros(n, np.float64) if want_starts else None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    ms = lib.ffsim_simulate(
+        n, proc_a.ctypes.data_as(i32p), dur_a.ctypes.data_as(f64p),
+        len(e), esrc.ctypes.data_as(i32p), edst.ctypes.data_as(i32p),
+        int(n_procs),
+        starts.ctypes.data_as(f64p) if starts is not None else None)
+    if ms < 0:
+        raise ValueError("task graph contains a cycle or bad ids")
+    return (ms, starts) if want_starts else ms
+
+
+def simulate_py(proc, duration, edges, n_procs, want_starts: bool = False):
+    """Pure-Python reference implementation (same scheduling semantics)."""
+    import heapq
+    n = len(proc)
+    succ = [[] for _ in range(n)]
+    indeg = [0] * n
+    for s, d in edges:
+        succ[s].append(d)
+        indeg[d] += 1
+    ready = [0.0] * n
+    start = [0.0] * n
+    avail = [0.0] * int(n_procs)
+    pq = [(0.0, i) for i in range(n) if indeg[i] == 0]
+    heapq.heapify(pq)
+    done = 0
+    makespan = 0.0
+    while pq:
+        rt, t = heapq.heappop(pq)
+        st = max(rt, avail[proc[t]])
+        ft = st + duration[t]
+        start[t] = st
+        avail[proc[t]] = ft
+        makespan = max(makespan, ft)
+        done += 1
+        for s in succ[t]:
+            ready[s] = max(ready[s], ft)
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                heapq.heappush(pq, (ready[s], s))
+    if done != n:
+        raise ValueError("task graph contains a cycle")
+    if want_starts:
+        return makespan, np.asarray(start)
+    return makespan
+
+
+def critical_path(duration, edges) -> float:
+    """Longest path ignoring processor contention (overlap lower bound)."""
+    lib = get_lib()
+    dur_a = _as(duration, np.float64)
+    n = len(dur_a)
+    e = np.asarray(edges, dtype=np.int32).reshape(-1, 2)
+    if lib is not None:
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        esrc = _as(e[:, 0], np.int32)
+        edst = _as(e[:, 1], np.int32)
+        cp = lib.ffsim_critical_path(
+            n, dur_a.ctypes.data_as(f64p), len(e),
+            esrc.ctypes.data_as(i32p), edst.ctypes.data_as(i32p))
+        if cp < 0:
+            raise ValueError("cycle")
+        return cp
+    # python fallback
+    succ = [[] for _ in range(n)]
+    indeg = [0] * n
+    for s, d in e:
+        succ[s].append(int(d))
+        indeg[d] += 1
+    order = [i for i in range(n) if indeg[i] == 0]
+    fin = [0.0] * n
+    best = 0.0
+    for t in order:
+        ft = fin[t] + float(dur_a[t])
+        best = max(best, ft)
+        for s in succ[t]:
+            fin[s] = max(fin[s], ft)
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                order.append(s)
+    if len(order) != n:
+        raise ValueError("cycle")
+    return best
+
+
+# ---------------------------------------------------------------------------
+# dataloader gather
+# ---------------------------------------------------------------------------
+def gather_batch(src: np.ndarray, indices: np.ndarray,
+                 out: Optional[np.ndarray] = None,
+                 n_threads: int = 4) -> np.ndarray:
+    """out[b] = src[indices[b]] — threaded C++ row gather when available
+    (reference dataloader batch-copy tasks)."""
+    src = np.ascontiguousarray(src)
+    idx = _as(indices, np.int64)
+    # normalize negative indices + bounds-check: the C++ path must match
+    # np.take semantics exactly (no silent OOB reads)
+    n_rows = src.shape[0]
+    idx = np.where(idx < 0, idx + n_rows, idx)
+    if len(idx) and (idx.min() < 0 or idx.max() >= n_rows):
+        raise IndexError("gather_batch index out of range")
+    batch = len(idx)
+    row_shape = src.shape[1:]
+    if out is None:
+        out = np.empty((batch,) + row_shape, dtype=src.dtype)
+    elif (out.shape != (batch,) + row_shape or out.dtype != src.dtype
+          or not out.flags.c_contiguous):
+        raise ValueError(
+            f"out must be C-contiguous {(batch,) + row_shape} {src.dtype}")
+    lib = get_lib()
+    if lib is None:
+        np.take(src, idx, axis=0, out=out)
+        return out
+    sample_bytes = int(np.prod(row_shape, dtype=np.int64)) * src.itemsize
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.ffdl_gather(
+        src.ctypes.data_as(u8p), out.ctypes.data_as(u8p),
+        idx.ctypes.data_as(i64p), batch, sample_bytes, int(n_threads))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reachability closure
+# ---------------------------------------------------------------------------
+def transitive_closure(n: int, edges) -> np.ndarray:
+    """Packed-bitset transitive closure: bool matrix reach[i, j]."""
+    words = (n + 63) // 64
+    e = np.asarray(edges, dtype=np.int32).reshape(-1, 2)
+    lib = get_lib()
+    if lib is not None:
+        out = np.zeros(n * words, np.uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        esrc = _as(e[:, 0], np.int32)
+        edst = _as(e[:, 1], np.int32)
+        rc = lib.ffgraph_closure(n, len(e), esrc.ctypes.data_as(i32p),
+                                 edst.ctypes.data_as(i32p),
+                                 out.ctypes.data_as(u64p))
+        if rc != 0:
+            raise ValueError("cycle")
+        bits = np.unpackbits(out.reshape(n, words).view(np.uint8),
+                             axis=1, bitorder="little")
+        return bits[:, :n].astype(bool)
+    # python fallback
+    reach = np.zeros((n, n), bool)
+    indeg = [0] * n
+    succ = [[] for _ in range(n)]
+    pred = [[] for _ in range(n)]
+    for s, d in e:
+        succ[s].append(int(d))
+        pred[d].append(int(s))
+        indeg[d] += 1
+    order = [i for i in range(n) if indeg[i] == 0]
+    for t in order:
+        for p in pred[t]:
+            reach[t] |= reach[p]
+            reach[t, p] = True
+        for s in succ[t]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                order.append(s)
+    if len(order) != n:
+        raise ValueError("cycle")
+    return reach

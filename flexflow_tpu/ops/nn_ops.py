@@ -433,9 +433,12 @@ class MultiHeadAttentionOp(OpDef):
         vh = proj(v, weights["wv"], weights.get("bv"))
         rate = params.get("dropout", 0.0) if ctx.training else 0.0
 
-        if self._flash_enabled(ctx):
+        causal = params.get("causal", False)
+        if self._flash_enabled(ctx) \
+                and not (causal and qh.shape[1] != kh.shape[1]):
             # Pallas flash kernel ((b,h,s,d) layout); in-kernel prob dropout
-            # only when compiled on TPU — interpret mode falls back to XLA
+            # only when compiled on TPU — interpret mode falls back to XLA.
+            # (causal cross-attention with sq != sk stays on the XLA path.)
             from ..kernels import flash_attention
             on_tpu = jax.default_backend() == "tpu"
             if rate > 0.0 and not on_tpu:
@@ -449,7 +452,7 @@ class MultiHeadAttentionOp(OpDef):
                     jnp.swapaxes(qh, 1, 2).astype(jnp.bfloat16),
                     jnp.swapaxes(kh, 1, 2).astype(jnp.bfloat16),
                     jnp.swapaxes(vh, 1, 2).astype(jnp.bfloat16),
-                    causal=params.get("causal", False),
+                    causal=causal,
                     dropout_rate=rate, dropout_seed=seed,
                     interpret=None if on_tpu else True)
                 ctxv = jnp.swapaxes(o, 1, 2).astype(jnp.float32)

@@ -74,11 +74,18 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
     if cfg.enable_memory_search:
         mem_budget = (cfg.device_mem_mb * (1 << 20)
                       if cfg.device_mem_mb > 0 else dmesh.spec.hbm_bytes)
+    evaluator_cls = None
+    if cfg.machine_model_version >= 1:
+        # machine model v1: native event-driven task-graph simulator
+        # (reference --machine-model-version / EnhancedMachineModel)
+        from .tasksim import TaskGraphEvaluator
+        evaluator_cls = TaskGraphEvaluator
     info, strategy, gc, graph = unity_search(
         ff.layers, ff.graph_inputs, [ff._output_tensor], dmesh, cost_model,
         budget=budget, alpha=max(cfg.search_alpha, 1.0 + 1e-6),
         mem_budget_bytes=mem_budget,
-        base_optimize_threshold=max(cfg.base_optimize_threshold, 2))
+        base_optimize_threshold=max(cfg.base_optimize_threshold, 2),
+        evaluator_cls=evaluator_cls)
     if cfg.profiling:
         print(f"unity search: {time.perf_counter() - t0:.2f}s, "
               f"cost {gc.total * 1e3:.3f} ms "

@@ -1,0 +1,236 @@
+"""Task-graph execution simulator for candidate strategies.
+
+Analog of the reference's full-graph simulation path
+(``Simulator::simulate_runtime``, ``src/runtime/simulator.cc:822-1200``,
+``TaskManager``/``SimTask``): expand a PCG + annotations into a DAG of
+per-shard forward/backward compute tasks and per-device communication tasks
+(links modeled as extra processors, exactly like the reference models
+inter-device connections as schedulable devices), then play the DAG through
+the native event-driven simulator (``native/src/ffruntime.cc``). This
+captures queueing and compute/comm overlap that the additive
+``GraphCostEvaluator`` cannot; it is selected with
+``machine_model_version >= 1`` (the reference's ``--machine-model-version``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..ffconst import OperatorType
+from ..pcg.graph import Graph, PNode
+from .. import native
+from .costmodel import OpCostModel
+from .unity import GraphCost, GraphCostEvaluator, _bytes_of, _bytes_of_spec
+
+
+def _compute_and_place_degree(ann) -> Tuple[int, int]:
+    """(compute-division degree, placement degree) for one annotation.
+
+    Compute shrinks only with output-sharding (+partial-sum) groups;
+    replicate/weight-only groups add devices without dividing work."""
+    scale_groups = {g for (_, _, g) in ann.out}
+    if ann.reduce:
+        scale_groups.add(ann.reduce)
+    scale = 1
+    for g in scale_groups:
+        scale *= ann.degree_of(g)
+    return max(1, scale), max(1, ann.total_degree())
+
+
+class TaskGraphBuilder:
+    """Expands one PCG into (proc, duration, edges) arrays.
+
+    Processors: [0, n_dev) = compute cores; [n_dev, 2*n_dev) = each
+    device's ICI injection port (communication processor)."""
+
+    def __init__(self, cost: OpCostModel, n_dev: int):
+        self.cost = cost
+        self.n_dev = n_dev
+        self.proc: List[int] = []
+        self.dur: List[float] = []
+        self.edges: List[Tuple[int, int]] = []
+
+    def add_task(self, proc: int, dur: float) -> int:
+        self.proc.append(proc)
+        self.dur.append(dur)
+        return len(self.proc) - 1
+
+    def dep(self, a: int, b: int):
+        self.edges.append((a, b))
+
+    def shard_devices(self, degree: int) -> List[int]:
+        """Block-distribute `degree` shards over the devices."""
+        degree = max(1, min(degree, self.n_dev))
+        stride = self.n_dev // degree
+        return [i * stride for i in range(degree)]
+
+    def comm_tasks(self, devices: List[int], seconds: float,
+                   after: List[int]) -> List[int]:
+        """One communication task on each participant's link processor."""
+        out = []
+        for d in devices:
+            t = self.add_task(self.n_dev + d, seconds)
+            for a in after:
+                self.dep(a, t)
+            out.append(t)
+        return out
+
+    # ------------------------------------------------------------------
+    def build(self, graph: Graph) -> Tuple[float, int]:
+        """Returns (makespan_seconds, peak_weight+act bytes per device).
+
+        Task expansion:
+          fwd shard tasks (per device of the op's group)
+          -> parallel-op comm tasks on link processors
+          -> bwd shard tasks in reverse order (dep on all fwd done)
+          -> gradient all-reduce comm + optimizer update per weighted op.
+        """
+        topo = graph.topo_order()
+        # per (node, phase): list of task ids; phase 0 fwd, 1 bwd
+        fwd_tasks: Dict[int, List[int]] = {}
+        bwd_tasks: Dict[int, List[int]] = {}
+        mem = 0
+
+        def producer_tasks(n: PNode, table) -> List[int]:
+            out = []
+            for e in graph.in_edges[n]:
+                out.extend(table.get(e.src.guid, []))
+            return out
+
+        # ---- forward ----
+        for n in topo:
+            t = n.op_type
+            preds = producer_tasks(n, fwd_tasks)
+            if t in (OperatorType.OP_INPUT, OperatorType.OP_NOOP,
+                     OperatorType.OP_WEIGHT):
+                fwd_tasks[n.guid] = preds
+                continue
+            in_bytes = 0
+            e0 = graph.producer(n, 0)
+            if e0 is not None:
+                in_bytes = _bytes_of(e0.src.layer.outputs[e0.src_idx])
+            elif n.layer.inputs:
+                in_bytes = _bytes_of(n.layer.inputs[0])
+            if t in (OperatorType.OP_REPARTITION, OperatorType.OP_COMBINE,
+                     OperatorType.OP_REPLICATE, OperatorType.OP_REDUCTION):
+                deg = n.layer.params.get("degree", 1)
+                coll = {OperatorType.OP_REPARTITION: "all_to_all",
+                        OperatorType.OP_COMBINE: "all_gather",
+                        OperatorType.OP_REPLICATE: "all_gather",
+                        OperatorType.OP_REDUCTION: "all_reduce"}[t]
+                secs = self.cost.xfer_cost(in_bytes, coll, deg)
+                devs = self.shard_devices(deg)
+                fwd_tasks[n.guid] = self.comm_tasks(devs, secs, preds)
+                continue
+            if t in (OperatorType.OP_PIPELINE,
+                     OperatorType.OP_FUSED_PARALLEL):
+                fwd_tasks[n.guid] = preds
+                continue
+            ann = n.ann
+            # compute divides only over output-sharding (+reduce) groups;
+            # replicate / weight-only groups duplicate work across devices
+            # (same rule as GraphCostEvaluator.graph_cost)
+            scale_deg, place_deg = _compute_and_place_degree(ann)
+            degs = {0: scale_deg} if scale_deg > 1 else {}
+            cm = self.cost.op_cost(n.layer, degs, ann.weight_degree())
+            mem += cm.weights_memory * 4 + cm.outputs_memory
+            ids = []
+            for d in self.shard_devices(place_deg):
+                tid = self.add_task(d, cm.forward_time)
+                for p in preds:
+                    self.dep(p, tid)
+                ids.append(tid)
+            fwd_tasks[n.guid] = ids
+
+        # ---- backward (reverse topo; bwd(n) after fwd(n) and after bwd of
+        # all consumers) ----
+        for n in reversed(topo):
+            t = n.op_type
+            succs: List[int] = []
+            for e in graph.out_edges[n]:
+                succs.extend(bwd_tasks.get(e.dst.guid, []))
+            if not succs:
+                succs = fwd_tasks.get(n.guid, [])
+            if t in (OperatorType.OP_INPUT, OperatorType.OP_NOOP,
+                     OperatorType.OP_WEIGHT, OperatorType.OP_PIPELINE,
+                     OperatorType.OP_FUSED_PARALLEL):
+                bwd_tasks[n.guid] = succs
+                continue
+            in_bytes = 0
+            e0 = graph.producer(n, 0)
+            if e0 is not None:
+                in_bytes = _bytes_of(e0.src.layer.outputs[e0.src_idx])
+            elif n.layer.inputs:
+                in_bytes = _bytes_of(n.layer.inputs[0])
+            if t in (OperatorType.OP_REPARTITION, OperatorType.OP_COMBINE,
+                     OperatorType.OP_REPLICATE, OperatorType.OP_REDUCTION):
+                deg = n.layer.params.get("degree", 1)
+                coll = {OperatorType.OP_REPARTITION: "all_to_all",
+                        OperatorType.OP_COMBINE: "all_to_all",
+                        OperatorType.OP_REPLICATE: "all_reduce",
+                        OperatorType.OP_REDUCTION: "all_gather"}[t]
+                secs = self.cost.xfer_cost(in_bytes, coll, deg)
+                devs = self.shard_devices(deg)
+                bwd_tasks[n.guid] = self.comm_tasks(devs, secs, succs)
+                continue
+            ann = n.ann
+            scale_deg, place_deg = _compute_and_place_degree(ann)
+            degs = {0: scale_deg} if scale_deg > 1 else {}
+            cm = self.cost.op_cost(n.layer, degs, ann.weight_degree())
+            ids = []
+            for d in self.shard_devices(place_deg):
+                tid = self.add_task(d, cm.backward_time)
+                for s in succs:
+                    self.dep(s, tid)
+                for f in fwd_tasks.get(n.guid, []):
+                    self.dep(f, tid)
+                ids.append(tid)
+            bwd_tasks[n.guid] = ids
+            # gradient sync + update riding the link processor, overlapping
+            # with earlier ops' backward compute (reference NCCL path)
+            wbytes = sum(_bytes_of_spec(w) for w in n.layer.weights)
+            if wbytes:
+                wdeg = max(1, ann.weight_degree())
+                dp_deg = max(1, self.n_dev // wdeg)
+                secs = self.cost.weight_sync_cost(wbytes // wdeg, dp_deg)
+                if secs > 0:
+                    self.comm_tasks(self.shard_devices(place_deg), secs, ids)
+
+        makespan = native.simulate(self.proc, self.dur, self.edges,
+                                   2 * self.n_dev)
+        return makespan, mem
+
+
+class TaskGraphEvaluator(GraphCostEvaluator):
+    """GraphCostEvaluator variant whose total is the simulated makespan.
+
+    Keeps the analytic components (xfer/sync breakdown, memory) from the
+    base class for reporting and pin penalties, but scores graphs by
+    playing the expanded task DAG through the native simulator."""
+
+    def graph_cost(self, graph: Graph,
+                   in_pins=None, out_pin=None) -> GraphCost:
+        key = ("tg", graph.hash(),
+               tuple(sorted((in_pins or {}).items())), out_pin,
+               self.mem_lambda)
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        # makespan/mem are pin-independent: simulate once per graph
+        sim_key = ("tg-sim", graph.hash())
+        sim = self._cache.get(sim_key)
+        if sim is None:
+            builder = TaskGraphBuilder(self.cost, self.dmesh.num_devices)
+            sim = builder.build(graph)
+            self._cache[sim_key] = sim
+        makespan, _ = sim
+        # isolate the pin-dependent analytic terms (boundary resharding):
+        # collectives internal to the graph are already in the makespan
+        base_pinned = super().graph_cost(graph, in_pins, out_pin)
+        base_free = super().graph_cost(graph)
+        pin_penalty = max(0.0, base_pinned.total - base_free.total)
+        total = makespan + pin_penalty \
+            + self.mem_lambda * base_pinned.peak_memory
+        gc = GraphCost(total, makespan, base_pinned.xfer, base_pinned.sync,
+                       base_pinned.peak_memory)
+        self._cache[key] = gc
+        return gc

@@ -398,17 +398,21 @@ def graph_optimize_with_memory(graph: Graph, xfers: Sequence[GraphXfer],
                                cost_model: OpCostModel, dmesh: DeviceMesh,
                                mem_budget_bytes: float, budget: int = 32,
                                alpha: float = 1.05, iters: int = 6,
-                               base_optimize_threshold: int = 12
+                               base_optimize_threshold: int = 12,
+                               evaluator_cls=None
                                ) -> Tuple[Graph, GraphCost]:
     """Binary search on the memory weight lambda until the best strategy
     fits per-device HBM (reference ``graph_optimize_with_memory`` +
     ``try_one_lambda``, ``substitution.cc:1960``, ``graph.cc:1883``)."""
+    if evaluator_cls is None:
+        evaluator_cls = GraphCostEvaluator
+
     def run(lam: float) -> Tuple[Graph, GraphCost]:
-        ev = GraphCostEvaluator(cost_model, dmesh, mem_lambda=lam)
+        ev = evaluator_cls(cost_model, dmesh, mem_lambda=lam)
         search = UnitySearch(ev, xfers, budget=budget, alpha=alpha,
                              base_optimize_threshold=base_optimize_threshold)
         g, _ = search.optimize(graph)
-        pure = GraphCostEvaluator(cost_model, dmesh)
+        pure = evaluator_cls(cost_model, dmesh)
         return g, pure.graph_cost(g)
 
     g0, c0 = run(0.0)
@@ -571,22 +575,30 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
                  alpha: float = 1.05,
                  mem_budget_bytes: Optional[float] = None,
                  base_optimize_threshold: int = 12,
-                 xfers: Optional[Sequence[GraphXfer]] = None
+                 xfers: Optional[Sequence[GraphXfer]] = None,
+                 evaluator_cls=None
                  ) -> Tuple[GraphProgramInfo, ShardingStrategy, GraphCost,
                             Graph]:
     """Full Unity pipeline: Layer graph -> PCG -> substitution/DP search ->
     executable program + ShardingStrategy (reference
-    ``Graph::graph_optimize_task``, ``graph.cc:2046``)."""
+    ``Graph::graph_optimize_task``, ``graph.cc:2046``).
+
+    ``evaluator_cls`` selects the scoring backend: the additive
+    GraphCostEvaluator (default; machine model v0) or the native task-graph
+    simulator (``tasksim.TaskGraphEvaluator``; machine model v1)."""
     graph = Graph.from_layers(layers, input_tensors, output_tensors)
     degrees = [d for d in dmesh.valid_degrees() if d > 1]
     if xfers is None:
         xfers = generate_all_pcg_xfers(degrees)
+    if evaluator_cls is None:
+        evaluator_cls = GraphCostEvaluator
     if mem_budget_bytes is not None:
         g, gc = graph_optimize_with_memory(
             graph, xfers, cost_model, dmesh, mem_budget_bytes, budget,
-            alpha, base_optimize_threshold=base_optimize_threshold)
+            alpha, base_optimize_threshold=base_optimize_threshold,
+            evaluator_cls=evaluator_cls)
     else:
-        ev = GraphCostEvaluator(cost_model, dmesh)
+        ev = evaluator_cls(cost_model, dmesh)
         search = UnitySearch(ev, xfers, budget=budget, alpha=alpha,
                              base_optimize_threshold=base_optimize_threshold)
         g, _ = search.optimize(graph)

@@ -88,13 +88,17 @@ class FFModel:
     def _add_layer(self, op_type: OperatorType, inputs: Sequence[Tensor],
                    params: Dict[str, Any], name: Optional[str] = None
                    ) -> Layer:
-        if name is not None:
-            # params/strategy dicts are name-keyed: uniquify collisions
-            used = {l.name for l in self.layers}
-            base, k = name, 1
-            while name in used:
-                name = f"{base}_{k}"
-                k += 1
+        if name is None:
+            # deterministic per-model naming (layer index, not a global
+            # counter) so params/checkpoints from two identically-built
+            # models share keys — required for checkpoint restore
+            name = f"{OperatorType(op_type).name.lower()}_{len(self.layers)}"
+        # params/strategy dicts are name-keyed: uniquify collisions
+        used = {l.name for l in self.layers}
+        base, k = name, 1
+        while name in used:
+            name = f"{base}_{k}"
+            k += 1
         layer = Layer(op_type, name, list(inputs), params)
         op = get_op_def(op_type)
         in_shapes = [t.shape for t in inputs]
@@ -577,6 +581,10 @@ class FFModel:
                 bsz = next(iter(batch.values())).shape[0]
                 pm.update({k: np.asarray(v) for k, v in bm.items()}, bsz)
                 nb += 1
+                # dynamic recompilation hook (reference model.cc:2422)
+                rs = getattr(self, "_recompile_state", None)
+                if rs is not None and rs.step(self):
+                    step_fn = self.executor.make_train_step()
                 if verbose and nb % self.config.print_freq == 0:
                     rep = pm.report()
                     msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
@@ -655,6 +663,26 @@ class FFModel:
 
     def get_perf_metrics(self):
         return self._current_metrics
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (beyond-reference: the reference has no built-in
+    # checkpointing, SURVEY.md §5)
+    def save_checkpoint(self, directory: str, step: Optional[int] = None,
+                        max_to_keep: int = 3):
+        from .runtime.checkpoint import save_model_checkpoint
+        return save_model_checkpoint(self, directory, step, max_to_keep)
+
+    def restore_checkpoint(self, directory: str,
+                           step: Optional[int] = None) -> int:
+        from .runtime.checkpoint import restore_model_checkpoint
+        return restore_model_checkpoint(self, directory, step)
+
+    # dynamic recompilation (reference recompile_on_condition, model.cc:2422)
+    def recompile_on_condition(self, trigger, alter) -> "object":
+        from .runtime.recompile import RecompileState
+        rs = RecompileState(trigger, alter, ff=self)
+        self._recompile_state = rs
+        return rs
 
     # weights access (reference Parameter.get/set_weights NumPy round-trip)
     def get_weights(self, layer_name: str, weight_name: str = "kernel"

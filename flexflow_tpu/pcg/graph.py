@@ -246,6 +246,17 @@ class Graph:
             for e in edges:
                 if e not in self.in_edges[e.dst]:
                     errs.append(f"dangling edge {e}")
+        # acyclicity via the native reachability closure when built
+        # (bitset transitive closure, native/src/ffruntime.cc)
+        try:
+            from .. import native
+            nodes = self.nodes
+            index = {n.guid: i for i, n in enumerate(nodes)}
+            edges = [(index[e.src.guid], index[e.dst.guid])
+                     for es in self.out_edges.values() for e in es]
+            native.transitive_closure(len(nodes), edges)
+        except ValueError:
+            errs.append("graph contains a cycle")
         return errs
 
     # -- dominators (for Unity sequence splits) ----------------------------

@@ -1,0 +1,151 @@
+"""Checkpoint / resume.
+
+The reference has NO built-in checkpointing (SURVEY.md §5): users hand-roll
+NumPy round-trips through ``Parameter.get_weights/set_weights``
+(``flexflow_cffi.py:851-886``). The TPU rebuild makes checkpointing a
+first-class subsystem on orbax: sharded, async-capable saves of the full
+training state (params, optimizer state, mutable op state, step) plus the
+searched parallelization strategy, so a resumed run restores both the
+weights AND the parallelization decision (the reference's closest analog is
+its separate ``--export``/``--import`` strategy files).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _tree_to_numpy(tree):
+    import jax
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+class CheckpointManager:
+    """Orbax-backed checkpoint manager with a plain-numpy fallback.
+
+    Layout: ``<dir>/<step>/state`` (orbax PyTree) + ``<dir>/<step>/meta.json``
+    (step, strategy document, user metadata).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        try:
+            import orbax.checkpoint as ocp
+            self._ocp = ocp
+        except Exception:  # orbax unavailable: numpy fallback
+            self._ocp = None
+
+    # ------------------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def all_steps(self):
+        out = []
+        for d in os.listdir(self.directory) if os.path.isdir(
+                self.directory) else []:
+            if d.isdigit() and os.path.exists(
+                    os.path.join(self.directory, d, "meta.json")):
+                out.append(int(d))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # ------------------------------------------------------------------
+    def save(self, step: int, state: Dict[str, Any],
+             metadata: Optional[Dict[str, Any]] = None):
+        """state: arbitrary pytree (params/opt_state/op state)."""
+        sdir = self._step_dir(step)
+        os.makedirs(sdir, exist_ok=True)
+        path = os.path.join(sdir, "state")
+        if self._ocp is not None:
+            with self._ocp.PyTreeCheckpointer() as ckptr:
+                ckptr.save(path, _tree_to_numpy(state), force=True)
+        else:
+            import pickle
+            with open(path + ".pkl", "wb") as f:
+                pickle.dump(_tree_to_numpy(state), f)
+        with open(os.path.join(sdir, "meta.json"), "w") as f:
+            json.dump({"step": step, **(metadata or {})}, f)
+        self._gc()
+
+    def restore(self, step: Optional[int] = None):
+        """Returns (state, metadata) for `step` (default: latest)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        sdir = self._step_dir(step)
+        path = os.path.join(sdir, "state")
+        if self._ocp is not None and os.path.isdir(path):
+            with self._ocp.PyTreeCheckpointer() as ckptr:
+                state = ckptr.restore(path)
+        else:
+            import pickle
+            with open(path + ".pkl", "rb") as f:
+                state = pickle.load(f)
+        with open(os.path.join(sdir, "meta.json")) as f:
+            meta = json.load(f)
+        return state, meta
+
+    def _gc(self):
+        steps = self.all_steps()
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            import shutil
+            shutil.rmtree(self._step_dir(victim), ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# FFModel-level helpers (wired as methods on FFModel)
+# ---------------------------------------------------------------------------
+def save_model_checkpoint(ff, directory: str, step: Optional[int] = None,
+                          max_to_keep: int = 3):
+    """Save params + optimizer state + op state + step + strategy."""
+    from ..search.serialization import _spec_to_json
+    mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
+    step = int(step if step is not None else ff._step)
+    strategy_doc = None
+    if getattr(ff, "strategy", None) is not None:
+        strategy_doc = {
+            name: {"outputs": [_spec_to_json(s) for s in os_.outputs],
+                   "weights": {k: _spec_to_json(v)
+                               for k, v in os_.weights.items()}}
+            for name, os_ in ff.strategy.ops.items()}
+    mgr.save(step,
+             {"params": ff.params, "opt_state": ff.opt_state,
+              "state": ff.state},
+             metadata={"strategy": strategy_doc,
+                       "batch_size": ff.config.batch_size})
+    return mgr
+
+
+def restore_model_checkpoint(ff, directory: str,
+                             step: Optional[int] = None) -> int:
+    """Restore training state into a compiled FFModel; returns the step.
+    Restored arrays are re-placed with the model's current shardings (so a
+    checkpoint taken under one strategy resumes under another — strategy
+    migration the reference cannot do)."""
+    import jax
+    mgr = CheckpointManager(directory)
+    state, meta = mgr.restore(step)
+
+    def replace(tmpl, new):
+        return jax.tree.map(
+            lambda t, n: jax.device_put(
+                np.asarray(n).astype(t.dtype).reshape(t.shape),
+                t.sharding if hasattr(t, "sharding") else None),
+            tmpl, new)
+
+    ff.params = replace(ff.params, state["params"])
+    ff.opt_state = replace(ff.opt_state, state["opt_state"])
+    if state.get("state"):
+        ff.state = replace(ff.state, state["state"])
+    ff._step = int(meta["step"])
+    return ff._step

@@ -63,7 +63,11 @@ class SingleDataLoader:
                 return None
             hi = self.num_samples
         sel = self._order[lo:hi]
-        return {k: v[sel] for k, v in self.arrays.items()}
+        # threaded C++ row gather when built (reference dataloader batch-copy
+        # index launches, dataloader.cc:324); numpy fallback inside
+        from .. import native
+        return {k: native.gather_batch(v, sel)
+                for k, v in self.arrays.items()}
 
     def next_batch(self):
         """Reference ``next_batch_xd_launcher`` analog; returns device dict

@@ -1,0 +1,44 @@
+"""Dynamic recompilation (reference ``RecompileState``,
+``include/flexflow/recompile.h:26``, ``FFModel::recompile_on_condition``,
+``src/runtime/model.cc:2422``).
+
+The reference evaluates a user trigger each iteration and, when it fires,
+runs an alter function that mutates the model (used for MoE cache swaps).
+TPU analog: the alter function may mutate the FFModel/config/layers; the
+executor is then rebuilt so the next step re-jits — XLA recompilation is
+the analog of Legion re-mapping the task graph.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class RecompileState:
+    """trigger() -> bool evaluated once per training iteration; when true,
+    alter(ff) runs and the jitted step is invalidated."""
+
+    def __init__(self, trigger: Callable[["RecompileState"], bool],
+                 alter: Callable[["RecompileState"], None], ff=None):
+        self.trigger = trigger
+        self.alter = alter
+        self.ff = ff
+        self.recompilations = 0
+        # free-form slots the reference exposes for trigger bookkeeping
+        self.last_metric: Optional[float] = None
+        self.iteration = 0
+
+    def step(self, ff) -> bool:
+        """Evaluate once per iteration; returns True if a recompile ran."""
+        self.iteration += 1
+        if not self.trigger(self):
+            return False
+        self.alter(self)
+        self.recompilations += 1
+        # invalidate jitted steps; params/opt state survive (the graph may
+        # have changed shape-compatibly — the user's responsibility, as in
+        # the reference)
+        if ff.executor is not None:
+            ff.executor._train_step = None
+            ff.executor._eval_step = None
+            ff.executor._forward_fn = None
+        return True

@@ -74,6 +74,15 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
     if cfg.enable_memory_search:
         mem_budget = (cfg.device_mem_mb * (1 << 20)
                       if cfg.device_mem_mb > 0 else dmesh.spec.hbm_bytes)
+    xfers = None
+    if cfg.substitution_json_path:
+        # reference-format rule collection (graph_subst_3_v2.json schema)
+        # appended to the programmatic parallelization xfers
+        from .substitution import generate_all_pcg_xfers
+        from .substitution_loader import load_rule_collection
+        degrees = [d for d in dmesh.valid_degrees() if d > 1]
+        xfers = list(generate_all_pcg_xfers(degrees))
+        xfers += load_rule_collection(cfg.substitution_json_path)
     evaluator_cls = None
     if cfg.machine_model_version >= 1:
         # machine model v1: native event-driven task-graph simulator
@@ -85,7 +94,7 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
         budget=budget, alpha=max(cfg.search_alpha, 1.0 + 1e-6),
         mem_budget_bytes=mem_budget,
         base_optimize_threshold=max(cfg.base_optimize_threshold, 2),
-        evaluator_cls=evaluator_cls)
+        xfers=xfers, evaluator_cls=evaluator_cls)
     if cfg.profiling:
         print(f"unity search: {time.perf_counter() - t0:.2f}s, "
               f"cost {gc.total * 1e3:.3f} ms "

@@ -90,6 +90,13 @@ class OpX:
         self.share = share        # dst-only: reuse matched layer of this OpX
         self.ann = ann            # dst-only: parallel annotation
         self.params = params      # dst-only: params for a new op
+        # arity of a params callable, computed once (hot path avoids
+        # per-application inspect.signature)
+        if callable(params):
+            import inspect
+            self._params_nargs = len(inspect.signature(params).parameters)
+        else:
+            self._params_nargs = 0
 
     def out(self, idx: int = 0) -> TensorX:
         return self.outputs[idx]
@@ -110,6 +117,12 @@ class OpX:
 
     def __repr__(self):
         return f"OpX({self.name})"
+
+
+class SkipRewrite(Exception):
+    """Raised by dst-pattern param callables to veto one concrete rewrite
+    (e.g. a loaded rule whose dim translation is invalid for the matched
+    tensor ranks)."""
 
 
 class GraphXfer:
@@ -173,7 +186,10 @@ class GraphXfer:
                ) -> Iterable[Graph]:
         if depth == len(self.src_ops):
             if self._check_match_safe(graph, mapping, bindings):
-                g2 = self._apply(graph, mapping, bindings)
+                try:
+                    g2 = self._apply(graph, mapping, bindings)
+                except SkipRewrite:
+                    g2 = None
                 if g2 is not None and g2.num_nodes() <= max_num_ops:
                     yield g2
             return
@@ -244,24 +260,52 @@ class GraphXfer:
             return ParAnn.trivial()
         return opx.ann(mapping) if callable(opx.ann) else opx.ann
 
-    def _resolve_params(self, opx: OpX, mapping) -> Dict[str, Any]:
+    def _resolve_params(self, opx: OpX, mapping,
+                        in_tensors: Optional[List[Tensor]] = None
+                        ) -> Dict[str, Any]:
         if opx.params is None:
             return {}
-        return opx.params(mapping) if callable(opx.params) else dict(
-            opx.params)
+        if callable(opx.params):
+            # loader-generated params also need the concrete input tensors
+            # (rank/shape-dependent dim translation); programmatic xfers
+            # take mapping only
+            return (opx.params(mapping, in_tensors)
+                    if opx._params_nargs >= 2 else opx.params(mapping))
+        return dict(opx.params)
 
     def _dst_layer(self, opx: OpX, in_tensors: List[Tensor],
                    mapping) -> Layer:
         """Create (or fetch cached) the concrete Layer for a new dst op."""
-        params = self._resolve_params(opx, mapping)
-        key = (opx.op_type, tuple(sorted(params.items())),
+        params = self._resolve_params(opx, mapping, in_tensors)
+        key = (opx.op_type,
+               tuple(sorted((k, tuple(v) if isinstance(v, list) else v)
+                            for k, v in params.items())),
                tuple(t.guid for t in in_tensors))
         hit = self._layer_cache.get(key)
         if hit is not None:
             return hit
         layer = Layer(opx.op_type, None, in_tensors, params)
-        for t in in_tensors[:1]:
-            layer.outputs.append(Tensor(t.shape, t.dtype, owner_layer=layer))
+        # real shape inference via the op registry (loaded rules introduce
+        # shape-changing dst ops like Concat/Split); identity fallback ONLY
+        # for unregistered ops — a registered op whose infer rejects these
+        # inputs vetoes the rewrite instead of fabricating a wrong shape
+        from ..ops import get_op_def
+        try:
+            op = get_op_def(opx.op_type)
+        except KeyError:
+            op = None
+        if op is None:
+            for t in in_tensors[:1]:
+                layer.outputs.append(
+                    Tensor(t.shape, t.dtype, owner_layer=layer))
+        else:
+            try:
+                outs = op.infer(params, [t.shape for t in in_tensors],
+                                [t.dtype for t in in_tensors])
+            except Exception as e:
+                raise SkipRewrite(f"{opx.name}: infer failed: {e}")
+            for shape, dt in outs:
+                layer.outputs.append(Tensor(shape, dt, owner_layer=layer))
         self._layer_cache[key] = layer
         return layer
 

@@ -112,11 +112,17 @@ class TaskGraphBuilder:
                 in_bytes = _bytes_of(n.layer.inputs[0])
             if t in (OperatorType.OP_REPARTITION, OperatorType.OP_COMBINE,
                      OperatorType.OP_REPLICATE, OperatorType.OP_REDUCTION):
+                # forward collective per parallel op; REPLICATE fwd is free
+                # under SPMD (input already replicated) — same semantics as
+                # GraphCostEvaluator
                 deg = n.layer.params.get("degree", 1)
                 coll = {OperatorType.OP_REPARTITION: "all_to_all",
                         OperatorType.OP_COMBINE: "all_gather",
-                        OperatorType.OP_REPLICATE: "all_gather",
+                        OperatorType.OP_REPLICATE: None,
                         OperatorType.OP_REDUCTION: "all_reduce"}[t]
+                if coll is None:
+                    fwd_tasks[n.guid] = preds
+                    continue
                 secs = self.cost.xfer_cost(in_bytes, coll, deg)
                 devs = self.shard_devices(deg)
                 fwd_tasks[n.guid] = self.comm_tasks(devs, secs, preds)
@@ -163,11 +169,19 @@ class TaskGraphBuilder:
                 in_bytes = _bytes_of(n.layer.inputs[0])
             if t in (OperatorType.OP_REPARTITION, OperatorType.OP_COMBINE,
                      OperatorType.OP_REPLICATE, OperatorType.OP_REDUCTION):
+                # backward cotangent collective: REPARTITION/COMBINE move
+                # the cotangent the other way; REPLICATE bwd all-reduces
+                # the replica cotangents; REDUCTION bwd is free (cotangent
+                # broadcast is the producing op's replication) — mirrors
+                # GraphCostEvaluator's per-op charges
                 deg = n.layer.params.get("degree", 1)
                 coll = {OperatorType.OP_REPARTITION: "all_to_all",
                         OperatorType.OP_COMBINE: "all_to_all",
                         OperatorType.OP_REPLICATE: "all_reduce",
-                        OperatorType.OP_REDUCTION: "all_gather"}[t]
+                        OperatorType.OP_REDUCTION: None}[t]
+                if coll is None:
+                    bwd_tasks[n.guid] = succs
+                    continue
                 secs = self.cost.xfer_cost(in_bytes, coll, deg)
                 devs = self.shard_devices(deg)
                 bwd_tasks[n.guid] = self.comm_tasks(devs, secs, succs)

@@ -1,0 +1,2 @@
+from .logger import RecursiveLogger
+from .profiling import Profiler, profile_region

@@ -1,0 +1,44 @@
+"""Indented hierarchical logging for search traces.
+
+Analog of the reference's ``RecursiveLogger`` (``utils/recursive_logger.h``,
+used throughout ``substitution.cc:2233``): each nested search phase indents
+its log lines, controlled per-category like Legion logger levels
+(``log_xfers``, ``log_dp``, ``log_sim`` ...).
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+from typing import Dict
+
+# category -> min level printed (0 = silent, 1 = info, 2 = debug)
+LOG_LEVELS: Dict[str, int] = {}
+
+
+def set_log_level(category: str, level: int):
+    LOG_LEVELS[category] = level
+
+
+class RecursiveLogger:
+    def __init__(self, category: str, stream=None):
+        self.category = category
+        self.depth = 0
+        self.stream = stream or sys.stderr
+
+    def enabled(self, level: int = 1) -> bool:
+        return LOG_LEVELS.get(self.category, 0) >= level
+
+    @contextlib.contextmanager
+    def enter(self, msg: str = "", level: int = 2):
+        if msg:
+            self.log(msg, level)
+        self.depth += 1
+        try:
+            yield self
+        finally:
+            self.depth -= 1
+
+    def log(self, msg: str, level: int = 1):
+        if self.enabled(level):
+            print(f"[{self.category}] {'  ' * self.depth}{msg}",
+                  file=self.stream)

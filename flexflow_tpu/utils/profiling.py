@@ -1,0 +1,84 @@
+"""Profiling / tracing.
+
+Reference analogs (SURVEY.md §5):
+  - ``--profiling`` per-op kernel timing  → per-step wall timing with true
+    device synchronization (device-to-host fetch; ``block_until_ready`` is
+    a no-op through tunneled TPU backends);
+  - ``-lg:prof`` Legion/Realm profiles    → ``jax.profiler`` traces
+    (XPlane, viewable in TensorBoard/Perfetto) via :func:`profile_region`
+    or ``Profiler(trace_dir=...)``;
+  - Legion iteration tracing              → jit caching (automatic); the
+    profiler records compile (first-call) time separately from steady-state.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def sync(value: Any) -> None:
+    """Force completion of device work feeding `value` (D2H fetch — the
+    only reliable barrier through tunneled backends)."""
+    import jax
+    leaves = jax.tree.leaves(value)
+    if leaves:
+        np.asarray(leaves[-1])
+
+
+@contextlib.contextmanager
+def profile_region(name: str, trace_dir: Optional[str] = None):
+    """jax.profiler trace around a region (reference -lg:prof analog)."""
+    import jax
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            with jax.profiler.TraceAnnotation(name):
+                yield
+    else:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
+class Profiler:
+    """Per-step timing accumulator used by fit() under --profiling."""
+
+    def __init__(self, trace_dir: Optional[str] = None):
+        self.trace_dir = trace_dir
+        self.step_times: List[float] = []
+        self.compile_time: float = 0.0
+        self._trace_active = False
+
+    def start_trace(self):
+        if self.trace_dir and not self._trace_active:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+            self._trace_active = True
+
+    def stop_trace(self):
+        if self._trace_active:
+            import jax
+            jax.profiler.stop_trace()
+            self._trace_active = False
+
+    @contextlib.contextmanager
+    def step(self, sync_value=None):
+        t0 = time.perf_counter()
+        yield
+        if sync_value is not None:
+            sync(sync_value)
+        dt = time.perf_counter() - t0
+        if not self.step_times:
+            self.compile_time = dt   # first step includes jit compile
+        self.step_times.append(dt)
+
+    def summary(self) -> Dict[str, float]:
+        steady = self.step_times[1:] or self.step_times
+        return {
+            "steps": len(self.step_times),
+            "compile_s": self.compile_time,
+            "mean_step_s": float(np.mean(steady)) if steady else 0.0,
+            "p50_step_s": float(np.median(steady)) if steady else 0.0,
+            "total_s": float(np.sum(self.step_times)),
+        }

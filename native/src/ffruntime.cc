@@ -14,11 +14,12 @@
 //  2. Parallel batch gather — the analog of the reference's dataloader
 //     index-launch batch copies (src/dataloader/dataloader.cc:324,382):
 //     gathers shuffled sample rows into a contiguous batch buffer with a
-//     thread pool, so host-side input pipelines keep up with the TPU.
+//     thread pool (wired into SingleDataLoader._host_batch).
 //
-//  3. Graph reachability/structure helpers (transitive closure bitsets) used
-//     by the substitution engine for fast cycle checks during rewrites
-//     (reference Graph::check_correctness, src/runtime/graph.cc).
+//  3. Graph reachability helpers (transitive closure bitsets) backing the
+//     PCG's structural validation / cycle detection
+//     (Graph.check_consistency; reference Graph::check_correctness,
+//     src/runtime/graph.cc).
 //
 // Exposed as a flat C ABI for ctypes (no pybind11 in this image).
 

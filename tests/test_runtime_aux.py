@@ -1,0 +1,101 @@
+"""Aux runtime subsystems: checkpoint/resume, dynamic recompilation,
+profiling utilities, recursive logger."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+
+def _toy_model(seed=0):
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    cfg.seed = seed
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16), name="x")
+    h = ff.dense(x, 32, activation="relu")
+    ff.softmax(ff.dense(h, 4))
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy",
+               ["accuracy"])
+    return ff
+
+
+def _batch(rng):
+    return {"x": rng.normal(size=(8, 16)).astype(np.float32),
+            "label": rng.integers(0, 4, size=(8, 1)).astype(np.int32)}
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    ff = _toy_model()
+    step_fn = ff.executor.make_train_step()
+    b = _batch(rng)
+    for _ in range(3):
+        ff._run_train_step(step_fn, b)
+    w_before = ff.get_weights(ff.layers[0].name)
+    ff.save_checkpoint(str(tmp_path / "ckpt"))
+
+    # fresh model (different init seed) restores to identical state
+    ff2 = _toy_model(seed=99)
+    assert not np.allclose(ff2.get_weights(ff2.layers[0].name), w_before)
+    step = ff2.restore_checkpoint(str(tmp_path / "ckpt"))
+    assert step == 3
+    np.testing.assert_allclose(ff2.get_weights(ff2.layers[0].name),
+                               w_before)
+    # training continues from the restored state identically
+    bm1 = ff._run_train_step(ff.executor.make_train_step(), b)
+    bm2 = ff2._run_train_step(ff2.executor.make_train_step(), b)
+    np.testing.assert_allclose(float(np.asarray(bm1["loss"])),
+                               float(np.asarray(bm2["loss"])), rtol=1e-5)
+
+
+def test_checkpoint_max_to_keep(tmp_path):
+    ff = _toy_model()
+    for s in range(5):
+        ff._step = s
+        ff.save_checkpoint(str(tmp_path / "ckpt"), max_to_keep=2)
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.all_steps() == [3, 4]
+
+
+def test_recompile_on_condition():
+    """Trigger fires mid-fit, alter mutates a layer param, training
+    continues with the re-jitted step (reference RecompileState)."""
+    rng = np.random.default_rng(1)
+    ff = _toy_model()
+    fired = []
+
+    def trigger(rs):
+        return rs.iteration == 2
+
+    def alter(rs):
+        fired.append(rs.iteration)
+
+    ff.recompile_on_condition(trigger, alter)
+    X = rng.normal(size=(32, 16)).astype(np.float32)
+    Y = rng.integers(0, 4, size=(32, 1)).astype(np.int32)
+    hist = ff.fit(x=X, y=Y, epochs=1, verbose=False)
+    assert fired == [2]
+    assert ff._recompile_state.recompilations == 1
+    assert np.isfinite(hist[-1]["loss"])
+
+
+def test_profiler_and_logger(capsys):
+    import time as _t
+    from flexflow_tpu.utils import Profiler, RecursiveLogger
+    from flexflow_tpu.utils.logger import set_log_level
+
+    p = Profiler()
+    for _ in range(3):
+        with p.step():
+            _t.sleep(0.01)
+    s = p.summary()
+    assert s["steps"] == 3 and s["mean_step_s"] >= 0.009
+
+    set_log_level("dp", 2)
+    log = RecursiveLogger("dp")
+    with log.enter("outer"):
+        log.log("inner")
+    err = capsys.readouterr().err
+    assert "[dp] outer" in err and "[dp]   inner" in err

@@ -1,0 +1,102 @@
+"""Reference-format substitution JSON loading (the reference's
+``substitution_loader`` + ``graph_subst_3_v2.json``, 640 rules)."""
+import json
+import os
+
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.tensor import Tensor
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.pcg.graph import Graph
+from flexflow_tpu.search.substitution_loader import (compile_rule,
+                                                     load_rule_collection)
+
+REF_JSON = "/root/reference/substitutions/graph_subst_3_v2.json"
+
+
+@pytest.mark.skipif(not os.path.exists(REF_JSON),
+                    reason="reference substitution file not mounted")
+def test_load_full_reference_collection():
+    xfers = load_rule_collection(REF_JSON)
+    with open(REF_JSON) as f:
+        n_total = len(json.load(f)["rule"])
+    assert n_total == 640
+    # every rule in the collection uses mappable operators
+    assert len(xfers) == n_total
+    names = {x.name for x in xfers}
+    assert len(names) == n_total  # unique rule names preserved
+
+
+def _partition_combine_rule():
+    """Hand-built doc in the reference schema: partition(d0) ∘ combine(d0)
+    == identity-ish rewrite to nothing — here: partition(dim1)·partition(
+    dim0)·combine(dim1) => partition(dim0), the first rule of the file."""
+    with open(REF_JSON) as f:
+        return json.load(f)["rule"][0]
+
+
+@pytest.mark.skipif(not os.path.exists(REF_JSON),
+                    reason="reference substitution file not mounted")
+def test_apply_first_reference_rule():
+    """taso_rule_0: partition(d1,2); partition(d2,2); combine(d1,2)
+    => partition(d2,2). Build exactly that src chain on a rank-3 tensor and
+    check the rule rewrites it to the single dst partition."""
+    rule = _partition_combine_rule()
+    xf = compile_rule(rule)
+    assert xf is not None
+
+    from flexflow_tpu.core.layer import Layer
+    x = Tensor((8, 4, 6), DataType.DT_FLOAT, name="x")
+    rank = 3
+    # reference dims: ff_dim 1 -> numpy axis rank-1-1 = 1; ff_dim 2 -> 0
+    l1 = Layer(OperatorType.OP_REPARTITION, None, [x],
+               {"dim": 1, "degree": 2, "group": "g"})
+    l1.outputs.append(Tensor(x.shape, x.dtype, owner_layer=l1))
+    l2 = Layer(OperatorType.OP_REPARTITION, None, [l1.outputs[0]],
+               {"dim": 0, "degree": 2, "group": "g"})
+    l2.outputs.append(Tensor(x.shape, x.dtype, owner_layer=l2))
+    l3 = Layer(OperatorType.OP_COMBINE, None, [l2.outputs[0]],
+               {"dim": 1, "degree": 2, "group": "g"})
+    l3.outputs.append(Tensor(x.shape, x.dtype, owner_layer=l3))
+    g = Graph.from_layers([l1, l2, l3], [x], [l3.outputs[0]])
+    assert g.num_nodes() == 3
+
+    rewrites = list(xf.run(g))
+    assert rewrites, "rule must match the hand-built chain"
+    g2 = rewrites[0]
+    assert g2.num_nodes() == 1
+    node = g2.nodes[0]
+    assert node.op_type == OperatorType.OP_REPARTITION
+    assert node.layer.params["dim"] == 0          # ff dim 2 on rank 3
+    assert node.layer.params["degree"] == 2
+
+
+@pytest.mark.skipif(not os.path.exists(REF_JSON),
+                    reason="reference substitution file not mounted")
+def test_search_accepts_substitution_json(tmp_path):
+    """--substitution-json end-to-end: search runs with the loaded rules."""
+    import numpy as np
+    from flexflow_tpu import SGDOptimizer
+
+    small = {"_t": "RuleCollection",
+             "rule": [json.load(open(REF_JSON))["rule"][0]]}
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(small))
+
+    cfg = FFConfig()
+    cfg.substitution_json_path = str(p)
+    cfg.search_budget = 4
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 32), name="x")
+    h = ff.dense(x, 64, activation="relu")
+    ff.dense(h, 8)
+    ff.softmax(ff.layers[-1].outputs[0])
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [])
+    batch = {"x": np.random.default_rng(0).normal(size=(16, 32))
+             .astype(np.float32),
+             "label": np.zeros((16, 1), np.int32)}
+    step = ff.executor.make_train_step()
+    bm = ff._run_train_step(step, batch)
+    assert np.isfinite(float(np.asarray(bm["loss"])))

@@ -1,0 +1,156 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+The reference only reserves an enum/task ids for pipelining
+(``OP_PIPELINE``, ``ffconst.h:159``; ``PIPELINE_*_TASK_ID``,
+``model.h:190-192``) — no implementation exists (SURVEY.md §2.6). This
+module supplies the real thing, TPU-style: stages are a mesh axis ("pp"),
+stage parameters are stacked on a leading stage dim sharded over that axis,
+and the schedule is a ``lax.scan`` whose per-step activation hand-off is a
+``ppermute`` to the next stage — XLA lowers it to neighbor collective-
+permutes over ICI. Reverse-mode AD through the scan + ppermute gives the
+backward pipeline for free (cotangents flow stage S-1 → 0 through the
+transposed permutes), so one ``jax.grad`` of the pipelined loss is a full
+1F1B-equivalent-work backward schedule.
+
+Constraints (the standard SPMD-pipeline shape): all stages run the same
+``stage_fn`` with shape-preserving activations (e.g. transformer blocks);
+embedding/head run outside the pipelined region.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _squeeze_stage(params):
+    """Drop the local (length-1) leading stage dim of each leaf."""
+    return jax.tree.map(lambda x: x[0], params)
+
+
+def gpipe(stage_fn: Callable[[Any, Any], Any], axis_name: str,
+          n_microbatches: int):
+    """Build the pipelined apply for use INSIDE shard_map over `axis_name`.
+
+    stage_fn(stage_params, x) -> y with y.shape == x.shape.
+
+    Returned fn(stacked_params_local, xs) where:
+      - stacked_params_local: pytree whose leaves have local shape
+        (1, ...) — this stage's slice of the (S, ...) stacked params;
+      - xs: (M, mb, ...) microbatched input (replicated across stages);
+    returns (M, mb, ...) outputs of the final stage (replicated).
+
+    Schedule: T = M + S - 1 steps; at step t stage s computes microbatch
+    t - s (bubble steps compute masked garbage that receives no gradient).
+    """
+
+    def apply(stacked_params_local, xs):
+        S = lax.psum(1, axis_name)
+        stage = lax.axis_index(axis_name)
+        M = n_microbatches
+        params = _squeeze_stage(stacked_params_local)
+        # neighbor hand-off, no wraparound: stage s -> s+1
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        outputs0 = jnp.zeros_like(xs)
+        state0 = jnp.zeros_like(xs[0])
+
+        def body(carry, t):
+            state, outputs = carry
+            # stage 0 pulls microbatch t from the local queue; later stages
+            # consume the activation handed off by the previous stage
+            mb_t = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(stage == 0, mb_t, state)
+            y = stage_fn(params, x_in)
+            # final stage owns microbatch t-(S-1) at step t
+            out_idx = t - (S - 1)
+            valid = jnp.logical_and(stage == S - 1,
+                                    jnp.logical_and(out_idx >= 0,
+                                                    out_idx < M))
+            write_idx = jnp.clip(out_idx, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(outputs, write_idx, 0,
+                                           keepdims=False)
+            upd = jnp.where(valid, y, cur)
+            outputs = lax.dynamic_update_index_in_dim(outputs, upd,
+                                                      write_idx, 0)
+            state = lax.ppermute(y, axis_name, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = lax.scan(body, (state0, outputs0),
+                                   jnp.arange(M + S - 1))
+        # broadcast final-stage outputs to every stage (masked psum)
+        outputs = lax.psum(
+            jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs
+
+    return apply
+
+
+class PipelinedBlocks:
+    """High-level dp×pp runner for a stack of identical blocks.
+
+    Wraps ``n_stages`` groups of blocks: stage parameters are stacked on a
+    leading dim and placed with ``NamedSharding(P('pp', ...))``; input
+    batches are split into microbatches; the pipelined apply runs under
+    ``shard_map`` over a (dp, pp) mesh and is differentiable end-to-end.
+    """
+
+    def __init__(self, mesh: Mesh, stage_fn, n_stages: int,
+                 n_microbatches: int, dp_axis: str = "dp",
+                 pp_axis: str = "pp"):
+        assert pp_axis in mesh.axis_names, (pp_axis, mesh.axis_names)
+        pp_size = mesh.shape[pp_axis]
+        assert n_stages == pp_size, \
+            (f"n_stages ({n_stages}) must equal the '{pp_axis}' axis size "
+             f"({pp_size}): one stage per pipeline rank")
+        self.mesh = mesh
+        self.stage_fn = stage_fn
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.dp_axis = dp_axis
+        self.pp_axis = pp_axis
+
+    def shard_params(self, stacked_params):
+        """Place (S, ...)-stacked params: stage dim over the pp axis."""
+        def put(x):
+            spec = P(self.pp_axis, *([None] * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+        return jax.tree.map(put, stacked_params)
+
+    def microbatch(self, x):
+        """(B, ...) -> (M, B/M, ...)"""
+        M = self.n_microbatches
+        assert x.shape[0] % M == 0, (x.shape, M)
+        return x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+    def apply(self, stacked_params, x):
+        """Differentiable pipelined forward of the block stack.
+        x: (B, ...) full batch (dp-sharded on the batch dim outside)."""
+        xs = self.microbatch(x)
+        engine = gpipe(self.stage_fn, self.pp_axis, self.n_microbatches)
+        in_param_spec = jax.tree.map(
+            lambda v: P(self.pp_axis, *([None] * (v.ndim - 1))),
+            stacked_params)
+        dp = self.dp_axis if self.dp_axis in self.mesh.axis_names else None
+        xs_spec = P(None, dp, *([None] * (xs.ndim - 2)))
+
+        fn = jax.shard_map(
+            engine, mesh=self.mesh,
+            in_specs=(in_param_spec, xs_spec),
+            out_specs=xs_spec,
+            check_vma=False)
+        ys = fn(stacked_params, xs)
+        return ys.reshape((-1,) + ys.shape[2:])
+
+
+def stack_stage_params(per_stage_params: Sequence[Any]):
+    """[stage0_params, stage1_params, ...] -> stacked pytree with leading
+    stage dim (the layout ``PipelinedBlocks`` shards over pp)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -167,6 +167,13 @@ class FFConfig:
                 cfg.profiling = True
             elif a == "--allow-tensor-op-math-conversion":
                 cfg.allow_tensor_op_math_conversion = True
+            elif a in ("--no-tensor-op-math-conversion", "--f32-compute"):
+                # TPU-native default is bf16 matmul compute (the MXU's
+                # native dtype) — unlike the reference, which defaults its
+                # TF32/FP16 conversion OFF (model.cc:3491). This flag
+                # restores full-f32 math for numerics debugging.
+                cfg.allow_tensor_op_math_conversion = False
+                cfg.use_bf16_compute = False
             elif a == "--export" or a == "--export-strategy":
                 cfg.export_strategy_file = take()
             elif a == "--import" or a == "--import-strategy":

@@ -54,6 +54,21 @@ class DataType(enum.IntEnum):
     DT_DOUBLE = 45
     DT_NONE = 49
 
+    @classmethod
+    def _missing_(cls, value):
+        if isinstance(value, str):
+            aliases = {"bool": "BOOLEAN", "int32": "INT32", "int64": "INT64",
+                       "half": "HALF", "float16": "HALF",
+                       "bfloat16": "BFLOAT16", "float": "FLOAT",
+                       "float32": "FLOAT", "double": "DOUBLE",
+                       "float64": "DOUBLE"}
+            key = aliases.get(value.lower(), value.upper())
+            try:
+                return cls[f"DT_{key}" if not key.startswith("DT_") else key]
+            except KeyError:
+                return None
+        return None
+
 
 class LossType(enum.IntEnum):
     LOSS_CATEGORICAL_CROSSENTROPY = 50

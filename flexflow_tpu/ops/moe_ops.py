@@ -70,8 +70,10 @@ class GroupByOp(OpDef):
         c = _capacity(params, b, k)
         disp = _dispatch_mask(assign, n, c)               # (T, n, C)
         xr = jnp.repeat(x, k, axis=0)                     # (T, D) token per slot
-        buf = jnp.einsum("tec,td->ecd", disp.astype(jnp.bfloat16),
-                         xr.astype(jnp.bfloat16),
+        from .registry import compute_dtype
+        mdt = compute_dtype(ctx, x.dtype)
+        buf = jnp.einsum("tec,td->ecd", disp.astype(mdt),
+                         xr.astype(mdt),
                          preferred_element_type=jnp.float32)
         buf = buf.astype(x.dtype)
         return [buf[e] for e in range(n)]
@@ -100,8 +102,10 @@ class AggregateOp(OpDef):
         w = gate_preds.reshape(-1)                        # (T,)
         combine = disp * w[:, None, None]
         stacked = jnp.stack(exp_preds, axis=0)            # (n, C, Do)
-        out = jnp.einsum("tec,ecd->td", combine.astype(jnp.bfloat16),
-                         stacked.astype(jnp.bfloat16),
+        from .registry import compute_dtype
+        mdt = compute_dtype(ctx, exp_preds[0].dtype)
+        out = jnp.einsum("tec,ecd->td", combine.astype(mdt),
+                         stacked.astype(mdt),
                          preferred_element_type=jnp.float32)
         out = out.reshape(b, k, -1).sum(axis=1).astype(exp_preds[0].dtype)
         # GShard-style load-balance aux loss: n * sum_e(frac_tokens_e * mean_gate_e)

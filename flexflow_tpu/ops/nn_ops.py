@@ -67,7 +67,7 @@ class LinearOp(OpDef):
 
     def emit(self, params, inputs, weights, ctx, name):
         (x,) = inputs
-        y = matmul(x, weights["kernel"])
+        y = matmul(x, weights["kernel"], ctx=ctx)
         if "bias" in weights:
             y = y + weights["bias"]
         y = apply_activation(y, params.get("activation",
@@ -117,7 +117,8 @@ class Conv2DOp(OpDef):
         (x,) = inputs
         k = weights["kernel"]
         cdt = x.dtype
-        if cdt == jnp.float32:
+        from .registry import bf16_enabled
+        if cdt == jnp.float32 and bf16_enabled(ctx):
             x16, k16 = x.astype(jnp.bfloat16), k.astype(jnp.bfloat16)
         else:
             x16, k16 = x, k
@@ -420,9 +421,12 @@ class MultiHeadAttentionOp(OpDef):
         cdt = q.dtype
         h = params["num_heads"]
 
+        from .registry import compute_dtype
+        mdt = compute_dtype(ctx, cdt)
+
         def proj(x, w, b):
-            y = jnp.einsum("ble,ehd->blhd", x.astype(jnp.bfloat16),
-                           w.astype(jnp.bfloat16),
+            y = jnp.einsum("ble,ehd->blhd", x.astype(mdt),
+                           w.astype(mdt),
                            preferred_element_type=jnp.float32)
             if b is not None:
                 y = y + b.astype(jnp.float32)
@@ -449,23 +453,23 @@ class MultiHeadAttentionOp(OpDef):
                     seed = jax.random.randint(ctx.rng_for(name), (),
                                               0, 2 ** 31 - 1, jnp.int32)
                 o = flash_attention(
-                    jnp.swapaxes(qh, 1, 2).astype(jnp.bfloat16),
-                    jnp.swapaxes(kh, 1, 2).astype(jnp.bfloat16),
-                    jnp.swapaxes(vh, 1, 2).astype(jnp.bfloat16),
+                    jnp.swapaxes(qh, 1, 2).astype(mdt),
+                    jnp.swapaxes(kh, 1, 2).astype(mdt),
+                    jnp.swapaxes(vh, 1, 2).astype(mdt),
                     causal=causal,
                     dropout_rate=rate, dropout_seed=seed,
                     interpret=None if on_tpu else True)
                 ctxv = jnp.swapaxes(o, 1, 2).astype(jnp.float32)
-                out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(jnp.bfloat16),
-                                 weights["wo"].astype(jnp.bfloat16),
+                out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(mdt),
+                                 weights["wo"].astype(mdt),
                                  preferred_element_type=jnp.float32)
                 if "bo" in weights:
                     out = out + weights["bo"].astype(jnp.float32)
                 return [out.astype(cdt)]
 
         scale = 1.0 / math.sqrt(qh.shape[-1])
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.bfloat16),
-                            kh.astype(jnp.bfloat16),
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(mdt),
+                            kh.astype(mdt),
                             preferred_element_type=jnp.float32) * scale
         if params.get("causal", False):
             lq, lk = logits.shape[-2], logits.shape[-1]
@@ -478,11 +482,11 @@ class MultiHeadAttentionOp(OpDef):
             keep = 1.0 - rate
             probs = jnp.where(jax.random.bernoulli(rng, keep, probs.shape),
                               probs / keep, 0.0)
-        ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16),
-                          vh.astype(jnp.bfloat16),
+        ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(mdt),
+                          vh.astype(mdt),
                           preferred_element_type=jnp.float32)
-        out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(jnp.bfloat16),
-                         weights["wo"].astype(jnp.bfloat16),
+        out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(mdt),
+                         weights["wo"].astype(mdt),
                          preferred_element_type=jnp.float32)
         if "bo" in weights:
             out = out + weights["bo"].astype(jnp.float32)
@@ -515,7 +519,7 @@ class BatchMatmulOp(OpDef):
 
     def emit(self, params, inputs, weights, ctx, name):
         a, b = inputs
-        return [matmul(a, b)]
+        return [matmul(a, b, ctx=ctx)]
 
     def flops(self, params, in_shapes, out_shapes):
         a, b = in_shapes

@@ -83,9 +83,34 @@ def get_op_def(op_type: OperatorType) -> OpDef:
     return OPS[OperatorType(op_type)]
 
 
-def matmul(a, b, *, prefer_bf16: bool = True, precision=None):
-    """MXU-friendly matmul: bf16 inputs, fp32 accumulation."""
+def bf16_enabled(ctx) -> bool:
+    """Whether emission may cast f32 matmul operands to bf16 (MXU path)."""
+    cfg = getattr(ctx, "config", None) if ctx is not None else None
+    if cfg is None:
+        return True
+    return getattr(cfg, "use_bf16_compute", True) and \
+        getattr(cfg, "allow_tensor_op_math_conversion", True)
+
+
+def compute_dtype(ctx, ref_dtype=None):
+    """bf16 when enabled and the reference dtype is f32/bf16, else f32."""
     import jax.numpy as jnp
+    if bf16_enabled(ctx) and ref_dtype in (None, jnp.float32, jnp.bfloat16):
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def matmul(a, b, *, prefer_bf16: bool = True, precision=None, ctx=None):
+    """MXU-friendly matmul: bf16 inputs, fp32 accumulation.
+
+    ``ctx`` (EmitCtx) gates the bf16 cast on
+    ``config.use_bf16_compute`` / ``allow_tensor_op_math_conversion``.
+    Unlike the reference (math conversion OFF by default, model.cc:3491),
+    the TPU-native default is ON — bf16 is the MXU's native input dtype;
+    ``--f32-compute`` / ``--no-tensor-op-math-conversion`` disables it."""
+    import jax.numpy as jnp
+    if ctx is not None:
+        prefer_bf16 = prefer_bf16 and bf16_enabled(ctx)
     if prefer_bf16 and a.dtype in (jnp.float32, jnp.bfloat16):
         a16 = a.astype(jnp.bfloat16)
         b16 = b.astype(jnp.bfloat16)

@@ -43,12 +43,15 @@ def compute_loss(loss_type: LossType, pred, label, *, logits: bool = False):
 
     if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE:
         d = pred - label.astype(jnp.float32)
-        return jnp.mean(jnp.sum(d * d, axis=-1))
+        # reference grad scale 2/volume (loss_functions.cc:51) == mean over
+        # ALL elements (torch mse_loss equivalent)
+        return jnp.mean(d * d)
 
     if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE:
         d = pred - label.astype(jnp.float32)
-        # reference scale: 2/volume on grad == mean over all elements on loss
-        return jnp.mean(d * d)
+        # reference grad = (pred-label)/batchSize (scale 1/batch,
+        # loss_functions.cc:53 + .cu kernel) => loss = sum(d^2)/(2*batch)
+        return 0.5 * jnp.sum(d * d) / d.shape[0]
 
     if loss_type == LossType.LOSS_IDENTITY:
         return jnp.mean(pred)

@@ -1,0 +1,72 @@
+"""Shared runner for the example suite.
+
+The reference's examples double as its integration suite (SURVEY.md §4:
+``tests/multi_gpu_tests.sh`` runs every example with accuracy callbacks);
+these examples follow the same pattern: build a model from the zoo, train
+on synthetic (or downloaded) data, print throughput, and — with ``--ab`` —
+run the searched-strategy vs data-parallel A/B the OSDI'22 artifact scripts
+perform (``scripts/osdi22ae/*.sh``).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+# honor JAX_PLATFORMS=cpu even when a TPU platform plugin is ambient
+# (the plugin ignores the env var; jax.config after import does not)
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+
+def run_example(name: str, build: Callable[[FFModel, FFConfig], object],
+                make_batch: Callable[[FFConfig, np.random.Generator], Dict],
+                loss: str = "sparse_categorical_crossentropy",
+                metrics=("accuracy",), steps: int = 20,
+                argv: Optional[list] = None):
+    """Build + train `steps` iterations; honors reference CLI flags.
+
+    With --ab: times data-parallel THEN the searched strategy on the same
+    model/batch and reports the ratio (the osdi22ae A/B)."""
+    import sys
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ab = "--ab" in argv
+    if ab:
+        argv.remove("--ab")
+    cfg = FFConfig.parse_args(argv)
+
+    def timed(only_dp: bool) -> float:
+        c = FFConfig.parse_args(argv)
+        c.only_data_parallel = only_dp or cfg.only_data_parallel
+        ff = FFModel(c)
+        out = build(ff, c)
+        ff.compile(SGDOptimizer(c.learning_rate), loss, list(metrics),
+                   output_tensor=out if out is not None else None)
+        rng = np.random.default_rng(0)
+        b = make_batch(c, rng)
+        step = ff.executor.make_train_step()
+        bm = ff._run_train_step(step, b)     # compile + warmup
+        float(np.asarray(bm["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            bm = ff._run_train_step(step, b)
+        loss_v = float(np.asarray(bm["loss"]))  # D2H sync
+        dt = time.perf_counter() - t0
+        sps = c.batch_size * steps / dt
+        mode = "data-parallel" if c.only_data_parallel else "searched"
+        print(f"[{name}] {mode}: {sps:.1f} samples/s "
+              f"(loss {loss_v:.4f}, {steps} steps in {dt:.2f}s)")
+        assert np.isfinite(loss_v)
+        return sps
+
+    if ab:
+        dp = timed(only_dp=True)
+        searched = timed(only_dp=False)
+        print(f"[{name}] searched vs data-parallel: {searched / dp:.2f}x")
+    else:
+        timed(only_dp=cfg.only_data_parallel)

@@ -1,0 +1,18 @@
+"""AlexNet / CIFAR-10 (reference ``bootcamp_demo/ff_alexnet_cifar10.py``,
+BASELINE.json config 1). Synthetic CIFAR-shaped data."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import build_alexnet_cifar10
+
+
+def batch(cfg, rng):
+    return {"input": rng.normal(size=(cfg.batch_size, 3, 32, 32))
+            .astype(np.float32),
+            "label": rng.integers(0, 10, size=(cfg.batch_size, 1))
+            .astype(np.int32)}
+
+
+if __name__ == "__main__":
+    run_example("alexnet_cifar10",
+                lambda ff, cfg: build_alexnet_cifar10(ff, cfg.batch_size),
+                batch)

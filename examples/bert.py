@@ -1,0 +1,27 @@
+"""BERT pretraining-shaped workload (reference osdi22ae bert.sh:
+``transformer -b 8 --budget 30``)."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import BertConfig, build_bert
+
+SEQ = 128
+
+
+def build(ff, cfg):
+    b = BertConfig.base()
+    b.max_position = SEQ
+    return build_bert(ff, cfg.batch_size, SEQ, b)
+
+
+def batch(cfg, rng):
+    return {"input_ids": rng.integers(0, 30522,
+                                      size=(cfg.batch_size, SEQ))
+            .astype(np.int32),
+            "position_ids": np.tile(np.arange(SEQ, dtype=np.int32),
+                                    (cfg.batch_size, 1)),
+            "label": rng.integers(0, 2, size=(cfg.batch_size, 1))
+            .astype(np.int32)}
+
+
+if __name__ == "__main__":
+    run_example("bert", build, batch, steps=10)

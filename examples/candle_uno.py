@@ -1,0 +1,26 @@
+"""CANDLE-Uno (reference ``examples/cpp/candle_uno``, osdi22ae
+candle_uno.sh): per-feature dense towers -> concat -> deep MLP."""
+import dataclasses
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import CandleConfig, build_candle_uno
+
+# shrunk feature dims so the example runs quickly everywhere
+CFG = CandleConfig(
+    dense_layers=(256,) * 2, dense_feature_layers=(256,) * 2,
+    feature_shapes={"dose": 1, "cell.rnaseq": 256,
+                    "drug.descriptors": 256, "drug.fingerprints": 256})
+
+
+def batch(cfg, rng):
+    b = {"label": rng.normal(size=(cfg.batch_size, 1)).astype(np.float32)}
+    for name, feat in CFG.input_features.items():
+        dim = CFG.feature_shapes[feat]
+        b[name] = rng.normal(size=(cfg.batch_size, dim)).astype(np.float32)
+    return b
+
+
+if __name__ == "__main__":
+    run_example("candle_uno",
+                lambda ff, cfg: build_candle_uno(ff, cfg.batch_size, CFG),
+                batch, loss="mean_squared_error", metrics=())

@@ -1,0 +1,26 @@
+"""DLRM recommender (reference ``examples/cpp/DLRM``, osdi22ae dlrm.sh;
+attribute-parallel embedding tables are the searched win). Table sizes
+shrunk from the reference's 1M rows so the example runs anywhere."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import DLRMConfig, build_dlrm
+
+CFG = DLRMConfig(embedding_size=(10000,) * 4)
+
+
+def batch(cfg, rng):
+    b = {"dense_input": rng.normal(
+        size=(cfg.batch_size, CFG.mlp_bot[0])).astype(np.float32),
+         "label": rng.integers(0, 2, size=(cfg.batch_size, 1))
+         .astype(np.int32)}
+    for i, size in enumerate(CFG.embedding_size):
+        b[f"sparse_{i}"] = rng.integers(
+            0, size, size=(cfg.batch_size, CFG.embedding_bag_size)
+        ).astype(np.int32)
+    return b
+
+
+if __name__ == "__main__":
+    run_example("dlrm",
+                lambda ff, cfg: build_dlrm(ff, cfg.batch_size, CFG),
+                batch)

@@ -1,0 +1,24 @@
+"""GPT-2 causal LM (BASELINE config 5; pipeline/TP target)."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import GPTConfig, build_gpt2
+
+SEQ = 128
+
+
+def build(ff, cfg):
+    g = GPTConfig(hidden_size=256, num_layers=4, num_heads=8,
+                  max_position=SEQ)
+    return build_gpt2(ff, cfg.batch_size, SEQ, g)
+
+
+def batch(cfg, rng):
+    ids = rng.integers(0, 50257, size=(cfg.batch_size, SEQ))
+    return {"input_ids": ids.astype(np.int32),
+            "position_ids": np.tile(np.arange(SEQ, dtype=np.int32),
+                                    (cfg.batch_size, 1)),
+            "label": ids.astype(np.int32)}
+
+
+if __name__ == "__main__":
+    run_example("gpt2", build, batch, steps=5)

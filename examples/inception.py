@@ -1,0 +1,21 @@
+"""Inception-v3 (reference ``examples/cpp/InceptionV3``, osdi22ae
+inception.sh: batch 64, budget 10). Reduced image size for CI."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import build_inception_v3
+
+HW = 75  # reference uses 299
+
+
+def batch(cfg, rng):
+    return {"input": rng.normal(size=(cfg.batch_size, 3, HW, HW))
+            .astype(np.float32),
+            "label": rng.integers(0, 10, size=(cfg.batch_size, 1))
+            .astype(np.int32)}
+
+
+if __name__ == "__main__":
+    run_example("inception",
+                lambda ff, cfg: build_inception_v3(ff, cfg.batch_size,
+                                                   image_hw=HW),
+                batch, steps=5)

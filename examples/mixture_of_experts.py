@@ -1,0 +1,21 @@
+"""MoE classifier (reference ``examples/cpp/mixture_of_experts``):
+top-k gate -> group_by -> per-expert dense -> aggregate."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import MoeConfig, build_moe_mnist
+
+
+CFG = MoeConfig()
+
+
+def batch(cfg, rng):
+    return {"input": rng.normal(size=(cfg.batch_size, CFG.in_dim))
+            .astype(np.float32),
+            "label": rng.integers(0, 10, size=(cfg.batch_size, 1))
+            .astype(np.int32)}
+
+
+if __name__ == "__main__":
+    run_example("mixture_of_experts",
+                lambda ff, cfg: build_moe_mnist(ff, cfg.batch_size, CFG),
+                batch)

@@ -1,0 +1,21 @@
+"""MLP hello-world (reference ``examples/python/native/mnist_mlp.py`` /
+osdi22ae MLP artifact). Synthetic MNIST-shaped data."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import build_mlp
+
+
+def build(ff, cfg):
+    return build_mlp(ff, cfg.batch_size, in_dim=784,
+                     hidden=(512, 512), num_classes=10)
+
+
+def batch(cfg, rng):
+    return {"input": rng.normal(size=(cfg.batch_size, 784))
+            .astype(np.float32),
+            "label": rng.integers(0, 10, size=(cfg.batch_size, 1))
+            .astype(np.int32)}
+
+
+if __name__ == "__main__":
+    run_example("mnist_mlp", build, batch)

@@ -1,0 +1,4 @@
+#!/bin/bash
+# A/B: searched strategy vs --only-data-parallel
+# (mirrors reference scripts/osdi22ae/bert.sh methodology)
+cd "$(dirname "$0")/.." && python bert.py --ab "$@"

@@ -1,0 +1,4 @@
+#!/bin/bash
+# A/B: searched strategy vs --only-data-parallel
+# (mirrors reference scripts/osdi22ae/inception.sh methodology)
+cd "$(dirname "$0")/.." && python inception.py --ab "$@"

@@ -1,0 +1,4 @@
+#!/bin/bash
+# A/B: searched strategy vs --only-data-parallel
+# (mirrors reference scripts/osdi22ae/mlp.sh methodology)
+cd "$(dirname "$0")/.." && python mnist_mlp.py --ab "$@"

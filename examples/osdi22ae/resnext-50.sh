@@ -1,0 +1,4 @@
+#!/bin/bash
+# A/B: searched strategy vs --only-data-parallel
+# (mirrors reference scripts/osdi22ae/resnext-50.sh methodology)
+cd "$(dirname "$0")/.." && python resnext50.py --ab "$@"

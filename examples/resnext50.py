@@ -1,0 +1,21 @@
+"""ResNeXt-50 (reference ``examples/cpp/resnext50``, osdi22ae
+resnext-50.sh: batch 16, budget 20). Small image size default for CI."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import build_resnext50
+
+HW = 64  # reference uses 224; kept small so the example runs anywhere
+
+
+def batch(cfg, rng):
+    return {"input": rng.normal(size=(cfg.batch_size, 3, HW, HW))
+            .astype(np.float32),
+            "label": rng.integers(0, 10, size=(cfg.batch_size, 1))
+            .astype(np.int32)}
+
+
+if __name__ == "__main__":
+    run_example("resnext50",
+                lambda ff, cfg: build_resnext50(ff, cfg.batch_size,
+                                                image_hw=HW),
+                batch, steps=5)

@@ -1,0 +1,20 @@
+"""Encoder stack (reference ``examples/cpp/Transformer/transformer.cc``)."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import TransformerConfig, build_transformer
+
+CFG = TransformerConfig(num_layers=2, sequence_length=64)
+
+
+def batch(cfg, rng):
+    return {"input": rng.normal(
+        size=(cfg.batch_size, CFG.sequence_length, CFG.hidden_size))
+        .astype(np.float32),
+        "label": rng.normal(size=(cfg.batch_size, CFG.sequence_length, 1))
+        .astype(np.float32)}
+
+
+if __name__ == "__main__":
+    run_example("transformer",
+                lambda ff, cfg: build_transformer(ff, cfg.batch_size, CFG),
+                batch, loss="mean_squared_error", metrics=(), steps=10)

@@ -1,0 +1,23 @@
+"""XDL ads model (reference ``examples/cpp/XDL``, osdi22ae xdl.sh):
+many embedding tables -> MLP -> softmax. Shrunk tables for portability."""
+import numpy as np
+from _common import run_example
+from flexflow_tpu.models import XDLConfig, build_xdl
+
+CFG = XDLConfig(embedding_size=(10000,) * 4)
+
+
+def batch(cfg, rng):
+    b = {"label": rng.integers(0, 2, size=(cfg.batch_size, 1))
+         .astype(np.int32)}
+    for i, size in enumerate(CFG.embedding_size):
+        b[f"sparse_{i}"] = rng.integers(
+            0, size, size=(cfg.batch_size, CFG.embedding_bag_size)
+        ).astype(np.int32)
+    return b
+
+
+if __name__ == "__main__":
+    run_example("xdl",
+                lambda ff, cfg: build_xdl(ff, cfg.batch_size, CFG),
+                batch)

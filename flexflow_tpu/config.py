@@ -167,6 +167,7 @@ class FFConfig:
                 cfg.profiling = True
             elif a == "--allow-tensor-op-math-conversion":
                 cfg.allow_tensor_op_math_conversion = True
+                cfg.use_bf16_compute = True   # symmetric with --f32-compute
             elif a in ("--no-tensor-op-math-conversion", "--f32-compute"):
                 # TPU-native default is bf16 matmul compute (the MXU's
                 # native dtype) — unlike the reference, which defaults its

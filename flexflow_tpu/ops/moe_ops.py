@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ffconst import DataType, OperatorType
-from .registry import EmitCtx, OpDef, register
+from .registry import EmitCtx, OpDef, register, compute_dtype
 
 
 def _capacity(params, batch: int, k: int) -> int:
@@ -70,7 +70,6 @@ class GroupByOp(OpDef):
         c = _capacity(params, b, k)
         disp = _dispatch_mask(assign, n, c)               # (T, n, C)
         xr = jnp.repeat(x, k, axis=0)                     # (T, D) token per slot
-        from .registry import compute_dtype
         mdt = compute_dtype(ctx, x.dtype)
         buf = jnp.einsum("tec,td->ecd", disp.astype(mdt),
                          xr.astype(mdt),
@@ -102,7 +101,6 @@ class AggregateOp(OpDef):
         w = gate_preds.reshape(-1)                        # (T,)
         combine = disp * w[:, None, None]
         stacked = jnp.stack(exp_preds, axis=0)            # (n, C, Do)
-        from .registry import compute_dtype
         mdt = compute_dtype(ctx, exp_preds[0].dtype)
         out = jnp.einsum("tec,ecd->td", combine.astype(mdt),
                          stacked.astype(mdt),

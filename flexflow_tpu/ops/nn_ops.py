@@ -19,7 +19,8 @@ from ..ffconst import (ActiMode, AggrMode, DataType, InitializerType,
                        OperatorType, PoolType)
 from ..core.tensor import WeightSpec
 from ..dtypes import to_jnp
-from .registry import EmitCtx, OpDef, matmul, register
+from .registry import (EmitCtx, OpDef, bf16_enabled, compute_dtype,
+                       matmul, register)
 
 
 def apply_activation(x, acti: ActiMode):
@@ -117,7 +118,6 @@ class Conv2DOp(OpDef):
         (x,) = inputs
         k = weights["kernel"]
         cdt = x.dtype
-        from .registry import bf16_enabled
         if cdt == jnp.float32 and bf16_enabled(ctx):
             x16, k16 = x.astype(jnp.bfloat16), k.astype(jnp.bfloat16)
         else:
@@ -421,7 +421,6 @@ class MultiHeadAttentionOp(OpDef):
         cdt = q.dtype
         h = params["num_heads"]
 
-        from .registry import compute_dtype
         mdt = compute_dtype(ctx, cdt)
 
         def proj(x, w, b):

@@ -116,4 +116,8 @@ def matmul(a, b, *, prefer_bf16: bool = True, precision=None, ctx=None):
         b16 = b.astype(jnp.bfloat16)
         out = jnp.matmul(a16, b16, preferred_element_type=jnp.float32)
         return out.astype(a.dtype) if a.dtype != jnp.float32 else out
+    # f32-compute path: still accumulate in f32 for low-precision operands
+    if a.dtype == jnp.bfloat16:
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32) \
+            .astype(a.dtype)
     return jnp.matmul(a, b)

@@ -28,7 +28,7 @@ def _gen(shape, seed, scale=1.0):
     return (rng.standard_normal(shape) * scale).astype(np.float32)
 
 
-def _forward(build, inputs, use_f32=True):
+def _forward(build, inputs):
     """Build a single-op model, return its jitted forward output."""
     cfg = FFConfig()
     cfg.only_data_parallel = True

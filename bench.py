@@ -19,13 +19,14 @@ import time
 import numpy as np
 
 
-def bench_bert(batch=16, seq=128, steps=20, warmup=3):
+def bench_bert(batch=16, seq=128, steps=20, warmup=3, flash="auto"):
     from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
     from flexflow_tpu.models import BertConfig, build_bert
 
     cfg = FFConfig()
     cfg.batch_size = batch
     cfg.only_data_parallel = True
+    cfg.use_flash_attention = flash
     ff = FFModel(cfg)
     bcfg = BertConfig.base()
     bcfg.max_position = seq
@@ -57,7 +58,22 @@ def bench_bert(batch=16, seq=128, steps=20, warmup=3):
 
 
 def main():
-    value = bench_bert()
+    try:
+        value = bench_bert()
+    except Exception as e:
+        print(f"bench: default path failed ({e!r}); retrying with "
+              f"flash attention disabled", file=sys.stderr)
+        try:
+            value = bench_bert(flash="false")
+        except Exception as e2:
+            print(f"bench: fallback failed too ({e2!r})", file=sys.stderr)
+            value = None
+    if value is None:
+        # defensive: never leave the driver without a JSON line
+        print(json.dumps({
+            "metric": "bert_base_train_samples_per_sec_per_chip",
+            "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0}))
+        return
     baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")
     baseline = None

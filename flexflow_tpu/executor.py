@@ -63,8 +63,14 @@ class GraphProgram:
         values (used for logits extraction by the loss)."""
         env: Dict[int, Any] = {}
         for t in self.input_tensors:
-            assert t.name in inputs, f"missing input {t.name}"
-            env[t.guid] = inputs[t.name]
+            if t.name in inputs:
+                env[t.guid] = inputs[t.name]
+            elif t.get_tensor() is not None:
+                # constant input (create_constant / frontend const folding):
+                # baked into the jitted program at trace time
+                env[t.guid] = jnp.asarray(t.get_tensor(), to_jnp(t.dtype))
+            else:
+                raise KeyError(f"missing input {t.name}")
         for layer in self.layers:
             op = get_op_def(layer.op_type)
             ins = [env[t.guid] for t in layer.inputs]

@@ -28,6 +28,36 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+class ConstValue:
+    """Host-side constant flowing through the fx graph (shape bookkeeping,
+    attention-mask arithmetic, buffer slices). Ops whose inputs are all
+    constants are folded eagerly with torch itself; a constant is
+    materialized into an FF constant tensor only when it meets a real
+    graph tensor (the reference frontend's Node-attribute equivalent)."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = np.asarray(arr)
+
+    @property
+    def shape(self):
+        return tuple(self.arr.shape)
+
+    def __repr__(self):
+        return f"ConstValue{self.shape}"
+
+
+def _has_graph_tensor(x) -> bool:
+    if isinstance(x, Tensor):
+        return True
+    if isinstance(x, (list, tuple)):
+        return any(_has_graph_tensor(v) for v in x)
+    if isinstance(x, dict):
+        return any(_has_graph_tensor(v) for v in x.values())
+    return False
+
+
 class PyTorchModel:
     def __init__(self, module, is_hf_model: bool = False,
                  batch_size: int = 1):
@@ -60,8 +90,12 @@ class PyTorchModel:
         def val(x):
             if isinstance(x, torch.fx.Node):
                 return env[x.name]
+            if isinstance(x, slice):
+                return slice(val(x.start), val(x.stop), val(x.step))
             if isinstance(x, (list, tuple)):
                 return type(x)(val(v) for v in x)
+            if isinstance(x, dict):
+                return {k: val(v) for k, v in x.items()}
             return x
 
         for node in gm.graph.nodes:
@@ -69,27 +103,98 @@ class PyTorchModel:
                 env[node.name] = inputs.pop(0)
             elif node.op == "get_attr":
                 t = self._get_attr(gm, node.target)
-                const = ff.create_tensor(tuple(t.shape), create_grad=False,
-                                         name=node.name)
-                const.set_tensor(t.detach().cpu().numpy())
-                env[node.name] = const
+                env[node.name] = ConstValue(t.detach().cpu().numpy())
             elif node.op == "call_module":
                 m = modules[node.target]
-                env[node.name] = self._module_to_ff(
-                    ff, m, node, [val(a) for a in node.args])
+                a = [self._ensure_tensor(ff, val(x), f"{node.name}_c{i}")
+                     for i, x in enumerate(node.args)]
+                env[node.name] = self._module_to_ff(ff, m, node, a)
             elif node.op == "call_function":
-                env[node.name] = self._function_to_ff(
-                    ff, node.target, node, [val(a) for a in node.args],
-                    {k: val(v) for k, v in node.kwargs.items()})
+                a = [val(x) for x in node.args]
+                kw = {k: val(v) for k, v in node.kwargs.items()}
+                if not (_has_graph_tensor(a) or _has_graph_tensor(kw)):
+                    env[node.name] = self._eager(node.target, a, kw)
+                else:
+                    env[node.name] = self._function_to_ff(ff, node.target,
+                                                          node, a, kw)
             elif node.op == "call_method":
-                env[node.name] = self._method_to_ff(
-                    ff, node.target, node, [val(a) for a in node.args],
-                    {k: val(v) for k, v in node.kwargs.items()})
+                a = [val(x) for x in node.args]
+                kw = {k: val(v) for k, v in node.kwargs.items()}
+                if not (_has_graph_tensor(a) or _has_graph_tensor(kw)):
+                    env[node.name] = self._eager_method(node.target, a, kw)
+                else:
+                    env[node.name] = self._method_to_ff(ff, node.target,
+                                                        node, a, kw)
             elif node.op == "output":
                 out = val(node.args[0])
+                if isinstance(out, dict):
+                    out = [v for v in out.values()
+                           if isinstance(v, Tensor)]
                 outputs = list(out) if isinstance(out, (list, tuple)) \
                     else [out]
+                outputs = [o for o in outputs if isinstance(o, Tensor)]
         return outputs
+
+    # ------------------------------------------------------------------
+    # const folding
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _to_torch(v):
+        import torch
+        if isinstance(v, ConstValue):
+            return torch.from_numpy(np.ascontiguousarray(v.arr))
+        if isinstance(v, (list, tuple)):
+            return type(v)(PyTorchModel._to_torch(x) for x in v)
+        return v
+
+    @staticmethod
+    def _from_torch(r):
+        import torch
+        if isinstance(r, torch.Tensor):
+            return ConstValue(r.detach().cpu().numpy())
+        if isinstance(r, (list, tuple)) and not isinstance(r, torch.Size):
+            return type(r)(PyTorchModel._from_torch(x) for x in r)
+        return r
+
+    def _eager(self, fn, args, kwargs):
+        """Fold a call_function over constants by just calling it."""
+        return self._from_torch(fn(*self._to_torch(tuple(args)),
+                                   **{k: self._to_torch(v)
+                                      for k, v in kwargs.items()}))
+
+    def _eager_method(self, method, args, kwargs):
+        import torch
+        obj, rest = args[0], args[1:]
+        tobj = self._to_torch(obj)
+        # python-level objects (tuples from size(), ints) pass through
+        if not isinstance(tobj, torch.Tensor) and not hasattr(tobj, method):
+            raise NotImplementedError(f"eager method {method} on {tobj!r}")
+        r = getattr(tobj, method)(*self._to_torch(tuple(rest)),
+                                  **{k: self._to_torch(v)
+                                     for k, v in kwargs.items()})
+        return self._from_torch(r)
+
+    def _ensure_tensor(self, ff: FFModel, v, name: str):
+        """Materialize a constant into an FF constant input tensor."""
+        if isinstance(v, ConstValue):
+            arr = v.arr
+            dt = {np.dtype("int64"): DataType.DT_INT32,
+                  np.dtype("int32"): DataType.DT_INT32,
+                  np.dtype("bool"): DataType.DT_INT32,
+                  np.dtype("float64"): DataType.DT_FLOAT,
+                  }.get(arr.dtype, DataType.DT_FLOAT)
+            if arr.dtype in (np.dtype("int64"), np.dtype("bool")):
+                arr = arr.astype(np.int32)
+            elif arr.dtype == np.dtype("float64"):
+                arr = arr.astype(np.float32)
+            t = ff.create_tensor(tuple(arr.shape), dtype=dt,
+                                 create_grad=False, name=name)
+            t.set_tensor(arr)
+            return t
+        if isinstance(v, (list, tuple)):
+            return type(v)(self._ensure_tensor(ff, x, f"{name}_{i}")
+                           for i, x in enumerate(v))
+        return v
 
     @staticmethod
     def _get_attr(gm, target: str):
@@ -192,11 +297,27 @@ class PyTorchModel:
                               name] = ff.layers[-1].name
         return out
 
+    def _prep(self, ff, v, name, i):
+        """Graph-op operand prep: 0-d constants become python scalars,
+        array constants become FF constant tensors."""
+        if isinstance(v, ConstValue):
+            if v.arr.ndim == 0:
+                return v.arr.item()
+            return self._ensure_tensor(ff, v, f"{name}_c{i}")
+        if isinstance(v, (list, tuple)) and any(
+                isinstance(x, ConstValue) for x in v):
+            return type(v)(self._prep(ff, x, name, f"{i}_{j}")
+                           for j, x in enumerate(v))
+        return v
+
     # ------------------------------------------------------------------
     def _function_to_ff(self, ff: FFModel, fn, node, args, kwargs):
         import torch
         import torch.nn.functional as F
         name = node.name
+        raw_args, raw_kwargs = list(args), dict(kwargs)
+        args = [self._prep(ff, a, name, i) for i, a in enumerate(args)]
+        kwargs = {k: self._prep(ff, v, name, k) for k, v in kwargs.items()}
         if fn in (operator.add, torch.add):
             return self._bin(ff, ff.add, args, name)
         if fn in (operator.sub, torch.sub):
@@ -207,6 +328,45 @@ class PyTorchModel:
             return self._bin(ff, ff.divide, args, name)
         if fn in (torch.matmul, torch.bmm):
             return ff.batch_matmul(args[0], args[1], name=name)
+        if fn is F.scaled_dot_product_attention:
+            # (b, h, s, d) SDPA — lowered to the same op chain the
+            # reference's attention uses (scores/softmax/context matmuls);
+            # the MHA op path uses the Pallas flash kernel instead when the
+            # module-level nn.MultiheadAttention is traced
+            q, k, v = args[0], args[1], args[2]
+            # positional order: (q, k, v, attn_mask, dropout_p, is_causal)
+            # — use RAW values so a bool ConstValue mask keeps its dtype
+            attn_mask = raw_kwargs.get(
+                "attn_mask", raw_args[3] if len(raw_args) > 3 else None)
+            dropout_p = raw_kwargs.get(
+                "dropout_p", raw_args[4] if len(raw_args) > 4 else 0.0)
+            is_causal = raw_kwargs.get(
+                "is_causal", raw_args[5] if len(raw_args) > 5 else False)
+            scale = kwargs.get("scale") or 1.0 / math.sqrt(q.shape[-1])
+            r = len(k.shape)
+            perm = list(range(r))
+            perm[-1], perm[-2] = perm[-2], perm[-1]
+            kt = ff.transpose(k, perm, name=f"{name}_kT")
+            scores = ff.scalar_multiply(
+                ff.batch_matmul(q, kt, name=f"{name}_qk"), float(scale))
+            if is_causal:
+                s_q, s_k = q.shape[-2], k.shape[-2]
+                mask = np.triu(np.full((s_q, s_k), -1e9, np.float32), 1)
+                scores = ff.add(scores, self._ensure_tensor(
+                    ff, ConstValue(mask), f"{name}_causal"))
+            if attn_mask is not None:
+                if isinstance(attn_mask, ConstValue):
+                    # torch bool mask = keep-where-True; float = additive
+                    if attn_mask.arr.dtype == np.dtype("bool"):
+                        attn_mask = ConstValue(np.where(
+                            attn_mask.arr, 0.0, -1e9).astype(np.float32))
+                    attn_mask = self._ensure_tensor(ff, attn_mask,
+                                                    f"{name}_mask")
+                scores = ff.add(scores, attn_mask, name=f"{name}_masked")
+            probs = ff.softmax(scores, axis=-1, name=f"{name}_probs")
+            if dropout_p:
+                probs = ff.dropout(probs, dropout_p, name=f"{name}_drop")
+            return ff.batch_matmul(probs, v, name=f"{name}_ctx")
         if fn is F.relu or fn is torch.relu:
             return ff.relu(args[0], name=name)
         if fn is F.gelu:
@@ -256,6 +416,11 @@ class PyTorchModel:
                 return x[idx]
             return self._getitem_tensor(ff, x, idx, name)
         if fn is getattr:
+            if args[1] == "device":
+                return None  # host bookkeeping; FF placement is global
+            if args[1] == "dtype":
+                import torch as _t
+                return _t.float32  # mask finfo() etc.; FF dtypes are global
             return getattr(args[0], args[1])
         raise NotImplementedError(f"torch function {fn} not supported")
 
@@ -297,7 +462,21 @@ class PyTorchModel:
     # ------------------------------------------------------------------
     def _method_to_ff(self, ff: FFModel, method: str, node, args, kwargs):
         name = node.name
+        args = [self._prep(ff, a, name, i) for i, a in enumerate(args)]
+        kwargs = {k: self._prep(ff, v, name, k) for k, v in kwargs.items()}
         x = args[0]
+        if method == "to" or method == "type_as":
+            # dtype cast; FF tensors stay in their graph dtype (bf16/f32
+            # policy handled by emission), so this is an identity
+            return x
+        if method == "expand":
+            sizes = [x.shape[d] if s == -1 else s
+                     for d, s in enumerate(args[1:])]
+            if tuple(sizes) == tuple(x.shape):
+                return x
+            raise NotImplementedError("expand to new shape on graph tensor")
+        if method == "float":
+            return x
         if method == "view" or method == "reshape":
             shape = args[1:] if not isinstance(args[1], (list, tuple)) \
                 else list(args[1])

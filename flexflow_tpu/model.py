@@ -469,9 +469,17 @@ class FFModel:
         # and the label tensor (created but unconsumed) — reference compile
         # creates the label tensor itself (model.cc:3086).
         consumed = {t.guid for l in self.layers for t in l.inputs}
+        # constants (attached host values) are baked in at trace time, not
+        # fed per batch
         self.graph_inputs = [t for t in self.input_tensors
-                             if t.guid in consumed]
-        unconsumed = [t for t in self.input_tensors if t.guid not in consumed]
+                             if t.guid in consumed
+                             and t.get_tensor() is None]
+        self.const_inputs = [t for t in self.input_tensors
+                             if t.guid in consumed
+                             and t.get_tensor() is not None]
+        unconsumed = [t for t in self.input_tensors
+                      if t.guid not in consumed
+                      and t.get_tensor() is None]
         if self.label_tensor is None and len(unconsumed) == 1:
             self.label_tensor = unconsumed[0]
 
@@ -494,7 +502,8 @@ class FFModel:
 
         # label tensor adopts the final op's batch sharding
         # (reference model.cc:3086-3124)
-        program = GraphProgram(exec_layers, self.graph_inputs,
+        program = GraphProgram(exec_layers,
+                               self.graph_inputs + self.const_inputs,
                                exec_outputs)
         self.executor = Executor(program, self.config, self.dmesh,
                                  self.strategy, self.optimizer,

@@ -406,9 +406,14 @@ class MultiHeadAttentionOp(OpDef):
         return ws
 
     @staticmethod
-    def _flash_enabled(ctx) -> bool:
-        mode = getattr(getattr(ctx, "config", None), "use_flash_attention",
+    def _flash_mode(ctx) -> str:
+        """Resolved flash-attention mode: "true" | "false" | "auto"."""
+        return getattr(getattr(ctx, "config", None), "use_flash_attention",
                        "auto")
+
+    @classmethod
+    def _flash_enabled(cls, ctx) -> bool:
+        mode = cls._flash_mode(ctx)
         if mode == "false":
             return False
         if mode == "true":
@@ -437,14 +442,17 @@ class MultiHeadAttentionOp(OpDef):
         rate = params.get("dropout", 0.0) if ctx.training else 0.0
 
         causal = params.get("causal", False)
+        flash_mode = self._flash_mode(ctx)
         if self._flash_enabled(ctx) \
                 and not (causal and qh.shape[1] != kh.shape[1]):
             # Pallas flash kernel ((b,h,s,d) layout); in-kernel prob dropout
             # only when compiled on TPU — interpret mode falls back to XLA.
             # (causal cross-attention with sq != sk stays on the XLA path.)
+            # In "auto" mode the dropout>0 case stays on XLA (the in-kernel
+            # PRNG path is opt-in via use_flash_attention="true").
             from ..kernels import flash_attention
             on_tpu = jax.default_backend() == "tpu"
-            if rate > 0.0 and not on_tpu:
+            if rate > 0.0 and (not on_tpu or flash_mode != "true"):
                 pass  # fall through to the XLA path below
             else:
                 seed = None

@@ -90,7 +90,8 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
         from .tasksim import TaskGraphEvaluator
         evaluator_cls = TaskGraphEvaluator
     info, strategy, gc, graph = unity_search(
-        ff.layers, ff.graph_inputs, [ff._output_tensor], dmesh, cost_model,
+        ff.layers, ff.graph_inputs + getattr(ff, "const_inputs", []),
+        [ff._output_tensor], dmesh, cost_model,
         budget=budget, alpha=max(cfg.search_alpha, 1.0 + 1e-6),
         mem_budget_bytes=mem_budget,
         base_optimize_threshold=max(cfg.base_optimize_threshold, 2),
@@ -105,8 +106,10 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
             f.write(graph.to_dot())
     if cfg.export_strategy_file:
         from .serialization import program_to_json
-        prog_doc = program_to_json(info.layers, ff.graph_inputs,
-                                   info.output_tensors[0])
+        prog_doc = program_to_json(
+            info.layers,
+            ff.graph_inputs + getattr(ff, "const_inputs", []),
+            info.output_tensors[0])
         save_strategy(cfg.export_strategy_file, strategy, None,
                       {"best_cost": gc.total}, program=prog_doc)
     return strategy, info
@@ -125,5 +128,6 @@ def _import_strategy(ff, path: str, dmesh):
     prog_doc = doc.get("program")
     if not prog_doc:
         return strategy, None
-    layers, out_t = program_from_json(prog_doc, ff.graph_inputs)
+    layers, out_t = program_from_json(
+        prog_doc, ff.graph_inputs + getattr(ff, "const_inputs", []))
     return strategy, GraphProgramInfo(layers, {}, [out_t])

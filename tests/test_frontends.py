@@ -223,3 +223,38 @@ def test_lr_scheduler_takes_effect():
     model.fit(xs, ys, epochs=1, verbose=False)  # lr now 0 via scheduler
     w3 = model.ffmodel.get_weights(model.ffmodel.layers[0].name).copy()
     assert np.allclose(w2, w3)
+
+
+def test_torch_fx_huggingface_bert():
+    """Import a real HF BertModel through fx (reference
+    ``python/flexflow/torch/model.py`` HF path), copy weights, and match
+    torch numerics — exercises const folding of the mask/position-id
+    machinery and the SDPA lowering."""
+    import numpy as np
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import BertConfig as HFBertConfig, BertModel
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+    tcfg = HFBertConfig(vocab_size=128, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        intermediate_size=64, max_position_embeddings=64)
+    m = BertModel(tcfg)
+    pm = PyTorchModel(m, is_hf_model=True, batch_size=2)
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    ids = ff.create_tensor((2, 16), dtype="int32", name="input_ids")
+    outs = pm.torch_to_ff(ff, [ids])
+    assert outs[0].shape == (2, 16, 32)
+    ff.compile(SGDOptimizer(0.01), "identity", [], output_tensor=outs[0])
+    pm.copy_weights(ff)
+    x = np.random.default_rng(0).integers(0, 128, size=(2, 16)) \
+        .astype(np.int32)
+    y = np.asarray(ff.executor.make_forward()(ff.params, ff.state,
+                                              {"input_ids": x}))
+    with torch.no_grad():
+        ref = m(input_ids=torch.from_numpy(x.astype(np.int64))) \
+            .last_hidden_state.numpy()
+    np.testing.assert_allclose(y, ref, atol=5e-3, rtol=5e-3)

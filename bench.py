@@ -1,39 +1,143 @@
 """Benchmark harness: prints ONE JSON line
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``.
 
-Measures the flagship training throughput (BERT-base train step,
-samples/sec/chip) on the available device(s). ``vs_baseline`` follows the
-reference's methodology (BASELINE.md): the ratio of the current strategy's
-throughput to pure data-parallel on the same hardware — on a single chip
-the canonical strategy IS data-parallel, so the ratio is computed against
-a stored reference measurement when present (bench_baseline.json), else
-against itself (1.0).
+Staged-with-deadlines design (round-1 postmortem: the ambient TPU plugin
+can fail or hang during backend init, and a hang here must never eat the
+driver's whole budget):
+
+  - every stage runs in a **subprocess** with its own hard timeout and
+    process-group kill, so a wedged XLA client cannot hang the parent;
+  - stage 1 probes backend init; on failure/timeout the bench falls back
+    to the CPU platform rather than dying;
+  - stage 2 runs a tiny-MLP smoke step before committing to the flagship;
+  - stage 3 runs the flagship (BERT-base train step, data-parallel);
+  - stage 4 runs the Unity-searched strategy (budget >= 8) for the
+    reference's searched-vs-DP A/B methodology
+    (/root/reference/scripts/osdi22ae/bert.sh:3-7);
+  - the parent ALWAYS emits the JSON line, with an "error" field when
+    something failed.
+
+``value`` is the best measured throughput (searched if it wins, else DP);
+``vs_baseline`` is the measured searched/DP ratio on the same hardware —
+the reference's own A/B metric. Extra fields: dp_sps, searched_sps,
+flash_off_sps, mfu, platform, n_devices, search_time_s, error.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
-import numpy as np
+METRIC = "bert_base_train_samples_per_sec_per_chip"
+HERE = os.path.dirname(os.path.abspath(__file__))
+RESULT_TAG = "@RESULT "
 
 
-def bench_bert(batch=16, seq=128, steps=20, warmup=3, flash="auto"):
+# ======================================================================
+# child stages (each runs in its own subprocess)
+# ======================================================================
+
+def _emit(obj):
+    print(RESULT_TAG + json.dumps(obj), flush=True)
+
+
+def _apply_platform_env():
+    """The ambient TPU plugin ignores JAX_PLATFORMS; when the parent asks
+    for CPU, force it through jax.config too (same fix as
+    tests/conftest.py)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _sync_fetch(x):
+    """Device->host fetch: block_until_ready does not synchronize on
+    tunneled TPU backends; a value fetch does."""
+    import numpy as np
+    return float(np.asarray(x))
+
+
+def stage_probe():
+    _apply_platform_env()
+    import jax
+    devs = jax.devices()
+    _emit({"platform": jax.default_backend(), "n": len(devs),
+           "device_kind": devs[0].device_kind})
+
+
+def stage_smoke():
+    """Tiny MLP, 3 train steps — proves compile+execute works before the
+    flagship commits minutes to it."""
+    _apply_platform_env()
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 8, in_dim=32, hidden=(64,), num_classes=10)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    rng = np.random.default_rng(0)
+    b = {"input": rng.normal(size=(8, 32)).astype(np.float32),
+         "label": rng.integers(0, 10, size=(8, 1)).astype(np.int32)}
+    step = ff.executor.make_train_step()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        bm = ff._run_train_step(step, b)
+    loss = _sync_fetch(bm["loss"])
+    assert np.isfinite(loss), loss
+    _emit({"smoke_s": round(time.perf_counter() - t0, 3)})
+
+
+def _train_flops_per_step(ff) -> float:
+    """Analytic fwd+bwd FLOPs of one train step (for MFU)."""
+    from flexflow_tpu.ffconst import OperatorType
+    from flexflow_tpu.ops import get_op_def
+    total = 0.0
+    layers = getattr(ff.executor.program, "layers", ff.layers)
+    for l in layers:
+        if l.op_type == OperatorType.OP_INPUT:
+            continue
+        op = get_op_def(l.op_type)
+        f = op.flops(l.params, [t.shape for t in l.inputs],
+                     [t.shape for t in l.outputs])
+        total += f * (1.0 + op.backward_flops_factor())
+    return total
+
+
+def stage_bert(flash: str, searched: bool, budget: int, steps: int,
+               batch: int, seq: int):
+    _apply_platform_env()
+    import numpy as np
+    import jax
     from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
     from flexflow_tpu.models import BertConfig, build_bert
+    from flexflow_tpu.parallel.machine import MachineSpec
 
     cfg = FFConfig()
     cfg.batch_size = batch
-    cfg.only_data_parallel = True
     cfg.use_flash_attention = flash
+    if searched:
+        cfg.only_data_parallel = False
+        cfg.search_budget = max(budget, 8)
+    else:
+        cfg.only_data_parallel = True
     ff = FFModel(cfg)
     bcfg = BertConfig.base()
     bcfg.max_position = seq
     bcfg.dropout = 0.1
     out = build_bert(ff, batch, seq, bcfg)
+    t_search0 = time.perf_counter()
     ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
                output_tensor=out)
+    search_time = time.perf_counter() - t_search0
     rng = np.random.default_rng(0)
     b = {"input_ids": rng.integers(0, bcfg.vocab_size,
                                    size=(batch, seq)).astype(np.int32),
@@ -41,56 +145,204 @@ def bench_bert(batch=16, seq=128, steps=20, warmup=3, flash="auto"):
                                  (batch, 1)),
          "label": rng.integers(0, 2, size=(batch, 1)).astype(np.int32)}
     step = ff.executor.make_train_step()
-    for _ in range(warmup):
+    for _ in range(3):
         bm = ff._run_train_step(step, b)
-    # NOTE: block_until_ready does not synchronize on tunneled TPU
-    # backends; a device-to-host value fetch does. The chained params
-    # dependency forces all steps to complete before the final loss.
-    float(np.asarray(bm["loss"]))
-    import jax
+    _sync_fetch(bm["loss"])  # compile + sync
     t0 = time.perf_counter()
     for _ in range(steps):
         bm = ff._run_train_step(step, b)
-    float(np.asarray(bm["loss"]))
+    _sync_fetch(bm["loss"])
     dt = time.perf_counter() - t0
     n_chips = max(1, len(jax.devices()))
-    return batch * steps / dt / n_chips
+    sps = batch * steps / dt / n_chips
+    spec = MachineSpec.detect()
+    flops_step = _train_flops_per_step(ff)
+    mfu = flops_step * (steps / dt) / (spec.peak_flops * n_chips)
+    _emit({"sps": round(sps, 3), "mfu": round(mfu, 4),
+           "flops_per_step": flops_step, "n_chips": n_chips,
+           "search_time_s": round(search_time, 2),
+           "generation": spec.generation})
+
+
+# ======================================================================
+# parent orchestration
+# ======================================================================
+
+def _run_stage(stage_args, timeout, extra_env=None):
+    """Run `python bench.py --stage ...` in its own process group with a
+    hard deadline; returns (result_dict | None, error | None)."""
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, os.path.abspath(__file__)] + stage_args
+    try:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env,
+                                start_new_session=True, text=True)
+        try:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            return None, f"timeout after {timeout:.0f}s"
+        for line in reversed(out.splitlines()):
+            if line.startswith(RESULT_TAG):
+                return json.loads(line[len(RESULT_TAG):]), None
+        tail = (err.strip().splitlines() or ["<no stderr>"])[-1][:300]
+        return None, f"rc={proc.returncode}: {tail}"
+    except Exception as e:  # noqa: BLE001 — bench must never crash
+        return None, repr(e)
 
 
 def main():
-    try:
-        value = bench_bert()
-    except Exception as e:
-        print(f"bench: default path failed ({e!r}); retrying with "
-              f"flash attention disabled", file=sys.stderr)
-        try:
-            value = bench_bert(flash="false")
-        except Exception as e2:
-            print(f"bench: fallback failed too ({e2!r})", file=sys.stderr)
-            value = None
-    if value is None:
-        # defensive: never leave the driver without a JSON line
-        print(json.dumps({
-            "metric": "bert_base_train_samples_per_sec_per_chip",
-            "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0}))
-        return
-    baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "bench_baseline.json")
-    baseline = None
-    if os.path.exists(baseline_file):
-        try:
-            with open(baseline_file) as f:
-                baseline = json.load(f).get("bert_base_train_sps")
-        except Exception:
-            baseline = None
-    vs = value / baseline if baseline else 1.0
-    print(json.dumps({
-        "metric": "bert_base_train_samples_per_sec_per_chip",
-        "value": round(value, 3),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(vs, 4),
-    }))
+    t_start = time.time()
+    deadline = float(os.environ.get("BENCH_DEADLINE_S", "1200"))
+
+    def remaining():
+        return deadline - (time.time() - t_start)
+
+    def budget(cap):
+        """Stage timeout honoring the global deadline; None = out of
+        time (the caller must emit the JSON line and stop)."""
+        r = remaining()
+        return None if r < 45 else min(cap, r)
+
+    errors = []
+    out = {"metric": METRIC, "value": 0.0, "unit": "samples/sec/chip",
+           "vs_baseline": 0.0}
+    cpu_env = {"JAX_PLATFORMS": "cpu"}
+    env = None  # default platform first
+
+    def bail():
+        if errors:
+            out["error"] = "; ".join(errors)
+        print(json.dumps(out))
+
+    def stage(args, cap, env_):
+        """Run a stage within the global deadline; (None, reason) when
+        the deadline leaves no room."""
+        t = budget(cap)
+        if t is None:
+            return None, "global deadline exhausted"
+        return _run_stage(args, t, env_)
+
+    # -- stage 1: backend probe ---------------------------------------
+    probe, err = stage(["--stage", "probe"], 240, None)
+    if probe is None:
+        errors.append(f"probe(default): {err}")
+        probe, err = stage(["--stage", "probe"], 120, cpu_env)
+        env = cpu_env
+        if probe is None:
+            errors.append(f"probe(cpu): {err}")
+            return bail()
+    out["platform"] = probe["platform"]
+    out["n_devices"] = probe["n"]
+
+    # -- stage 2: smoke ------------------------------------------------
+    smoke, err = stage(["--stage", "smoke"], 300, env)
+    if smoke is None:
+        errors.append(f"smoke({out['platform']}): {err}")
+        if env is not None:
+            return bail()
+        # TPU path broken mid-run: fall back to CPU (re-probe so
+        # platform/n_devices reflect what the numbers were measured on)
+        env = cpu_env
+        probe, err = stage(["--stage", "probe"], 120, cpu_env)
+        if probe is None:
+            errors.append(f"probe(cpu): {err}")
+            return bail()
+        out["platform"] = probe["platform"]
+        out["n_devices"] = probe["n"]
+        smoke, err = stage(["--stage", "smoke"], 240, env)
+        if smoke is None:
+            errors.append(f"smoke(cpu): {err}")
+            return bail()
+
+    # -- stage 3: flagship, data-parallel -----------------------------
+    # CPU fallback runs a reduced config so stages fit their deadlines;
+    # the JSON line carries platform so the number is interpretable
+    if out["platform"] == "cpu":
+        bert_args = ["--stage", "bert", "--steps", "5", "--batch", "8",
+                     "--seq", "64"]
+    else:
+        bert_args = ["--stage", "bert", "--steps", "20"]
+    dp, err = stage(bert_args + ["--flash", "auto"], 600, env)
+    flash_used = "auto"
+    if dp is None:
+        errors.append(f"bert(flash=auto): {err}")
+        dp, err = stage(bert_args + ["--flash", "false"], 480, env)
+        flash_used = "false"
+        if dp is None:
+            errors.append(f"bert(flash=false): {err}")
+            return bail()
+    out["dp_sps"] = dp["sps"]
+    out["mfu"] = dp["mfu"]
+    out["flash"] = flash_used
+
+    # -- stage 4: flash-off A/B data point ----------------------------
+    if flash_used == "auto" and remaining() > 420:
+        foff, err = stage(bert_args + ["--flash", "false"], 420, env)
+        if foff is not None:
+            out["flash_off_sps"] = foff["sps"]
+        else:
+            errors.append(f"bert(flash-off point): {err}")
+
+    # -- stage 5: searched strategy A/B (reference osdi22ae method) ---
+    if remaining() > 420:
+        srch, err = stage(
+            bert_args + ["--flash", flash_used, "--searched",
+                         "--budget", "8"], 600, env)
+        if srch is not None:
+            out["searched_sps"] = srch["sps"]
+            out["search_time_s"] = srch["search_time_s"]
+        else:
+            errors.append(f"bert(searched): {err}")
+
+    dp_sps = out["dp_sps"]
+    srch_sps = out.get("searched_sps")
+    out["value"] = max(dp_sps, srch_sps) if srch_sps else dp_sps
+    # measured A/B ratio (searched vs DP, same hardware, same run);
+    # falls back to the stored same-methodology baseline when the
+    # searched leg did not run
+    if srch_sps:
+        out["vs_baseline"] = round(srch_sps / dp_sps, 4)
+    else:
+        # stored baseline was measured on TPU; comparing a CPU-fallback
+        # number against it would be meaningless
+        baseline = None
+        if out["platform"] != "cpu":
+            try:
+                with open(os.path.join(HERE, "bench_baseline.json")) as f:
+                    baseline = json.load(f).get("bert_base_train_sps")
+            except Exception:
+                pass
+        out["vs_baseline"] = round(out["value"] / baseline, 4) \
+            if baseline else 1.0
+    if errors:
+        out["error"] = "; ".join(errors)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", default=None)
+    ap.add_argument("--flash", default="auto")
+    ap.add_argument("--searched", action="store_true")
+    ap.add_argument("--budget", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=128)
+    a = ap.parse_args()
+    if a.stage is None:
+        main()
+    elif a.stage == "probe":
+        stage_probe()
+    elif a.stage == "smoke":
+        stage_smoke()
+    elif a.stage == "bert":
+        stage_bert(a.flash, a.searched, a.budget, a.steps, a.batch, a.seq)
+    else:
+        raise SystemExit(f"unknown stage {a.stage!r}")

@@ -63,6 +63,14 @@ class FFConfig:
     include_costs_dot_graph: bool = False
     # -------- TPU-native --------
     mesh_shape: Optional[Sequence[int]] = None     # explicit ICI mesh, else auto
+    # pipeline parallelism through the product path (reference reserves
+    # OP_PIPELINE, ffconst.h:159, with no implementation): partition the
+    # maximal repeated-block region into this many GPipe stages
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0                 # 0 = 2 * stages
+    # let the search score a pipeline candidate (bubble model) against the
+    # searched sharding strategy and pick the winner
+    enable_pipeline_search: bool = False
     use_bf16_compute: bool = True                  # matmuls in bf16, fp32 accum
     # "auto": Pallas flash attention when compiled on TPU; "true": always
     # (interpret mode off-TPU — slow, test-only); "false": plain XLA attention
@@ -195,6 +203,12 @@ class FFConfig:
                 cfg.num_nodes = int(take())
             elif a == "--mesh-shape":
                 cfg.mesh_shape = tuple(int(x) for x in take().split("x"))
+            elif a in ("--pp", "--pipeline-stages"):
+                cfg.pipeline_stages = int(take())
+            elif a in ("--num-microbatches", "--pipeline-microbatches"):
+                cfg.pipeline_microbatches = int(take())
+            elif a == "--enable-pipeline-search":
+                cfg.enable_pipeline_search = True
             elif a == "--seed":
                 cfg.seed = int(take())
             # unknown flags: skip (reference forwards to Legion)

@@ -56,11 +56,7 @@ class GraphProgram:
         self.input_tensors = list(input_tensors)
         self.output_tensors = list(output_tensors)
 
-    def emit(self, params: Dict[str, Dict[str, Any]], inputs: Dict[str, Any],
-             ctx: EmitCtx, strategy: Optional[ShardingStrategy] = None,
-             capture: Optional[Dict[int, Any]] = None) -> List[Any]:
-        """Interpret the graph. `capture[tensor.guid]` collects intermediate
-        values (used for logits extraction by the loss)."""
+    def init_env(self, inputs: Dict[str, Any]) -> Dict[int, Any]:
         env: Dict[int, Any] = {}
         for t in self.input_tensors:
             if t.name in inputs:
@@ -71,7 +67,14 @@ class GraphProgram:
                 env[t.guid] = jnp.asarray(t.get_tensor(), to_jnp(t.dtype))
             else:
                 raise KeyError(f"missing input {t.name}")
-        for layer in self.layers:
+        return env
+
+    def emit_layers(self, layers: Sequence[Layer],
+                    env: Dict[int, Any],
+                    params: Dict[str, Dict[str, Any]], ctx: EmitCtx,
+                    strategy: Optional[ShardingStrategy] = None,
+                    capture: Optional[Dict[int, Any]] = None) -> None:
+        for layer in layers:
             op = get_op_def(layer.op_type)
             ins = [env[t.guid] for t in layer.inputs]
             w = params.get(layer.name, {})
@@ -85,6 +88,14 @@ class GraphProgram:
                 env[t.guid] = o
                 if capture is not None:
                     capture[t.guid] = o
+
+    def emit(self, params: Dict[str, Dict[str, Any]], inputs: Dict[str, Any],
+             ctx: EmitCtx, strategy: Optional[ShardingStrategy] = None,
+             capture: Optional[Dict[int, Any]] = None) -> List[Any]:
+        """Interpret the graph. `capture[tensor.guid]` collects intermediate
+        values (used for logits extraction by the loss)."""
+        env = self.init_env(inputs)
+        self.emit_layers(self.layers, env, params, ctx, strategy, capture)
         return [env[t.guid] for t in self.output_tensors]
 
 
@@ -103,6 +114,12 @@ class Executor:
         self.seed = seed
         self._train_step = None
         self._eval_step = None
+        # pipeline region (parallel/pipeline_lowering): pre/post layer
+        # split + GPipe lowering of the repeated-block region
+        self.pipe = getattr(strategy, "pipeline", None)
+        if self.pipe is not None:
+            self._pre_layers = program.layers[:self.pipe.start]
+            self._post_layers = program.layers[self.pipe.end:]
         # CE-on-logits fusion: if the final op is Softmax, take its input as
         # logits (grad identical to the reference's (probs-labels)/B kernel).
         self._logits_tensor: Optional[Tensor] = None
@@ -122,7 +139,14 @@ class Executor:
             rng = jax.random.key(self.seed)
         params: Dict[str, Dict[str, Any]] = {}
         state: Dict[str, Dict[str, Any]] = {}
+        region_names = set()
+        if self.pipe is not None:
+            region_names = {l.name for l in self.program.layers[
+                self.pipe.start:self.pipe.end]}
+            params.update(self._init_pipeline_params(rng))
         for li, layer in enumerate(self.program.layers):
+            if layer.name in region_names:
+                continue  # initialized stacked, above
             op = get_op_def(layer.op_type)
             specs = layer.weights or op.weights(
                 layer.params, [t.shape for t in layer.inputs],
@@ -152,6 +176,95 @@ class Executor:
         return params, state
 
     # ------------------------------------------------------------------
+    # pipeline lowering (parallel/pipeline_lowering.PipelineRegion)
+    # ------------------------------------------------------------------
+    def _init_pipeline_params(self, rng):
+        """Stacked region params: for each template layer, one leaf of
+        shape (S,) + spec.shape — stage s initialized independently —
+        sharded P(pp_axis, ...) so each pipeline rank holds its stage."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        pipe = self.pipe
+        out: Dict[str, Dict[str, Any]] = {}
+        for lj, layer in enumerate(pipe.template):
+            op = get_op_def(layer.op_type)
+            specs = layer.weights or op.weights(
+                layer.params, [t.shape for t in layer.inputs],
+                [t.dtype for t in layer.inputs])
+            layer.weights = specs
+            if not specs:
+                continue
+            lp = {}
+            for wi, spec in enumerate(specs):
+                slices = []
+                for s in range(pipe.n_stages):
+                    k = jax.random.fold_in(jax.random.fold_in(
+                        jax.random.fold_in(rng, 7000 + lj), wi), s)
+                    slices.append(initialize(spec, k, to_jnp(spec.dtype)))
+                stacked = jnp.stack(slices)
+                sh = NamedSharding(
+                    self.dmesh.mesh,
+                    P(pipe.pp_axis, *([None] * len(spec.shape))))
+                lp[spec.name] = jax.device_put(stacked, sh)
+            out[pipe.param_name(layer)] = lp
+        return out
+
+    def _make_stage_fn(self, training: bool):
+        """stage_fn(params, x, t) interpreting the template chunk; params
+        is the squeezed (per-stage) subtree handed over by gpipe."""
+        pipe = self.pipe
+        template = pipe.template
+
+        def stage_fn(p, x, t):
+            rng_base = p.get("__rng__")
+            env = {pipe.template_entry_guid: x}
+            ctx = EmitCtx(training=training, rngs={}, state={},
+                          config=self.config)
+            for j, layer in enumerate(template):
+                if training and rng_base is not None and _needs_rng(layer):
+                    ctx.rngs[layer.name] = jax.random.fold_in(
+                        jax.random.fold_in(rng_base, t), j)
+                op = get_op_def(layer.op_type)
+                ins = [env[tt.guid] for tt in layer.inputs]
+                w = p.get(pipe.param_name(layer), {})
+                outs = op.emit(layer.params, ins, w, ctx, layer.name)
+                for o, tt in zip(outs, layer.outputs):
+                    env[tt.guid] = o
+            return env[pipe.template_exit_guid]
+
+        return stage_fn
+
+    def _pipe_apply(self, params, x, step, training: bool):
+        """Run the pipeline region: microbatch x, shard_map the GPipe
+        schedule over (dp, pp), return the region output (full batch)."""
+        from jax.sharding import PartitionSpec as P
+        from .parallel.pipeline import gpipe
+        pipe = self.pipe
+        S, M = pipe.n_stages, pipe.n_microbatches
+        stacked = {pipe.param_name(l): params[pipe.param_name(l)]
+                   for l in pipe.template
+                   if pipe.param_name(l) in params}
+        if training:
+            base = jax.random.fold_in(jax.random.key(self.seed + 2), step)
+            stage_keys = jax.vmap(
+                lambda i: jax.random.fold_in(base, i))(jnp.arange(S))
+            stacked = dict(stacked, __rng__=stage_keys)
+        assert x.shape[0] % M == 0, \
+            f"batch {x.shape[0]} not divisible into {M} microbatches"
+        xs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        engine = gpipe(self._make_stage_fn(training), pipe.pp_axis, M,
+                       with_step_arg=True)
+        param_specs = jax.tree.map(
+            lambda v: P(pipe.pp_axis, *([None] * (v.ndim - 1))), stacked)
+        dp = pipe.dp_axes if pipe.dp_axes else None
+        dp = dp[0] if dp is not None and len(dp) == 1 else dp
+        xs_spec = P(None, dp, *([None] * (xs.ndim - 2)))
+        fn = jax.shard_map(engine, mesh=self.dmesh.mesh,
+                           in_specs=(param_specs, xs_spec),
+                           out_specs=xs_spec, check_vma=False)
+        ys = fn(stacked, xs)
+        return ys.reshape((-1,) + ys.shape[2:])
+
+    # ------------------------------------------------------------------
     def _rngs_for_step(self, step):
         base = jax.random.key(self.seed + 1)
         base = jax.random.fold_in(base, step)
@@ -166,7 +279,20 @@ class Executor:
         ctx = EmitCtx(training=training, rngs=rngs, state=state,
                       config=self.config)
         capture: Dict[int, Any] = {}
-        outs = self.program.emit(params, batch, ctx, self.strategy, capture)
+        if self.pipe is None:
+            outs = self.program.emit(params, batch, ctx, self.strategy,
+                                     capture)
+        else:
+            env = self.program.init_env(batch)
+            self.program.emit_layers(self._pre_layers, env, params, ctx,
+                                     self.strategy, capture)
+            y = self._pipe_apply(params, env[self.pipe.entry_guid], step,
+                                 training)
+            env[self.pipe.exit_guid] = y
+            capture[self.pipe.exit_guid] = y
+            self.program.emit_layers(self._post_layers, env, params, ctx,
+                                     self.strategy, capture)
+            outs = [env[t.guid] for t in self.program.output_tensors]
         new_state = dict(state)
         for k, v in ctx.new_state.items():
             new_state[k] = v

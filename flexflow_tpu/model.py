@@ -484,15 +484,34 @@ class FFModel:
             self.label_tensor = unconsumed[0]
 
         spec = machine_spec or MachineSpec.detect()
-        self.dmesh = DeviceMesh(spec, mesh_shape=self.config.mesh_shape)
+        mesh_shape = self.config.mesh_shape
+        pp = self.config.pipeline_stages
+        if strategy is None and pp > 1 and mesh_shape is None:
+            # dp × pp mesh: last axis carries the pipeline stages
+            nd = spec.num_devices
+            assert nd % pp == 0, \
+                f"--pp {pp} does not divide {nd} devices"
+            mesh_shape = (nd // pp, pp) if nd > pp else (pp,)
+        self.dmesh = DeviceMesh(spec, mesh_shape=mesh_shape)
         if search_budget is not None:
             self.config.search_budget = search_budget
 
         exec_layers, exec_outputs = self.layers, [self._output_tensor]
+        if strategy is None and pp > 1:
+            # pipeline through the product path (reference reserves
+            # OP_PIPELINE, ffconst.h:159, without implementing it)
+            from .parallel.presets import pipeline_strategy
+            strategy = pipeline_strategy(
+                self.layers, self.graph_inputs, self.dmesh, n_stages=pp,
+                n_microbatches=self.config.pipeline_microbatches)
         if strategy is not None:
             self.strategy = strategy
         else:
             self.strategy, program_info = self._optimize_strategy()
+            if self.strategy.dmesh is not self.dmesh:
+                # the search chose a strategy on its own mesh layout
+                # (e.g. a (dp, S) pipeline mesh) — adopt it
+                self.dmesh = self.strategy.dmesh
             if program_info is not None:
                 # search rewrote the graph (inserted parallel ops) —
                 # reference convert_graph_to_operators (model.cc:2834)
@@ -517,7 +536,12 @@ class FFModel:
         """Strategy selection: search unless --only-data-parallel.
         Returns (strategy, program_info_or_None) — Unity search may rewrite
         the executable graph."""
-        if self.config.only_data_parallel or self.dmesh.num_devices == 1 \
+        # On one device the search still matters when a budget is set
+        # explicitly: algebraic substitutions (fusions/eliminations) can
+        # rewrite the graph even without parallelism choices.
+        single_no_budget = (self.dmesh.num_devices == 1
+                            and self.config.search_budget <= 0)
+        if self.config.only_data_parallel or single_no_budget \
                 or self.config.search_algo == "dp":
             return ShardingStrategy.data_parallel(
                 self.layers, self.graph_inputs, self.dmesh), None

@@ -68,8 +68,10 @@ class AllToAllOp(_ShardingTransitionBase):
 @register
 class PipelineOp(_ShardingTransitionBase):
     """Pipeline stage boundary marker (reference has only the enum,
-    ``ffconst.h:159`` — no implementation; we give it real semantics in the
-    pipeline executor: stage split point for lax.scan-based 1F1B/GPipe)."""
+    ``ffconst.h:159`` — no implementation). As a graph node it is an
+    identity; actual pipelining happens when ``FFConfig.pipeline_stages``
+    lowers a repeated-block region onto the GPipe engine
+    (``parallel/pipeline_lowering.py`` + executor), not through this op."""
     op_type = OperatorType.OP_PIPELINE
 
 

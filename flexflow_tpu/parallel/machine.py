@@ -75,16 +75,29 @@ class MachineSpec:
 
     @classmethod
     def detect(cls, devices=None) -> "MachineSpec":
+        import logging
+
         import jax
         devices = devices or jax.devices()
         kind = devices[0].device_kind.lower()
-        gen = "v5e"
+        gen = None
         for g in ("v6e", "v5p", "v5e", "v4"):
             if g in kind.replace(" ", ""):
                 gen = g
                 break
         if devices[0].platform == "cpu":
             gen = "cpu-sim"
+        log = logging.getLogger("flexflow_tpu")
+        if gen is None:
+            gen = "v5e"
+            log.warning(
+                "MachineSpec.detect: unknown device kind %r (platform %r); "
+                "defaulting cost-model constants to %s — pass an explicit "
+                "MachineSpec or a machine-model file if this is wrong",
+                devices[0].device_kind, devices[0].platform, gen)
+        else:
+            log.info("MachineSpec.detect: %d x %s (device_kind=%r)",
+                     len(devices), gen, devices[0].device_kind)
         return cls(num_devices=len(devices), generation=gen)
 
 

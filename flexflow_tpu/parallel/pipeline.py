@@ -33,11 +33,14 @@ def _squeeze_stage(params):
     return jax.tree.map(lambda x: x[0], params)
 
 
-def gpipe(stage_fn: Callable[[Any, Any], Any], axis_name: str,
-          n_microbatches: int):
+def gpipe(stage_fn: Callable[..., Any], axis_name: str,
+          n_microbatches: int, with_step_arg: bool = False):
     """Build the pipelined apply for use INSIDE shard_map over `axis_name`.
 
     stage_fn(stage_params, x) -> y with y.shape == x.shape.
+    With ``with_step_arg``, stage_fn(stage_params, x, t) also receives the
+    schedule step t (traced int32) — used e.g. to derive per-microbatch
+    dropout rng inside a pipelined region.
 
     Returned fn(stacked_params_local, xs) where:
       - stacked_params_local: pytree whose leaves have local shape
@@ -67,7 +70,8 @@ def gpipe(stage_fn: Callable[[Any, Any], Any], axis_name: str,
             mb_t = lax.dynamic_index_in_dim(
                 xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
             x_in = jnp.where(stage == 0, mb_t, state)
-            y = stage_fn(params, x_in)
+            y = stage_fn(params, x_in, t) if with_step_arg \
+                else stage_fn(params, x_in)
             # final stage owns microbatch t-(S-1) at step t
             out_idx = t - (S - 1)
             valid = jnp.logical_and(stage == S - 1,

@@ -1,0 +1,212 @@
+"""Pipeline lowering: find a repeated-block region in a layer graph and
+lower it onto the GPipe engine, through the PRODUCT path (FFModel.compile
+→ Executor), not a hand-built stage_fn.
+
+The reference reserves ``OP_PIPELINE`` (``include/flexflow/ffconst.h:159``)
+and task ids but ships no implementation; here pipelining is a first-class
+strategy dimension: ``FFConfig.pipeline_stages = k`` (or a searched
+candidate) partitions the *maximal repeated-block run* of the graph —
+transformer blocks, residual MLP stacks — into k structurally identical
+stages, stacks their parameters on a leading stage dim sharded over the
+``pp`` mesh axis, and executes the region with the ``lax.scan`` +
+``ppermute`` schedule from ``parallel/pipeline.py``. Layers before/after
+the region (embedding, LM head, loss) run as ordinary sharded ops.
+
+Constraints (checked by ``find_pipeline_region``): the region must be a
+chain of ``n_stages`` structurally identical single-input/single-output
+chunks with shape-preserving boundaries, no stateful ops (BN running
+stats), and no tensor from outside the region consumed inside it (other
+than the boundary activation). Dropout inside the region draws its rng
+from (step, stage, scan-step), so masks differ across microbatches.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.layer import Layer
+from ..ffconst import OperatorType
+
+__all__ = ["PipelineRegion", "find_pipeline_region", "layer_signature"]
+
+
+def layer_signature(layer: Layer) -> Tuple:
+    """Structural identity of a layer for repeated-block detection:
+    op type + params + input/output shapes/dtypes (not names/guids)."""
+    from ..core.layer import _hashable
+    return (layer.op_type, _hashable(layer.params),
+            tuple(t.shape for t in layer.inputs),
+            tuple(t.dtype for t in layer.inputs),
+            tuple(t.shape for t in layer.outputs))
+
+
+@dataclasses.dataclass
+class PipelineRegion:
+    """A lowered pipeline region inside a layer program."""
+    start: int                  # first region layer index in the program
+    end: int                    # exclusive
+    n_stages: int
+    n_microbatches: int
+    entry_guid: int             # activation entering stage 0
+    exit_guid: int              # activation leaving stage n_stages-1
+    template: List[Layer]       # stage 0's layers (the stage program)
+    template_entry_guid: int
+    # for stage s, layer j of that stage corresponds to template[j];
+    # stage_layer_names[s][j] is its original (per-stage) layer name,
+    # used to initialize per-stage weights before stacking
+    stage_layer_names: List[List[str]]
+    # mesh binding, filled in by parallel.presets.pipeline_strategy
+    pp_axis: Optional[str] = None
+    dp_axes: Tuple[str, ...] = ()
+
+    @property
+    def template_exit_guid(self) -> int:
+        return self.template[-1].outputs[0].guid
+
+    @property
+    def layers_per_stage(self) -> int:
+        return len(self.template)
+
+    def param_name(self, template_layer: Layer) -> str:
+        """Key of the stacked parameter subtree in the params pytree."""
+        return f"pp::{template_layer.name}"
+
+
+def _single_crossing(layers: Sequence[Layer], cut: int,
+                     region_end: int) -> Optional[int]:
+    """If exactly one tensor produced by layers[:cut] (within the region
+    under test) is consumed by layers[cut:region_end], return its guid."""
+    produced = {t.guid for l in layers[:cut] for t in l.outputs}
+    crossing = set()
+    for l in layers[cut:region_end]:
+        for t in l.inputs:
+            if t.guid in produced:
+                crossing.add(t.guid)
+            elif t.owner_layer is not None and \
+                    t.owner_layer not in layers[cut:region_end]:
+                # produced outside the candidate window entirely
+                return None
+    if len(crossing) != 1:
+        return None
+    return next(iter(crossing))
+
+
+def _chunks_isomorphic(a: Sequence[Layer], b: Sequence[Layer],
+                       a_entry: int, b_entry: int) -> bool:
+    """Do chunks a and b compute the same function of their entry tensor?
+    Layer-wise signature equality + input-wiring isomorphism."""
+    guid_map = {a_entry: b_entry}
+    for la, lb in zip(a, b):
+        if layer_signature(la) != layer_signature(lb):
+            return False
+        if len(la.inputs) != len(lb.inputs) or \
+                len(la.outputs) != len(lb.outputs):
+            return False
+        for ta, tb in zip(la.inputs, lb.inputs):
+            if guid_map.get(ta.guid) != tb.guid:
+                return False
+        for ta, tb in zip(la.outputs, lb.outputs):
+            guid_map[ta.guid] = tb.guid
+    return True
+
+
+def _has_state(layer: Layer) -> bool:
+    from ..ops import get_op_def
+    op = get_op_def(layer.op_type)
+    state_spec = getattr(op, "state_spec", None)
+    if state_spec is None:
+        return False
+    ss = state_spec(layer.params, [t.shape for t in layer.inputs],
+                    [t.dtype for t in layer.inputs])
+    return bool(ss)
+
+
+def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
+                         n_microbatches: int = 0
+                         ) -> Optional[PipelineRegion]:
+    """Find the maximal run of identical single-input/single-output chunks
+    divisible into ``n_stages`` stages. Returns None when the graph has no
+    such region (the caller falls back to non-pipelined execution)."""
+    layers = list(layers)
+    n = len(layers)
+    sigs = [layer_signature(l) for l in layers]
+    best: Optional[Tuple[int, int, int]] = None  # (total_len, start, unit)
+    for unit in range(1, n // max(n_stages, 2) + 1):
+        for start in range(n - unit * 2 + 1):
+            # count consecutive repeats of layers[start:start+unit]
+            reps = 1
+            while True:
+                nxt = start + reps * unit
+                if nxt + unit > n:
+                    break
+                if sigs[nxt:nxt + unit] != sigs[start:start + unit]:
+                    break
+                reps += 1
+            reps -= reps % n_stages          # whole stages only
+            if reps >= n_stages and reps * unit > (best or (0,))[0]:
+                # verify structure before accepting
+                if _verify_run(layers, start, unit, reps):
+                    best = (reps * unit, start, unit)
+    if best is None:
+        return None
+    total, start, unit = best
+    reps = total // unit
+    per_stage = (reps // n_stages) * unit
+    end = start + total
+    region = layers[start:end]
+    # stage boundaries must each cross exactly one tensor
+    entry = _single_crossing(layers[:start] + region, start, start + total)
+    if entry is None:
+        return None
+    boundaries = [entry]
+    for s in range(1, n_stages):
+        g = _single_crossing(region, s * per_stage, total)
+        if g is None:
+            return None
+        boundaries.append(g)
+    exit_guid = region[-1].outputs[0].guid
+    # chunk shape preservation: entry and exit tensors of each stage match
+    by_guid = {t.guid: t for l in layers for t in l.outputs}
+    for l in layers:
+        for t in l.inputs:
+            by_guid.setdefault(t.guid, t)
+    shapes = {tuple(by_guid[g].shape) for g in boundaries + [exit_guid]
+              if g in by_guid}
+    if len(shapes) != 1:
+        return None
+    # stages must be isomorphic to stage 0 and stateless
+    template = region[:per_stage]
+    if any(_has_state(l) for l in template):
+        return None
+    for s in range(1, n_stages):
+        chunk = region[s * per_stage:(s + 1) * per_stage]
+        if not _chunks_isomorphic(template, chunk, boundaries[0],
+                                  boundaries[s]):
+            return None
+    if n_microbatches <= 0:
+        n_microbatches = 2 * n_stages
+    return PipelineRegion(
+        start=start, end=end, n_stages=n_stages,
+        n_microbatches=n_microbatches, entry_guid=entry,
+        exit_guid=exit_guid, template=list(template),
+        template_entry_guid=boundaries[0],
+        stage_layer_names=[
+            [l.name for l in region[s * per_stage:(s + 1) * per_stage]]
+            for s in range(n_stages)])
+
+
+def _verify_run(layers: Sequence[Layer], start: int, unit: int,
+                reps: int) -> bool:
+    """Cheap pre-check that consecutive unit chunks are chainable: each
+    chunk's inputs come from itself or the previous chunk's outputs (or
+    the tensor entering the first chunk)."""
+    region = layers[start:start + unit * reps]
+    internal = {t.guid for l in region for t in l.outputs}
+    external = set()
+    for l in region:
+        for t in l.inputs:
+            if t.guid not in internal:
+                external.add(t.guid)
+    return len(external) == 1

@@ -116,6 +116,56 @@ def transformer_strategy(layers, input_tensors, dmesh: DeviceMesh,
     return st
 
 
+def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
+                      n_stages: int, n_microbatches: int = 0,
+                      pp_axis: Optional[str] = None,
+                      dp_axes: Optional[Sequence[str]] = None
+                      ) -> ShardingStrategy:
+    """dp×pp strategy through the product path: the maximal repeated-block
+    region (found by ``find_pipeline_region``) becomes ``n_stages`` GPipe
+    stages over the ``pp`` mesh axis; everything outside the region is
+    batch-sharded over the dp axes. Raises ValueError when the graph has
+    no pipelinable region or no mesh axis of size ``n_stages``.
+
+    The reference only reserves the enum for this (``ffconst.h:159``);
+    here it composes with dp and is schedulable by the search
+    (``search.pipeline_score``). TP inside a pipelined region is not yet
+    expressed (stage-internal collectives inside shard_map)."""
+    from .pipeline_lowering import find_pipeline_region
+    if pp_axis is None:
+        pp_axis = next((a for a, s in dmesh.axis_sizes.items()
+                        if s == n_stages), None)
+        if pp_axis is None:
+            raise ValueError(
+                f"no mesh axis of size {n_stages} for pipeline stages "
+                f"(mesh {dict(dmesh.axis_sizes)}); pass --mesh-shape")
+    if dp_axes is None:
+        dp_axes = tuple(a for a in dmesh.axis_names if a != pp_axis)
+    dp = _norm(dp_axes)
+    dp_size = _size(dmesh, dp)
+    region = find_pipeline_region(layers, n_stages, n_microbatches)
+    if region is None:
+        raise ValueError(
+            f"graph has no repeated-block region divisible into "
+            f"{n_stages} identical stages")
+    region.pp_axis = pp_axis
+    region.dp_axes = tuple(dp_axes)
+    st = ShardingStrategy(dmesh)
+    st.pipeline = region
+    for t in input_tensors:
+        if t.shape and t.shape[0] % dp_size == 0:
+            st.inputs[t.name] = P(dp)
+    region_names = {l.name for l in layers[region.start:region.end]}
+    for layer in layers:
+        if layer.name in region_names:
+            continue  # sharded via the GPipe shard_map, not constraints
+        outs = [P(dp, *([None] * (len(o.shape) - 1)))
+                if o.shape and o.shape[0] % dp_size == 0 else None
+                for o in layer.outputs]
+        st.set_op(layer.name, outs, {})
+    return st
+
+
 def expert_parallel_strategy(layers, input_tensors, dmesh: DeviceMesh,
                              dp_axes, ep_axes) -> ShardingStrategy:
     """DP + expert parallelism for MoE graphs built by ``FFModel.moe``:

@@ -53,6 +53,9 @@ class ShardingStrategy:
         self.dmesh = dmesh
         self.ops: Dict[str, OpSharding] = {}
         self.inputs: Dict[str, P] = {}   # input tensor name -> spec
+        # set by parallel.presets.pipeline_strategy: a PipelineRegion the
+        # executor lowers onto the GPipe engine (None = no pipelining)
+        self.pipeline = None
 
     # ------------------------------------------------------------------
     def set_op(self, layer_name: str, outputs: Sequence[Optional[P]],

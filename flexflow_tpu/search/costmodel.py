@@ -2,12 +2,15 @@
 over the TPU machine model.
 
 Analog of the reference's Simulator (``src/runtime/simulator.cc``):
-  - ``measure_operator_cost`` ≙ ``OpCostModel.op_cost``: analytic roofline
-    (FLOPs on the MXU vs bytes over HBM) refined by optional on-chip
-    microbenchmarks (jit-compile the op at shard-local shape, warmup +
-    repeat — the direct analog of ``inner_measure_operator_cost``,
-    ``model.cu:38``), cached by (op params, degrees) like the reference's
-    ``hash_to_operator_cost``.
+  - ``measure_operator_cost`` (``simulator.cc:537``) ≙
+    ``OpCostModel.measure``: jit-compile the op's own ``emit`` at the
+    shard-local shape on the real device, warmup + repeat + median — the
+    direct analog of ``inner_measure_operator_cost`` (``model.cu:38``) —
+    cached in-memory AND on disk by (generation, op params, degrees) like
+    the reference's ``hash_to_operator_cost``. ``op_cost`` consults the
+    measurement when ``measure_on_device`` is set (search on a real chip)
+    and falls back to the analytic roofline (FLOPs on the MXU vs bytes
+    over HBM) otherwise — e.g. on the CPU simulation platform.
   - ``estimate_xfer_cost`` ≙ resharding cost between PartitionSpecs:
     collective volume over ICI bandwidth + per-hop latency.
   - weight sync ≙ gradient all-reduce ring cost over the dp axes.
@@ -15,8 +18,10 @@ Analog of the reference's Simulator (``src/runtime/simulator.cc``):
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -49,11 +54,54 @@ class OpCostModel:
     # refined by calibrate() microbenchmarks when a chip is available.
     _DEFAULT_EFF = 0.5
 
-    def __init__(self, spec: MachineSpec):
+    # ops worth a per-op microbenchmark (compile time ~seconds each);
+    # everything cheaper uses the analytic roofline, as fusion makes
+    # standalone elementwise timings meaningless under XLA anyway
+    _MEASURE_MIN_FLOPS = 1e7
+
+    def __init__(self, spec: MachineSpec, cache_dir: Optional[str] = None):
         self.spec = spec
         self.cache: Dict[Tuple, CostMetrics] = {}
         self.mxu_eff = self._DEFAULT_EFF
         self.overhead_s = 2e-6  # per-op dispatch/fusion overhead inside XLA
+        # on-device measurement (reference measure_operator_cost analog)
+        self.measure_on_device = False
+        self.measure_budget_s = 120.0   # total wall budget for microbenches
+        self._measure_spent_s = 0.0
+        self._unmeasurable: set = set()  # per-process, deliberately not on disk
+        self._disk: Optional[Dict[str, Any]] = None
+        self._cache_dir = cache_dir or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".ffcache")
+
+    # ------------------------------------------------------------------
+    # disk cache (reference hash_to_operator_cost persisted)
+    # ------------------------------------------------------------------
+    @property
+    def _disk_path(self) -> str:
+        return os.path.join(self._cache_dir,
+                            f"opcost_{self.spec.generation}.json")
+
+    def _disk_cache(self) -> Dict[str, Any]:
+        if self._disk is None:
+            try:
+                with open(self._disk_path) as f:
+                    self._disk = json.load(f)
+            except Exception:
+                self._disk = {}
+        return self._disk
+
+    def _disk_put(self, key: str, value) -> None:
+        cache = self._disk_cache()
+        cache[key] = value
+        try:
+            os.makedirs(self._cache_dir, exist_ok=True)
+            tmp = self._disk_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(cache, f)
+            os.replace(tmp, self._disk_path)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     def calibrate(self):
@@ -86,6 +134,145 @@ class OpCostModel:
             pass
 
     # ------------------------------------------------------------------
+    # on-device per-op measurement (simulator.cc:537 / model.cu:38 analog)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _local_shape(shape: Sequence[int],
+                     degrees: Dict[int, int]) -> Tuple[int, ...]:
+        out = list(shape)
+        for d, deg in degrees.items():
+            if 0 <= d < len(out) and deg > 1 and out[d] % deg == 0:
+                out[d] = out[d] // deg
+        return tuple(out)
+
+    def _make_arg(self, shape, dtype, rng: np.random.Generator,
+                  int_high: int):
+        import jax.numpy as jnp
+        from ..dtypes import to_jnp
+        jdt = to_jnp(dtype)
+        if np.issubdtype(np.dtype(jdt if jdt != jnp.bfloat16 else np.float32),
+                         np.integer):
+            return jnp.asarray(
+                rng.integers(0, max(int_high, 2), size=shape), jdt)
+        return jnp.asarray(rng.standard_normal(shape) * 0.02, jdt)
+
+    def measure(self, layer: Layer, shard_degrees: Dict[int, int],
+                weight_shard_degree: int = 1, warmup: int = 2,
+                repeats: int = 5) -> Optional[CostMetrics]:
+        """Microbenchmark one op's fwd and fwd+bwd at shard-local shape on
+        the local device (jit the op's own ``emit``; warmup + repeat +
+        median; device-to-host fetch as the sync barrier). Returns None
+        when the op cannot be measured standalone — caller falls back to
+        the analytic roofline."""
+        import jax
+        import jax.numpy as jnp
+        from ..dtypes import to_jnp
+        from ..ops import EmitCtx
+
+        op = get_op_def(layer.op_type)
+        out_shape = layer.outputs[0].shape if layer.outputs else ()
+        out_rank = len(out_shape)
+        # A degree on the LAST output dim is feature/head sharding: it is
+        # realized by sharding the weight's output dim, NOT by shrinking
+        # the op input (column-parallel linear/attention). Degrees on
+        # earlier dims (batch/spatial) shrink the activations.
+        act_degrees = {d: g for d, g in shard_degrees.items()
+                       if d < out_rank - 1}
+        eff_wdeg = weight_shard_degree * shard_degrees.get(out_rank - 1, 1)
+        rng = np.random.default_rng(0)
+        int_high = int(layer.params.get(
+            "num_entries", layer.params.get("vocab_size", 100)))
+        ins = []
+        for t in layer.inputs:
+            ls = self._local_shape(t.shape, act_degrees) \
+                if len(t.shape) == len(out_shape) else t.shape
+            ins.append(self._make_arg(ls, t.dtype, rng, int_high))
+        w: Dict[str, Any] = {}
+        for spec in (layer.weights or op.weights(
+                layer.params, [t.shape for t in layer.inputs],
+                [t.dtype for t in layer.inputs])):
+            ws = list(spec.shape)
+            if eff_wdeg > 1 and ws and ws[-1] % eff_wdeg == 0:
+                ws[-1] //= eff_wdeg
+            w[spec.name] = self._make_arg(tuple(ws), spec.dtype, rng, 2)
+        state = {}
+        state_spec = getattr(op, "state_spec", None)
+        if state_spec is not None:
+            ss = state_spec(layer.params, [t.shape for t in layer.inputs],
+                            [t.dtype for t in layer.inputs]) or {}
+            for sname, (sshape, sdt) in ss.items():
+                init = jnp.ones if sname == "var" else jnp.zeros
+                state[sname] = init(sshape, to_jnp(sdt))
+
+        def make_ctx():
+            return EmitCtx(training=True,
+                           rngs={layer.name: jax.random.key(0)},
+                           state={layer.name: state})
+
+        def fwd(ins_, w_):
+            outs = op.emit(layer.params, list(ins_), w_, make_ctx(),
+                           layer.name)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+        float_ins = [i for i, a in enumerate(ins)
+                     if jnp.issubdtype(a.dtype, jnp.floating)]
+
+        def fwdbwd(ins_, w_):
+            def loss(w__, fins):
+                full = list(ins_)
+                for i, a in zip(float_ins, fins):
+                    full[i] = a
+                return fwd(full, w__)
+            args = (w_, [ins_[i] for i in float_ins])
+            g = jax.grad(loss, argnums=(0, 1))(*args)
+            return jax.tree_util.tree_reduce(
+                lambda acc, x: acc + jnp.sum(x.astype(jnp.float32)), g, 0.0)
+
+        def timed(fn):
+            f = jax.jit(fn)
+            for _ in range(warmup):
+                float(np.asarray(f(ins, w)))  # fetch = sync barrier
+            ts = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                float(np.asarray(f(ins, w)))
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        try:
+            t_all = time.perf_counter()
+            fwd_t = timed(fwd)
+            tot_t = timed(fwdbwd) if (float_ins or w) else fwd_t
+            self._measure_spent_s += time.perf_counter() - t_all
+            return CostMetrics(forward_time=fwd_t,
+                               backward_time=max(tot_t - fwd_t, 0.0))
+        except Exception:
+            self._measure_spent_s += 1.0  # count failures against budget
+            return None
+
+    def _measured_cost(self, layer: Layer, shard_degrees: Dict[int, int],
+                       weight_shard_degree: int,
+                       key: Tuple) -> Optional[CostMetrics]:
+        """Disk-cached measurement; None = not measurable / over budget."""
+        dkey = repr(key)
+        cached = self._disk_cache().get(dkey)
+        if cached is not None:
+            return CostMetrics(forward_time=cached[0],
+                               backward_time=cached[1])
+        if key in self._unmeasurable:
+            return None
+        if self._measure_spent_s >= self.measure_budget_s:
+            return None
+        cm = self.measure(layer, shard_degrees, weight_shard_degree)
+        if cm is None:
+            # in-memory only: a failure may be transient (device busy,
+            # flaky compile) and must not poison future processes
+            self._unmeasurable.add(key)
+            return None
+        self._disk_put(dkey, [cm.forward_time, cm.backward_time])
+        return cm
+
+    # ------------------------------------------------------------------
     def op_cost(self, layer: Layer, shard_degrees: Dict[int, int],
                 weight_shard_degree: int = 1) -> CostMetrics:
         """Cost of one op with its output dims partitioned by
@@ -115,6 +302,14 @@ class OpCostModel:
         fwd = max(t_compute, t_mem) + self.overhead_s
         bwd = fwd * op.backward_flops_factor() \
             if layer.op_type != OperatorType.OP_INPUT else 0.0
+        if (self.measure_on_device and flops >= self._MEASURE_MIN_FLOPS
+                and layer.op_type not in PARALLEL_OPS
+                and layer.op_type != OperatorType.OP_INPUT):
+            mm = self._measured_cost(layer, shard_degrees,
+                                     weight_shard_degree,
+                                     (self.spec.generation,) + key)
+            if mm is not None:
+                fwd, bwd = mm.forward_time, mm.backward_time
         cm = CostMetrics(forward_time=fwd, backward_time=bwd,
                          inputs_memory=in_bytes, outputs_memory=out_bytes,
                          weights_memory=w_bytes)

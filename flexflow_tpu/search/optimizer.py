@@ -36,10 +36,13 @@ def optimize_strategy(ff):
     cost_model = OpCostModel(spec)
     import jax
     if jax.devices()[0].platform != "cpu":
-        # refine MXU efficiency with a real on-chip microbenchmark
-        # (analog of inner_measure_operator_cost; skipped on CPU sim
-        # where analytic constants already match cpu-sim MachineSpec)
+        # real chip: refine MXU efficiency with a matmul microbenchmark
+        # AND enable per-op on-device measurement (the analog of
+        # measure_operator_cost, simulator.cc:537 — every heavy op is
+        # timed at shard-local shape and disk-cached). On the CPU sim
+        # the analytic constants already match the cpu-sim MachineSpec.
         cost_model.calibrate()
+        cost_model.measure_on_device = True
     t0 = time.perf_counter()
     if cfg.search_algo == "unity":
         return _unity(ff, cost_model, t0)
@@ -61,7 +64,40 @@ def optimize_strategy(ff):
     if cfg.export_strategy_file:
         save_strategy(cfg.export_strategy_file, strategy, best,
                       {"best_cost": best_cost, "dp_cost": dp_cost})
-    return strategy, None
+    return _maybe_pipeline(ff, cost_model, best_cost, (strategy, None))
+
+
+def _maybe_pipeline(ff, cost_model, searched_cost, searched_result):
+    """--enable-pipeline-search: score GPipe candidates (bubble model,
+    search/pipeline_score.py) against the searched sharding strategy and
+    take the winner. The chosen strategy carries its own (dp, S) mesh —
+    FFModel.compile adopts strategy.dmesh."""
+    cfg = ff.config
+    if not cfg.enable_pipeline_search:
+        return searched_result
+    from .pipeline_score import best_pipeline
+    cand = best_pipeline(ff.layers, ff.dmesh, cost_model,
+                         cfg.pipeline_microbatches)
+    if cand is None or (searched_cost is not None
+                        and cand.cost >= searched_cost):
+        if cfg.profiling and cand is not None:
+            print(f"pipeline candidate S={cand.n_stages} "
+                  f"cost {cand.cost * 1e3:.3f} ms >= searched "
+                  f"{searched_cost * 1e3:.3f} ms — keeping searched")
+        return searched_result
+    from ..parallel.machine import DeviceMesh
+    from ..parallel.presets import pipeline_strategy
+    n = ff.dmesh.num_devices
+    shape = (n // cand.n_stages, cand.n_stages) if n > cand.n_stages \
+        else (cand.n_stages,)
+    dmesh2 = DeviceMesh(ff.dmesh.spec, mesh_shape=shape)
+    st = pipeline_strategy(ff.layers, ff.graph_inputs, dmesh2,
+                           n_stages=cand.n_stages,
+                           n_microbatches=cand.n_microbatches)
+    if cfg.profiling:
+        print(f"pipeline candidate S={cand.n_stages} wins: "
+              f"{cand.cost * 1e3:.3f} ms < {searched_cost * 1e3:.3f} ms")
+    return st, None
 
 
 def _unity(ff, cost_model: OpCostModel, t0: float):
@@ -112,7 +148,7 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
             info.output_tensors[0])
         save_strategy(cfg.export_strategy_file, strategy, None,
                       {"best_cost": gc.total}, program=prog_doc)
-    return strategy, info
+    return _maybe_pipeline(ff, cost_model, gc.total, (strategy, info))
 
 
 def _import_strategy(ff, path: str, dmesh):

@@ -1,0 +1,102 @@
+"""Pipeline-candidate scoring for the strategy search (bubble model).
+
+The reference reserves OP_PIPELINE without a cost model; here the search
+can score "partition the repeated-block region into S GPipe stages" the
+same way it scores sharding strategies, so pipeline parallelism competes
+on measured/analytic cost rather than being a user-only knob.
+
+Cost model (standard GPipe bubble algebra):
+  per-microbatch stage time  t = (fwd+bwd of one stage's ops at batch
+                                  B/dp/M)
+  schedule length            T_region = (M + S - 1) * (t + t_handoff)
+  handoff                    activation bytes / ICI bw + latency
+  outside-region layers      costed at the dp sharding
+  weight sync                all-reduce over dp only (stage weights live
+                             on their pipeline rank; no pp sync)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..dtypes import itemsize
+from ..ffconst import OperatorType
+from ..parallel.machine import DeviceMesh, MachineSpec
+from ..parallel.pipeline_lowering import PipelineRegion, \
+    find_pipeline_region
+from .costmodel import OpCostModel
+
+
+@dataclasses.dataclass
+class PipelineCandidate:
+    n_stages: int
+    n_microbatches: int
+    dp_size: int
+    cost: float                  # estimated step time, seconds
+    region: PipelineRegion
+
+
+def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
+                   n_stages: int, n_devices: int,
+                   n_microbatches: int = 0) -> Optional[PipelineCandidate]:
+    """Estimated train-step time for an S-stage GPipe split of the
+    graph's repeated-block region on ``n_devices`` (dp = n/S). None when
+    the graph has no S-divisible region."""
+    region = find_pipeline_region(layers, n_stages, n_microbatches)
+    if region is None:
+        return None
+    S, M = n_stages, region.n_microbatches
+    dp = max(n_devices // S, 1)
+    batch_deg = {0: dp * M}
+    t_stage = 0.0
+    for l in region.template:
+        cm = cost_model.op_cost(l, batch_deg)
+        t_stage += cm.forward_time + cm.backward_time
+    # handoff: the boundary activation (one microbatch, dp-sharded)
+    by_guid = {t.guid: t for l in layers for t in l.outputs}
+    entry_t = by_guid.get(region.entry_guid)
+    act_bytes = (int(np.prod(entry_t.shape)) * itemsize(entry_t.dtype)
+                 / max(dp * M, 1)) if entry_t is not None else 0.0
+    t_handoff = act_bytes / spec.ici_bandwidth + spec.ici_latency_us * 1e-6
+    t_region = (M + S - 1) * (t_stage + t_handoff)
+    # outside layers at plain dp
+    region_idx = set(range(region.start, region.end))
+    t_out, w_bytes_out = 0.0, 0.0
+    for i, l in enumerate(layers):
+        if i in region_idx or l.op_type == OperatorType.OP_INPUT:
+            continue
+        cm = cost_model.op_cost(l, {0: dp * S})
+        t_out += cm.forward_time + cm.backward_time
+        w_bytes_out += cm.weights_memory
+    # gradient sync over dp. Stage weights all-reduce over their own dp
+    # group (disjoint groups run concurrently), so the region contributes
+    # ONE stage's weight bytes, not S stages'.
+    from ..ops import get_op_def
+    w_bytes_stage = 0.0
+    for l in region.template:
+        specs = l.weights or get_op_def(l.op_type).weights(
+            l.params, [t.shape for t in l.inputs],
+            [t.dtype for t in l.inputs])
+        w_bytes_stage += sum(int(np.prod(ws.shape)) * itemsize(ws.dtype)
+                             for ws in specs)
+    t_sync = cost_model.weight_sync_cost(w_bytes_stage + w_bytes_out, dp)
+    return PipelineCandidate(S, M, dp, t_region + t_out + t_sync, region)
+
+
+def best_pipeline(layers, dmesh: DeviceMesh,
+                  cost_model: OpCostModel,
+                  microbatches: int = 0) -> Optional[PipelineCandidate]:
+    """Best S over the stage counts realizable on this machine (S must
+    divide the device count; the mesh is rebuilt (n/S, S) when chosen)."""
+    n = dmesh.num_devices
+    best: Optional[PipelineCandidate] = None
+    for S in range(2, n + 1):
+        if n % S:
+            continue
+        cand = score_pipeline(layers, dmesh.spec, cost_model, S, n,
+                              microbatches)
+        if cand is not None and (best is None or cand.cost < best.cost):
+            best = cand
+    return best

@@ -283,20 +283,60 @@ class UnitySearch:
         self.max_num_ops = max_num_ops
         self._memo: Dict[Tuple, Tuple[Graph, float]] = {}
 
-    def _cut_layout_candidates(self, t: Tensor) -> List[Layout]:
+    def _cut_layout_candidates(self, t: Tensor,
+                               depth: int = 0) -> List[Layout]:
         """Candidate layouts of the cut tensor — the analog of enumerating
-        the bottleneck node's machine views."""
-        cands: List[Layout] = [()]
+        the bottleneck node's machine views (reference ``graph.h:205``):
+        replicated, every divisible dim at every realizable degree, and
+        batch×feature 2-dim combinations. Ordered best-guess-first
+        (replicated, batch shardings, feature, interior, combos) and
+        capped at deeper DP levels to bound the layout×position
+        combinatorics."""
         if not t.shape:
-            return cands
-        for d in self.ev.dmesh.valid_degrees():
-            if d <= 1:
-                continue
+            return [()]
+        rank = len(t.shape)
+        degrees = sorted((d for d in self.ev.dmesh.valid_degrees()
+                          if d > 1), reverse=True)
+        batch: List[Layout] = []
+        feature: List[Layout] = []
+        interior_dims: List[Layout] = []
+        combos: List[Layout] = []
+        for d in degrees:
             if t.shape[0] % d == 0:
-                cands.append(_layout({0: d}))
-            if len(t.shape) > 1 and t.shape[-1] % d == 0:
-                cands.append(_layout({len(t.shape) - 1: d}))
-        return list(dict.fromkeys(cands))
+                batch.append(_layout({0: d}))
+            if rank > 1 and t.shape[-1] % d == 0:
+                feature.append(_layout({rank - 1: d}))
+            for dim in range(1, rank - 1):
+                if t.shape[dim] % d == 0:
+                    interior_dims.append(_layout({dim: d}))
+        if rank > 1:
+            valid = set(self.ev.dmesh.valid_degrees())
+            for d0 in degrees:
+                if t.shape[0] % d0:
+                    continue
+                for d1 in degrees:
+                    if t.shape[rank - 1] % d1 == 0 and d0 * d1 in valid:
+                        combos.append(_layout({0: d0, rank - 1: d1}))
+        cands = list(dict.fromkeys(
+            [()] + batch + feature + interior_dims + combos))
+        cap = 12 if depth < 2 else 6
+        return cands[:cap]
+
+    def _split_positions(self, interior: List[PNode],
+                         depth: int) -> List[PNode]:
+        """Split positions to try. At shallow depth, several bottlenecks
+        compete (the reference's per-bottleneck recursion,
+        substitution.cc:2572); deeper, the midpoint alone — pins rarely
+        repeat across layouts, so memoization cannot keep an all-position
+        all-depth DP polynomial."""
+        if depth >= 2 or len(interior) == 1:
+            return [interior[len(interior) // 2]]
+        if len(interior) <= 3:
+            return list(interior)
+        q = len(interior) // 4
+        picks = [interior[q], interior[len(interior) // 2],
+                 interior[-1 - q]]
+        return list(dict.fromkeys(picks))
 
     def optimize(self, graph: Graph,
                  in_pins: Optional[Dict[int, Layout]] = None,
@@ -320,33 +360,39 @@ class UnitySearch:
                                 out_pin)
             self._memo[key] = res
             return res
-        # split at the middle bottleneck (reference splits at each
-        # bottleneck recursively; the midpoint halves the DP depth)
-        b = interior[len(interior) // 2]
-        pre, post = graph.split_at(b)
-        # crossing tensors, positionally aligned with pre.outputs —
-        # substitutions may replace the producing node (fresh output
-        # Tensors), but graph.outputs positions are rewired in place,
-        # so index k of the optimized pre's outputs still corresponds
-        # to original cut tensor k
-        cut_tensors = [n.layer.outputs[i] for n, i in pre.outputs]
-        cut_t = b.layer.outputs[0]
-        best_pair: Optional[Tuple[Graph, Graph]] = None
+        # DP over split positions × cut layouts (reference recurses at
+        # each bottleneck over machine-view sets, substitution.cc:2572;
+        # memoization by (subgraph hash, pins) keeps this polynomial)
+        best_merged: Optional[Graph] = None
         best_cost = float("inf")
-        for L in self._cut_layout_candidates(cut_t):
-            g1, c1 = self.optimize(pre, in_pins, L, depth + 1)
-            if c1 >= best_cost:
-                continue
-            pins2 = dict(in_pins)
-            pins2[cut_t.guid] = L
-            g2, c2 = self.optimize(post, pins2, out_pin, depth + 1)
-            if c1 + c2 < best_cost:
-                best_cost = c1 + c2
-                best_pair = (g1, g2)
-        assert best_pair is not None
-        merged = _merge_split(best_pair[0], best_pair[1], graph,
-                              [t.guid for t in cut_tensors])
-        res = (merged, best_cost)
+        for b in self._split_positions(interior, depth):
+            pre, post = graph.split_at(b)
+            # crossing tensors, positionally aligned with pre.outputs —
+            # substitutions may replace the producing node (fresh output
+            # Tensors), but graph.outputs positions are rewired in place,
+            # so index k of the optimized pre's outputs still corresponds
+            # to original cut tensor k
+            cut_tensors = [n.layer.outputs[i] for n, i in pre.outputs]
+            cut_t = b.layer.outputs[0]
+            best_pair: Optional[Tuple[Graph, Graph]] = None
+            split_cost = float("inf")
+            for L in self._cut_layout_candidates(cut_t, depth):
+                g1, c1 = self.optimize(pre, in_pins, L, depth + 1)
+                if c1 >= min(split_cost, best_cost):
+                    continue
+                pins2 = dict(in_pins)
+                pins2[cut_t.guid] = L
+                g2, c2 = self.optimize(post, pins2, out_pin, depth + 1)
+                if c1 + c2 < split_cost:
+                    split_cost = c1 + c2
+                    best_pair = (g1, g2)
+            if best_pair is not None and split_cost < best_cost:
+                best_cost = split_cost
+                best_merged = _merge_split(best_pair[0], best_pair[1],
+                                           graph,
+                                           [t.guid for t in cut_tensors])
+        assert best_merged is not None
+        res = (best_merged, best_cost)
         self._memo[key] = res
         return res
 

@@ -1,0 +1,84 @@
+"""On-device op-cost measurement (reference measure_operator_cost /
+simulator.cc:537 analog): measured and analytic costs must agree on the
+ordering of ops with well-separated analytic costs, and the disk cache
+must round-trip."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search.costmodel import OpCostModel
+
+
+def _layers_by_cost():
+    """Five ops whose analytic FLOPs are each >=4x apart:
+    embedding << linear-S << conv << linear-L << attention."""
+    ff = FFModel(FFConfig())
+    ids = ff.create_tensor((8, 16), DataType.DT_INT32, name="ids")
+    ff.embedding(ids, num_entries=1000, out_dim=64)
+
+    x1 = ff.create_tensor((32, 128), name="x1")
+    ff.dense(x1, 128)                                  # ~1.0e6 flops
+
+    img = ff.create_tensor((4, 16, 32, 32), name="img")
+    ff.conv2d(img, 32, 3, 3, 1, 1, 1, 1)               # ~3.8e7
+
+    x2 = ff.create_tensor((128, 1024), name="x2")
+    ff.dense(x2, 1024)                                 # ~2.7e8
+
+    q = ff.create_tensor((2, 128, 512), name="q")
+    ff.multihead_attention(q, q, q, embed_dim=512, num_heads=8)  # >5e8
+    wanted = (OperatorType.OP_EMBEDDING, OperatorType.OP_LINEAR,
+              OperatorType.OP_CONV2D, OperatorType.OP_MULTIHEAD_ATTENTION)
+    return [l for l in ff.layers if l.op_type in wanted]
+
+
+def test_measured_matches_analytic_ordering(tmp_path):
+    cm = OpCostModel(MachineSpec.detect(), cache_dir=str(tmp_path))
+    layers = _layers_by_cost()
+    assert len(layers) == 5
+    analytic = [cm.op_cost(l, {}).forward_time for l in layers]
+    measured = []
+    for l in layers:
+        m = cm.measure(l, {})
+        assert m is not None, f"measure failed for {l.op_type}"
+        assert m.forward_time > 0
+        measured.append(m.forward_time)
+    assert np.argsort(analytic[1:]).tolist() == \
+        np.argsort(measured[1:]).tolist(), (analytic, measured)
+    # the tiny embedding must measure far cheaper than the big attention
+    assert measured[0] < measured[-1]
+
+
+def test_disk_cache_roundtrip(tmp_path):
+    spec = MachineSpec.detect()
+    layers = _layers_by_cost()
+    lin = next(l for l in layers if l.op_type == OperatorType.OP_LINEAR)
+    cm1 = OpCostModel(spec, cache_dir=str(tmp_path))
+    cm1.measure_on_device = True
+    cm1._MEASURE_MIN_FLOPS = 0
+    c1 = cm1.op_cost(lin, {0: 2})
+    # fresh model, same cache dir: must hit disk, not re-measure
+    cm2 = OpCostModel(spec, cache_dir=str(tmp_path))
+    cm2.measure_on_device = True
+    cm2._MEASURE_MIN_FLOPS = 0
+    cm2.measure_budget_s = 0.0  # re-measuring would be over budget
+    c2 = cm2.op_cost(lin, {0: 2})
+    assert c1.forward_time == pytest.approx(c2.forward_time)
+    assert c1.forward_time > 0
+
+
+def test_measure_budget_falls_back_to_analytic(tmp_path):
+    spec = MachineSpec.detect()
+    layers = _layers_by_cost()
+    lin = next(l for l in layers if l.op_type == OperatorType.OP_LINEAR)
+    cm = OpCostModel(spec, cache_dir=str(tmp_path))
+    cm.measure_on_device = True
+    cm._MEASURE_MIN_FLOPS = 0
+    cm.measure_budget_s = 0.0
+    c = cm.op_cost(lin, {})
+    # over budget -> analytic roofline, which is deterministic
+    cm_plain = OpCostModel(spec, cache_dir=str(tmp_path))
+    assert c.forward_time == pytest.approx(
+        cm_plain.op_cost(lin, {}).forward_time)

@@ -1,0 +1,117 @@
+"""Pipeline parallelism through the PRODUCT path (FFModel.compile with
+pipeline_stages=k), not a hand-built stage_fn. Reference gap: the
+reference only reserves OP_PIPELINE (ffconst.h:159).
+
+- region detection on a real GPT-2 graph;
+- dp×pp training through compile/fit on the 8-device CPU mesh;
+- numerics: pipelined eval forward == plain eval forward (same params).
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models import GPTConfig, build_gpt2
+from flexflow_tpu.parallel.pipeline_lowering import find_pipeline_region
+
+BATCH, SEQ = 8, 16
+
+
+def _gpt2(pp=1, microbatches=0, dropout=0.0):
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.pipeline_stages = pp
+    cfg.pipeline_microbatches = microbatches
+    g = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                  num_heads=4, max_position=SEQ, dropout=dropout)
+    ff = FFModel(cfg)
+    out = build_gpt2(ff, BATCH, SEQ, g)
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return ff, g
+
+
+def _batch(g, rng):
+    ids = rng.integers(0, g.vocab_size, size=(BATCH, SEQ)).astype(np.int32)
+    return {"input_ids": ids,
+            "position_ids": np.tile(np.arange(SEQ, dtype=np.int32),
+                                    (BATCH, 1)),
+            "label": ids}
+
+
+def test_find_region_gpt2():
+    ff, _ = _gpt2(pp=1)
+    region = find_pipeline_region(ff.layers, 4)
+    assert region is not None
+    assert region.n_stages == 4
+    # 4 transformer blocks of 7 layers -> 1 block per stage
+    assert region.layers_per_stage == 7
+    assert region.n_microbatches == 8
+
+
+def test_find_region_rejects_unpipelinable():
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((8, 16), name="x")
+    h = ff.dense(x, 32)
+    out = ff.dense(h, 4)  # two NON-identical layers: no region
+    assert find_pipeline_region(ff.layers, 2) is None
+    del out
+
+
+def test_pp_train_through_compile():
+    ff, g = _gpt2(pp=4, microbatches=4)
+    assert ff.executor.pipe is not None
+    assert ff.executor.pipe.n_stages == 4
+    assert dict(ff.dmesh.axis_sizes) == {"x0": 2, "x1": 4}
+    rng = np.random.default_rng(0)
+    b = _batch(g, rng)
+    step = ff.executor.make_train_step()
+    losses = []
+    for _ in range(5):
+        bm = ff._run_train_step(step, b)
+        losses.append(float(np.asarray(bm["loss"])))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_pp_forward_matches_plain():
+    """Same weights, pipelined vs plain eval forward must agree."""
+    ff_pp, g = _gpt2(pp=4, microbatches=2)
+    ff_plain, _ = _gpt2(pp=1)
+    pipe = ff_pp.executor.pipe
+
+    # graft plain model's weights into the pipelined model: positional
+    # layer mapping (both models built identically)
+    plain_by_pos = {i: l for i, l in enumerate(ff_plain.layers)}
+    params_pp = dict(ff_pp.params)
+    import jax.numpy as jnp
+    for j, tl in enumerate(pipe.template):
+        key = pipe.param_name(tl)
+        if key not in params_pp:
+            continue
+        stacked = {}
+        for wname in params_pp[key]:
+            slices = []
+            for s in range(pipe.n_stages):
+                src = plain_by_pos[pipe.start + s * pipe.layers_per_stage
+                                   + j]
+                slices.append(np.asarray(ff_plain.params[src.name][wname]))
+            stacked[wname] = jnp.asarray(np.stack(slices))
+        params_pp[key] = stacked
+    # non-region layers map by position too
+    region_names = {l.name for l in
+                    ff_pp.layers[pipe.start:pipe.end]}
+    for i, l in enumerate(ff_pp.layers):
+        if l.name in region_names or l.name not in ff_pp.params:
+            continue
+        params_pp[l.name] = {
+            w: jnp.asarray(np.asarray(ff_plain.params[plain_by_pos[i].name][w]))
+            for w in ff_pp.params[l.name]}
+
+    rng = np.random.default_rng(1)
+    b = _batch(g, rng)
+    del b["label"]
+    fwd_pp = ff_pp.executor.make_forward()
+    fwd_plain = ff_plain.executor.make_forward()
+    y_pp = np.asarray(fwd_pp(params_pp, ff_pp.state, b))
+    y_plain = np.asarray(fwd_plain(ff_plain.params, ff_plain.state, b))
+    np.testing.assert_allclose(y_pp, y_plain, rtol=2e-2, atol=2e-3)

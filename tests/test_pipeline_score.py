@@ -1,0 +1,51 @@
+"""Bubble-model scoring of GPipe candidates in the search
+(search/pipeline_score.py; the reference has no pipeline cost model)."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.models import GPTConfig, build_gpt2
+from flexflow_tpu.parallel.machine import DeviceMesh, MachineSpec
+from flexflow_tpu.search.costmodel import OpCostModel
+from flexflow_tpu.search.pipeline_score import best_pipeline, score_pipeline
+
+
+def _gpt2_layers(num_layers=8, hidden=32, seq=16, batch=8, vocab=128):
+    ff = FFModel(FFConfig())
+    g = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                  num_layers=num_layers, num_heads=4, max_position=seq)
+    build_gpt2(ff, batch, seq, g)
+    return ff.layers
+
+
+def test_score_pipeline_bubble_penalty():
+    """On a compute-bound stack with a fixed microbatch count, the S=8
+    bubble ((M+7)/M) must cost more than the S=4 bubble ((M+3)/M) —
+    the scoring has to reflect schedule length, not just per-stage
+    compute."""
+    layers = _gpt2_layers(8, hidden=512, seq=128, batch=64, vocab=1024)
+    spec = MachineSpec(num_devices=8, generation="v5e")
+    cm = OpCostModel(spec)
+    c4 = score_pipeline(layers, spec, cm, 4, 8, n_microbatches=8)
+    c8 = score_pipeline(layers, spec, cm, 8, 8, n_microbatches=8)
+    assert c4 and c8
+    for c in (c4, c8):
+        assert c.cost > 0 and np.isfinite(c.cost)
+    assert c4.cost < c8.cost
+
+
+def test_score_none_without_region():
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((8, 16), name="x")
+    ff.dense(ff.dense(x, 32), 4)
+    spec = MachineSpec(num_devices=8)
+    assert score_pipeline(ff.layers, spec, OpCostModel(spec), 2, 8) is None
+
+
+def test_best_pipeline_picks_a_divisor():
+    layers = _gpt2_layers(8)
+    spec = MachineSpec(num_devices=8, generation="v5e")
+    dmesh = DeviceMesh(spec)
+    cand = best_pipeline(layers, dmesh, OpCostModel(spec))
+    assert cand is not None
+    assert 8 % cand.n_stages == 0 and cand.n_stages > 1
+    assert cand.dp_size * cand.n_stages == 8

@@ -26,6 +26,11 @@ class FFConfig:
     num_nodes: int = 1
     workers_per_node: int = 0     # 0 = use all local devices
     cpus_per_node: int = 1
+    # multi-host rendezvous (reference: GASNet/mpirun launch, MULTI-NODE.md;
+    # here: jax.distributed — see parallel/distributed.py). Empty = also
+    # honor FF_COORDINATOR_ADDRESS / FF_NUM_PROCESSES / FF_PROCESS_ID env.
+    coordinator_address: str = ""
+    process_id: int = -1
     # memory per device in MB (reference -ll:fsize); used by memory-aware search
     device_mem_mb: int = 0        # 0 = query from device / default model
     # -------- search (reference --budget/--alpha/...) --------
@@ -201,6 +206,10 @@ class FFConfig:
                 cfg.device_mem_mb = int(take())
             elif a == "--nodes":
                 cfg.num_nodes = int(take())
+            elif a == "--coordinator-address":
+                cfg.coordinator_address = take()
+            elif a == "--process-id":
+                cfg.process_id = int(take())
             elif a == "--mesh-shape":
                 cfg.mesh_shape = tuple(int(x) for x in take().split("x"))
             elif a in ("--pp", "--pipeline-stages"):

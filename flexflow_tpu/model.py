@@ -212,8 +212,10 @@ class FFModel:
                                [query, key, value], params, name).outputs[0]
 
     def batch_norm(self, input: Tensor, relu: bool = True,
+                   eps: float = 1e-5, momentum: float = 0.1,
                    name: Optional[str] = None) -> Tensor:
-        return self._unary(OperatorType.OP_BATCHNORM, input, name, relu=relu)
+        return self._unary(OperatorType.OP_BATCHNORM, input, name, relu=relu,
+                           eps=eps, momentum=momentum)
 
     def layer_norm(self, input: Tensor, axes: Sequence[int],
                    elementwise_affine: bool = True, eps: float = 1e-5,
@@ -225,6 +227,13 @@ class FFModel:
     def rms_norm(self, input: Tensor, eps: float = 1e-6,
                  name: Optional[str] = None) -> Tensor:
         return self._unary(OperatorType.OP_RMSNORM, input, name, eps=eps)
+
+    def lstm(self, input: Tensor, hidden_size: int, num_layers: int = 1,
+             name: Optional[str] = None) -> Tensor:
+        """Multi-layer LSTM over (batch, seq, features) — lax.scan
+        recurrence (reference: legacy nmt/lstm.cu app)."""
+        return self._unary(OperatorType.OP_LSTM, input, name,
+                           hidden_size=hidden_size, num_layers=num_layers)
 
     def batch_matmul(self, a: Tensor, b: Tensor,
                      a_seq_length_dim: int = -1, b_seq_length_dim: int = -1,
@@ -483,7 +492,22 @@ class FFModel:
         if self.label_tensor is None and len(unconsumed) == 1:
             self.label_tensor = unconsumed[0]
 
-        spec = machine_spec or MachineSpec.detect()
+        # join the multi-host world first (reference: GASNet launch +
+        # control replication happen before graph_optimize) so that
+        # MachineSpec.detect sees the GLOBAL device view
+        from .parallel.distributed import maybe_initialize
+        maybe_initialize(self.config)
+        if machine_spec is not None:
+            spec = machine_spec
+        elif self.config.machine_model_file:
+            # --machine-model-file: the described machine drives the cost
+            # model / simulator / topology (reference machine_model.cc);
+            # execution is clamped to the live devices
+            spec = MachineSpec.from_file(self.config.machine_model_file)
+            import jax
+            spec.num_devices = min(spec.num_devices, len(jax.devices()))
+        else:
+            spec = MachineSpec.detect()
         mesh_shape = self.config.mesh_shape
         pp = self.config.pipeline_stages
         if strategy is None and pp > 1 and mesh_shape is None:
@@ -727,6 +751,14 @@ class FFModel:
         cur = self.params[layer_name][weight_name]
         assert cur.shape == value.shape, (cur.shape, value.shape)
         self.params[layer_name][weight_name] = jax.device_put(
+            jnp.asarray(value, cur.dtype), cur.sharding)
+
+    def set_state(self, layer_name: str, key: str, value: np.ndarray):
+        """Overwrite one non-trainable state entry (e.g. batch-norm
+        running mean/var imported from a trained torch model)."""
+        cur = self.state[layer_name][key]
+        assert cur.shape == tuple(value.shape), (cur.shape, value.shape)
+        self.state[layer_name][key] = jax.device_put(
             jnp.asarray(value, cur.dtype), cur.sharding)
 
     @property

@@ -53,12 +53,19 @@ class MachineSpec:
     # num_devices for partial slices
     ici_shape: Optional[Tuple[int, ...]] = None
     num_slices: int = 1                     # multi-slice via DCN
+    num_hosts: int = 1                      # controller hosts (DCN NICs)
     dcn_bandwidth_gbps: float = 25.0        # per-host DCN
     ici_latency_us: float = 1.0
     dcn_latency_us: float = 10.0
+    # machine-file overrides of the per-generation constants
+    # (``--machine-model-file``, parallel/topology.py:load_machine_file)
+    ici_bandwidth_override: Optional[float] = None
+    peak_flops_override: Optional[float] = None
 
     @property
     def peak_flops(self) -> float:
+        if self.peak_flops_override is not None:
+            return self.peak_flops_override
         return TPU_GENERATIONS[self.generation][0] * 1e12
 
     @property
@@ -71,7 +78,35 @@ class MachineSpec:
 
     @property
     def ici_bandwidth(self) -> float:
+        if self.ici_bandwidth_override is not None:
+            return self.ici_bandwidth_override
         return TPU_GENERATIONS[self.generation][3] * 1e9
+
+    @property
+    def topology(self):
+        """Physical ICI torus when ``ici_shape`` is known, else None."""
+        if self.ici_shape is None:
+            return None
+        from .topology import TorusTopology
+        return TorusTopology(tuple(self.ici_shape))
+
+    @classmethod
+    def from_file(cls, path: str) -> "MachineSpec":
+        """Load a machine description (``--machine-model-file``); see
+        ``parallel/topology.py:load_machine_file`` for the formats."""
+        from .topology import load_machine_file
+        return load_machine_file(path)
+
+    @property
+    def dcn_bandwidth(self) -> float:
+        """Inter-slice (per-host NIC) bandwidth in bytes/s."""
+        return self.dcn_bandwidth_gbps * 1e9
+
+    @property
+    def devices_per_slice(self) -> int:
+        """Devices reachable over ICI alone; collectives of larger degree
+        must cross DCN (the cost model's slice boundary)."""
+        return max(1, self.num_devices // max(1, self.num_slices))
 
     @classmethod
     def detect(cls, devices=None) -> "MachineSpec":
@@ -79,10 +114,15 @@ class MachineSpec:
 
         import jax
         devices = devices or jax.devices()
-        kind = devices[0].device_kind.lower()
+        kind = devices[0].device_kind.lower().replace(" ", "")
         gen = None
-        for g in ("v6e", "v5p", "v5e", "v4"):
-            if g in kind.replace(" ", ""):
+        # device_kind spellings seen in the wild: "TPU v4", "TPU v5e",
+        # "TPU v5 lite" (= v5e), "TPU v5p", "TPU v6 lite" (= v6e/Trillium)
+        for g, names in (("v6e", ("v6e", "v6lite")),
+                         ("v5p", ("v5p",)),
+                         ("v5e", ("v5e", "v5lite")),
+                         ("v4", ("v4",))):
+            if any(n in kind for n in names):
                 gen = g
                 break
         if devices[0].platform == "cpu":
@@ -98,7 +138,12 @@ class MachineSpec:
         else:
             log.info("MachineSpec.detect: %d x %s (device_kind=%r)",
                      len(devices), gen, devices[0].device_kind)
-        return cls(num_devices=len(devices), generation=gen)
+        # each controller process hosts one DCN island (a slice, or a
+        # CPU-sim process); ICI never spans jax processes in this model
+        n_proc = jax.process_count()
+        n_slices = n_proc if n_proc > 1 and len(devices) % n_proc == 0 else 1
+        return cls(num_devices=len(devices), generation=gen,
+                   num_slices=n_slices)
 
 
 class DeviceMesh:
@@ -112,12 +157,25 @@ class DeviceMesh:
         self.spec = spec
         devices = devices if devices is not None else jax.devices()
         devices = devices[: spec.num_devices]
+        self.dcn_axis: Optional[str] = None
+        n = len(devices)
+        slices = spec.num_slices if (spec.num_slices > 1
+                                     and n % spec.num_slices == 0) else 1
         if mesh_shape is not None:
             factors = [int(s) for s in mesh_shape if int(s) > 1] or [1]
+            self.axis_sizes: Dict[str, int] = {
+                f"x{i}": f for i, f in enumerate(factors)}
+        elif slices > 1:
+            # leading "dcn" axis spans slices/hosts: jax.devices() orders
+            # devices process-major, so the reshape puts each slice's
+            # devices contiguous along the inner (ICI) axes
+            inner = _prime_factors(n // slices) or [1]
+            self.axis_sizes = {"dcn": slices,
+                               **{f"x{i}": f for i, f in enumerate(inner)}}
+            self.dcn_axis = "dcn"
         else:
-            factors = _prime_factors(len(devices)) or [1]
-        self.axis_sizes: Dict[str, int] = {
-            f"x{i}": f for i, f in enumerate(factors)}
+            factors = _prime_factors(n) or [1]
+            self.axis_sizes = {f"x{i}": f for i, f in enumerate(factors)}
         arr = np.asarray(devices).reshape(tuple(self.axis_sizes.values()))
         self.mesh = Mesh(arr, tuple(self.axis_sizes.keys()))
 

@@ -1,0 +1,191 @@
+"""Physical interconnect topology: ICI torus + DCN, with routing.
+
+TPU-native analog of the reference's network/machine-model layer
+(``src/runtime/network.cc``, ``include/flexflow/simulator.h:381-499``:
+``NetworkedMachineModel``, ``ShortestPathNetworkRoutingStrategy``,
+topology generators; file loading in ``src/runtime/machine_model.cc`` via
+``--machine-model-file``, format ``machine_config_example``). The
+reference models sockets/PCIe/NVLink/NIC graphs with shortest-path
+routing; a TPU pod is regular, so the model is exact rather than
+generated: chips sit on an N-D torus (e.g. 4x8 for v5e-32) joined by
+per-dimension ICI links, hosts own contiguous blocks of chips, and
+slices are joined by per-host DCN NICs. Routing is dimension-ordered
+with shortest wrap direction — the ICI fabric's actual scheme.
+
+``TorusTopology.ring_links``/``route`` let the task-graph simulator
+(``search/tasksim.py``) charge traffic to *physical links*, so it can
+tell a 4x8 torus from a flat 32-ring: e.g. concurrent row- and
+column-rings do not contend on the torus but alias onto the same links
+in a flat model.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Link = Tuple[int, int, int]  # (device, dim, direction ±1) — outgoing port
+
+
+@dataclasses.dataclass
+class TorusTopology:
+    """N-D torus of devices; wrap links exist on dims of size >= 3
+    (TPU slices expose wraparound only for full rings)."""
+    shape: Tuple[int, ...]
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def coord(self, dev: int) -> Tuple[int, ...]:
+        c = []
+        for s in reversed(self.shape):
+            c.append(dev % s)
+            dev //= s
+        return tuple(reversed(c))
+
+    def device(self, coord: Sequence[int]) -> int:
+        d = 0
+        for x, s in zip(coord, self.shape):
+            d = d * s + (x % s)
+        return d
+
+    def _wrap(self, dim: int) -> bool:
+        return self.shape[dim] >= 3
+
+    def hop_distance(self, a: int, b: int) -> int:
+        """Total hops of the dimension-ordered route."""
+        ca, cb = self.coord(a), self.coord(b)
+        hops = 0
+        for k, s in enumerate(self.shape):
+            d = abs(ca[k] - cb[k])
+            hops += min(d, s - d) if self._wrap(k) else d
+        return hops
+
+    def route(self, src: int, dst: int) -> List[Link]:
+        """Dimension-ordered shortest-wrap route as outgoing links.
+
+        Analog of ``ShortestPathNetworkRoutingStrategy::get_routes``
+        (``simulator.h:399``) specialized to the torus, where
+        dimension-ordered IS shortest-path."""
+        links: List[Link] = []
+        cur = list(self.coord(src))
+        tgt = self.coord(dst)
+        for k, s in enumerate(self.shape):
+            while cur[k] != tgt[k]:
+                fwd = (tgt[k] - cur[k]) % s
+                back = (cur[k] - tgt[k]) % s
+                step = 1 if (fwd <= back or not self._wrap(k)) else -1
+                if not self._wrap(k) and tgt[k] < cur[k]:
+                    step = -1
+                links.append((self.device(cur), k, step))
+                cur[k] = (cur[k] + step) % s
+        return links
+
+    def ring_links(self, devices: Sequence[int]) -> List[List[Link]]:
+        """Per-step physical links of a ring collective over ``devices``
+        (each participant sends to its successor every step)."""
+        n = len(devices)
+        return [self.route(devices[i], devices[(i + 1) % n])
+                for i in range(n)]
+
+    def link_index(self) -> Dict[Link, int]:
+        """Dense numbering of every outgoing port (device, dim, dir)."""
+        idx: Dict[Link, int] = {}
+        for d in range(self.num_devices):
+            for k in range(len(self.shape)):
+                for s in (1, -1):
+                    idx[(d, k, s)] = len(idx)
+        return idx
+
+
+# ----------------------------------------------------------------------
+# machine description files (--machine-model-file)
+# ----------------------------------------------------------------------
+
+def _parse_ini(text: str) -> Dict[str, str]:
+    """``key = value`` lines, ``#`` comments — the reference's
+    ``machine_config_example`` format."""
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        m = re.match(r"([A-Za-z0-9_]+)\s*=\s*(.+)", line)
+        if m:
+            out[m.group(1)] = m.group(2).strip()
+    return out
+
+
+def load_machine_file(path: str):
+    """Parse a machine description into a ``MachineSpec``.
+
+    Two formats:
+      - JSON (TPU-native): ``{"generation": "v5e", "ici_shape": [4, 8],
+        "num_hosts": 4, "num_slices": 1, "dcn_bandwidth_gbps": 25, ...}``
+      - reference-style INI (``machine_config_example``): ``num_nodes``,
+        ``num_gpus_per_socket`` x ``num_sockets_per_node`` -> devices,
+        ``nvlink_bandwidth`` -> ICI GB/s, ``nic_bandwidth`` -> DCN,
+        latencies in ms.
+    """
+    from .machine import MachineSpec
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        cfg = json.loads(text)
+        is_json = True
+    except json.JSONDecodeError:
+        cfg = _parse_ini(text)
+        is_json = False
+
+    if is_json:
+        spec = MachineSpec(
+            num_devices=int(cfg.get("num_devices") or
+                            _prod(cfg.get("ici_shape", [1])) *
+                            int(cfg.get("num_slices", 1))),
+            generation=cfg.get("generation", "v5e"),
+            ici_shape=tuple(cfg["ici_shape"]) if "ici_shape" in cfg
+            else None,
+            num_slices=int(cfg.get("num_slices", 1)),
+            dcn_bandwidth_gbps=float(cfg.get("dcn_bandwidth_gbps", 25.0)),
+            ici_latency_us=float(cfg.get("ici_latency_us", 1.0)),
+            dcn_latency_us=float(cfg.get("dcn_latency_us", 10.0)),
+        )
+        spec.num_hosts = int(cfg.get("num_hosts", spec.num_slices))
+        if "ici_bandwidth_gbps" in cfg:
+            spec.ici_bandwidth_override = \
+                float(cfg["ici_bandwidth_gbps"]) * 1e9
+        if "peak_tflops" in cfg:
+            spec.peak_flops_override = float(cfg["peak_tflops"]) * 1e12
+        return spec
+
+    # reference INI: nodes x sockets x gpus-per-socket accelerators;
+    # nvlink ≙ intra-node fabric (ICI), nic ≙ inter-node (DCN)
+    nodes = int(cfg.get("num_nodes", 1))
+    sockets = int(cfg.get("num_sockets_per_node", 1))
+    per_socket = int(cfg.get("num_gpus_per_socket", 1))
+    per_node = sockets * per_socket
+    spec = MachineSpec(
+        num_devices=nodes * per_node,
+        num_slices=nodes if nodes > 1 else 1,
+        dcn_bandwidth_gbps=float(cfg.get("nic_bandwidth", 25.0)),
+        # reference latencies are in ms
+        ici_latency_us=float(cfg.get("nvlink_latency", 0.001)) * 1e3,
+        dcn_latency_us=float(cfg.get("nic_latency", 0.01)) * 1e3,
+    )
+    spec.num_hosts = nodes
+    spec.ici_shape = (per_node,)
+    if "nvlink_bandwidth" in cfg:
+        spec.ici_bandwidth_override = float(cfg["nvlink_bandwidth"]) * 1e9
+    return spec
+
+
+def _prod(xs) -> int:
+    n = 1
+    for x in xs:
+        n *= int(x)
+    return n

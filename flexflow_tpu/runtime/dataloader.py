@@ -49,11 +49,9 @@ class SingleDataLoader:
             self.rng.shuffle(self._order)
 
     def _device_put(self, batch: Dict[str, np.ndarray]):
-        out = {}
-        for k, v in batch.items():
-            sh = self.shardings.get(k)
-            out[k] = jax.device_put(v, sh) if sh is not None else jax.device_put(v)
-        return out
+        from ..parallel.distributed import put_global
+        return {k: put_global(v, self.shardings.get(k))
+                for k, v in batch.items()}
 
     def _host_batch(self, i: int) -> Optional[Dict[str, np.ndarray]]:
         lo = i * self.batch_size

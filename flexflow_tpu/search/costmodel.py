@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -319,13 +320,36 @@ class OpCostModel:
     # ------------------------------------------------------------------
     def xfer_cost(self, volume_bytes: float, collective: str,
                   degree: int) -> float:
-        """Collective time over ICI (ring algorithms):
-        all-gather/reduce-scatter move (d-1)/d of the volume; all-reduce
-        2(d-1)/d; all-to-all (d-1)/d with per-hop latency."""
+        """Collective time (ring algorithms): all-gather/reduce-scatter
+        move (d-1)/d of the volume; all-reduce 2(d-1)/d; all-to-all
+        (d-1)/d with per-hop latency.
+
+        Multi-slice machines: a collective whose degree exceeds
+        ``devices_per_slice`` necessarily crosses DCN; its cost is the
+        standard hierarchical decomposition — intra-slice leg over ICI
+        plus an inter-slice leg on the slice-reduced volume over DCN
+        (reference analog: per-link-type simulation in
+        ``src/runtime/network.cc`` / ``simulator.h:381-499``)."""
+        per_slice = self.spec.devices_per_slice
+        if self.spec.num_slices > 1 and degree > per_slice:
+            d_in = math.gcd(degree, per_slice) or 1
+            d_out = degree // d_in
+            return (self._ring_cost(volume_bytes, collective, d_in,
+                                    self.spec.ici_bandwidth,
+                                    self.spec.ici_latency_us * 1e-6)
+                    + self._ring_cost(volume_bytes / max(d_in, 1),
+                                      collective, d_out,
+                                      self.spec.dcn_bandwidth,
+                                      self.spec.dcn_latency_us * 1e-6))
+        return self._ring_cost(volume_bytes, collective, degree,
+                               self.spec.ici_bandwidth,
+                               self.spec.ici_latency_us * 1e-6)
+
+    @staticmethod
+    def _ring_cost(volume_bytes: float, collective: str, degree: int,
+                   bw: float, lat: float) -> float:
         if degree <= 1 or volume_bytes <= 0:
             return 0.0
-        bw = self.spec.ici_bandwidth
-        lat = self.spec.ici_latency_us * 1e-6
         frac = (degree - 1) / degree
         mult = {"all_reduce": 2.0, "all_gather": 1.0, "reduce_scatter": 1.0,
                 "all_to_all": 1.0 / degree, "permute": 1.0 / degree}[collective]

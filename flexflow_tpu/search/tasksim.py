@@ -39,8 +39,17 @@ def _compute_and_place_degree(ann) -> Tuple[int, int]:
 class TaskGraphBuilder:
     """Expands one PCG into (proc, duration, edges) arrays.
 
-    Processors: [0, n_dev) = compute cores; [n_dev, 2*n_dev) = each
-    device's ICI injection port (communication processor)."""
+    Processors: [0, n_dev) = compute cores. Communication:
+      - no physical topology known: [n_dev, 2*n_dev) = each device's ICI
+        injection port (one comm processor per device);
+      - ``MachineSpec.ici_shape`` set (e.g. via --machine-model-file):
+        one processor PER PHYSICAL TORUS LINK (parallel/topology.py), and
+        ring collectives charge every link on each participant's
+        dimension-ordered route — so strategies whose collectives share
+        links (a flat ring snaking a 4x8 torus; concurrent groups
+        aliasing onto one dim) serialize there, exactly the congestion
+        the reference models with per-connection CommDevices
+        (``simulator.h:142``, ``network.cc``)."""
 
     def __init__(self, cost: OpCostModel, n_dev: int):
         self.cost = cost
@@ -48,6 +57,15 @@ class TaskGraphBuilder:
         self.proc: List[int] = []
         self.dur: List[float] = []
         self.edges: List[Tuple[int, int]] = []
+        topo = cost.spec.topology
+        self.topo = topo if topo is not None \
+            and topo.num_devices == n_dev else None
+        self.link_idx = self.topo.link_index() if self.topo else None
+
+    @property
+    def num_procs(self) -> int:
+        return self.n_dev + (len(self.link_idx) if self.link_idx
+                             else self.n_dev)
 
     def add_task(self, proc: int, dur: float) -> int:
         self.proc.append(proc)
@@ -65,10 +83,41 @@ class TaskGraphBuilder:
 
     def comm_tasks(self, devices: List[int], seconds: float,
                    after: List[int]) -> List[int]:
-        """One communication task on each participant's link processor."""
+        """Communication tasks for one ring collective.
+
+        Without a topology: one task on each participant's injection
+        port. With a torus: one task per physical link on each
+        participant's route to its ring successor — multi-hop routes and
+        link sharing between concurrent collectives then cost real time
+        on the shared link processors."""
         out = []
-        for d in devices:
-            t = self.add_task(self.n_dev + d, seconds)
+        if self.topo is not None and len(devices) > 1:
+            for hops in self.topo.ring_links(devices):
+                prev = None
+                for link in hops:
+                    t = self.add_task(self.n_dev + self.link_idx[link],
+                                      seconds)
+                    if prev is None:
+                        for a in after:
+                            self.dep(a, t)
+                    else:
+                        # store-and-forward along the route: hop k starts
+                        # after hop k-1 (the reference charges each
+                        # CommDevice on the path the same way)
+                        self.dep(prev, t)
+                    prev = t
+                if prev is not None:
+                    out.append(prev)
+            if out:
+                return out
+            # fully-local ring (all routes empty): charge the first
+            # participant's first link so time is still accounted
+            first = (devices[0], 0, 1)
+            procs = [self.n_dev + self.link_idx[first]] * len(devices)
+        else:
+            procs = [self.n_dev + d for d in devices]
+        for p in procs:
+            t = self.add_task(p, seconds)
             for a in after:
                 self.dep(a, t)
             out.append(t)
@@ -210,7 +259,7 @@ class TaskGraphBuilder:
                     self.comm_tasks(self.shard_devices(place_deg), secs, ids)
 
         makespan = native.simulate(self.proc, self.dur, self.edges,
-                                   2 * self.n_dev)
+                                   self.num_procs)
         return makespan, mem
 
 

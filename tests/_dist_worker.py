@@ -1,0 +1,52 @@
+"""Worker for tests/test_distributed.py: one controller process of a
+2-process CPU world (2 local devices each -> 4 global)."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["FF_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+    os.environ["FF_NUM_PROCESSES"] = "2"
+    os.environ["FF_PROCESS_ID"] = str(pid)
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 8, in_dim=16, hidden=(32,), num_classes=4)
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy",
+               ["accuracy"], output_tensor=out)
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+    assert ff.dmesh.dcn_axis == "dcn", ff.dmesh.axis_sizes
+    assert ff.dmesh.spec.num_slices == 2
+
+    # identical synthetic dataset on every host (same seed): the loader
+    # contributes only this process's rows to each global batch
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 16)).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 0).astype(np.int32)
+    hist = ff.fit(x, y, epochs=3, verbose=False)
+    loss0, loss1 = hist[0]["loss"], hist[-1]["loss"]
+    assert np.isfinite(loss1), loss1
+    assert loss1 < loss0, (loss0, loss1)
+    print(f"DIST_OK pid={pid} loss0={loss0:.6f} loss1={loss1:.6f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

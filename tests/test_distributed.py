@@ -1,0 +1,53 @@
+"""Multi-host execution: 2 jax.distributed CPU processes x 2 local
+devices train data-parallel over a (dcn=2, x0=2) global mesh.
+
+Reference parity: multi-node training via control replication + GASNet
+(``/root/reference/MULTI-NODE.md``, ``src/runtime/model.cc:3129-3168``);
+here each subprocess is one controller in the jax.distributed world
+(``flexflow_tpu/parallel/distributed.py``).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "_dist_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_training():
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep \
+        + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)  # worker sets its own
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(port), str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"proc {i}:\n{out}\n{err}"
+        assert "DIST_OK" in out, out
+        outs.append(out)
+    # replicated loss scalars must agree across controllers
+    losses = [[tok for tok in o.split() if tok.startswith("loss1=")][0]
+              for o in outs]
+    assert losses[0] == losses[1], losses
+    a = [float(tok.split("=")[1]) for o in outs for tok in o.split()
+         if tok.startswith("loss1=")]
+    assert np.isfinite(a).all()

@@ -213,6 +213,16 @@ class Multiply(Layer):
         return ff.multiply(ins[0], ins[1], name=self.name)
 
 
+class Maximum(Layer):
+    def to_ff(self, ff, ins):
+        return ff.max(ins[0], ins[1], name=self.name)
+
+
+class Minimum(Layer):
+    def to_ff(self, ff, ins):
+        return ff.min(ins[0], ins[1], name=self.name)
+
+
 class Reshape(Layer):
     def __init__(self, target_shape, name=None):
         super().__init__(name)

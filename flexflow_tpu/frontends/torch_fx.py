@@ -3,9 +3,10 @@
 Reference parity: ``python/flexflow/torch/model.py`` (``PyTorchModel.
 torch_to_ff``, ``_trace_model``): trace the module (HF transformers models
 via ``transformers.utils.fx`` when requested), walk nodes in topological
-order, and dispatch each fx node to the matching FFModel builder. Also
-supports the reference's file serialization hand-off (``torch_to_file`` /
-``file_to_ff``) in spirit via ``export_graph``/``import_graph``.
+order, and dispatch each fx node to the matching FFModel builder. The
+reference's file serialization hand-off is supported with the same names
+(``torch_to_file`` / ``file_to_ff``; ``model.py:2408-2604``), so a graph
+traced where torch is installed can be rebuilt and trained without it.
 
 Weight transfer: ``PyTorchModel.copy_weights(ff)`` moves the torch
 module's trained parameters into the compiled FFModel (the reference used
@@ -26,6 +27,113 @@ from ..model import FFModel
 
 def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
+
+
+import contextlib
+
+# HF module classes lowered whole to one FF op (name-matched so imports
+# survive transformers version drift); also forced to be fx leaf modules
+_OPAQUE_HF_MODULES = frozenset({
+    "Conv1D", "T5LayerNorm", "MT5LayerNorm", "LlamaRMSNorm",
+    "MistralRMSNorm", "NewGELUActivation", "GELUActivation",
+    "FastGELUActivation", "QuickGELUActivation",
+})
+
+
+def _meta_override_for(cls_name: str):
+    """Shape-level meta evaluation for an opaque HF module (its real
+    weights cannot mix with the tracer's meta tensors)."""
+    import torch
+
+    if cls_name == "Conv1D":
+        def f(mod, x, *a, **k):
+            return torch.empty(*x.shape[:-1], mod.nf, device="meta",
+                               dtype=x.dtype)
+    else:  # norms / activations: shape-preserving
+        def f(mod, x, *a, **k):
+            return torch.empty_like(x, device="meta")
+    return f
+
+
+@contextlib.contextmanager
+def _patched_hf_mask_vmap(root_module=None):
+    """Tracing-compatibility shims for current transformers versions
+    (whose fx support has drifted behind the modeling code):
+
+    - ``masking_utils`` builds attention masks with ``torch.vmap``, which
+      rejects fx proxies. Its mask functions are elementwise predicates
+      over (batch, head, q_idx, kv_idx), so an index-broadcasting
+      evaluation is exactly equivalent — swap it in while tracing.
+    - ``HFProxy`` installs meta-tensor metadata but defines no
+      ``__iter__``, so tuple unpacking (``q, k, v = x.split(...)``,
+      ``(*x.shape[:-1], -1)``) raises TraceError; iterate by emitting
+      ``getitem`` proxies when the metadata length is known.
+    """
+    try:
+        import transformers.masking_utils as mu
+        from transformers.utils import fx as hf_fx
+    except ImportError:
+        yield
+        return
+    orig = getattr(mu, "_vmap_for_bhqkv", None)
+
+    def broadcast_bhqkv(mask_function, bh_indices: bool = True):
+        if bh_indices:
+            def wrapped(batch, head, q, kv):
+                return mask_function(batch[:, None, None, None],
+                                     head[None, :, None, None],
+                                     q[None, None, :, None],
+                                     kv[None, None, None, :])
+        else:
+            def wrapped(batch, head, q, kv):
+                return mask_function(batch, head, q[:, None], kv[None, :])
+        return wrapped
+
+    def hfproxy_iter(self):
+        md = getattr(self, "_metadata", None)
+        if md is not None and hasattr(md, "__len__"):
+            return iter(self[i] for i in range(len(md)))
+        import torch.fx as tfx
+        return tfx.Proxy.__iter__(self)
+
+    # keep HF composite modules we lower as single FF ops OPAQUE, so
+    # their weights stay trainable layer weights instead of tracing
+    # into addmm over frozen get_attr constants
+    orig_leaf = hf_fx.HFTracer.is_leaf_module
+
+    def leaf(self, m, qualname):
+        return type(m).__name__ in _OPAQUE_HF_MODULES \
+            or orig_leaf(self, m, qualname)
+
+    # meta-shape overrides for each opaque module type present in the
+    # model (their real weights cannot mix with meta tensors)
+    added_overrides = []
+    if root_module is not None:
+        for _, m in root_module.named_modules():
+            t = type(m)
+            if t.__name__ in _OPAQUE_HF_MODULES \
+                    and t not in hf_fx._MANUAL_META_OVERRIDES:
+                hf_fx._MANUAL_META_OVERRIDES[t] = \
+                    _meta_override_for(t.__name__)
+                added_overrides.append(t)
+
+    orig_iter = getattr(hf_fx.HFProxy, "__iter__", None)
+    if orig is not None:
+        mu._vmap_for_bhqkv = broadcast_bhqkv
+    hf_fx.HFProxy.__iter__ = hfproxy_iter
+    hf_fx.HFTracer.is_leaf_module = leaf
+    try:
+        yield
+    finally:
+        if orig is not None:
+            mu._vmap_for_bhqkv = orig
+        hf_fx.HFTracer.is_leaf_module = orig_leaf
+        for t in added_overrides:
+            del hf_fx._MANUAL_META_OVERRIDES[t]
+        if orig_iter is None:
+            del hf_fx.HFProxy.__iter__
+        else:
+            hf_fx.HFProxy.__iter__ = orig_iter
 
 
 class ConstValue:
@@ -60,11 +168,15 @@ def _has_graph_tensor(x) -> bool:
 
 class PyTorchModel:
     def __init__(self, module, is_hf_model: bool = False,
-                 batch_size: int = 1):
+                 batch_size: int = 1,
+                 input_names: Optional[Sequence[str]] = None):
         import torch
         self.module = module.eval()
         self.is_hf_model = is_hf_model
         self.batch_size = batch_size
+        # explicit trace inputs for HF models whose forward signature the
+        # tracer mis-guesses (e.g. T5EncoderModel)
+        self.input_names = list(input_names) if input_names else None
         self._layer_of_module: Dict[str, str] = {}  # torch path -> ff layer
 
     # ------------------------------------------------------------------
@@ -72,19 +184,39 @@ class PyTorchModel:
         import torch.fx
         if self.is_hf_model:
             from transformers.utils import fx as hf_fx
-            return hf_fx.symbolic_trace(self.module)
-        return torch.fx.symbolic_trace(self.module)
+            with _patched_hf_mask_vmap(self.module):
+                if self.input_names:
+                    return hf_fx.symbolic_trace(
+                        self.module, input_names=self.input_names)
+                return hf_fx.symbolic_trace(self.module)
+
+        class _Tracer(torch.fx.Tracer):
+            # keep modules we lower whole (RMS norms etc.) opaque in the
+            # plain-fx path too, so they fuse instead of tracing open
+            def is_leaf_module(self, m, qualname):
+                return type(m).__name__ in _OPAQUE_HF_MODULES \
+                    or super().is_leaf_module(m, qualname)
+
+        tracer = _Tracer()
+        graph = tracer.trace(self.module)
+        return torch.fx.GraphModule(self.module, graph)
 
     # ------------------------------------------------------------------
     def torch_to_ff(self, ff: FFModel, input_tensors: Sequence[Tensor]
                     ) -> List[Tensor]:
         """Build the FF graph from the traced module. ``input_tensors``
-        bind to placeholders in order (reference ``torch_to_ff``)."""
+        bind to placeholders by name when names match, else in order
+        (reference ``torch_to_ff``)."""
         import torch
+        if self.is_hf_model and not self.input_names:
+            # trace exactly the inputs the caller provides, so the HF
+            # tracer does not add placeholders (masks etc.) we can't bind
+            self.input_names = [t.name for t in input_tensors]
         gm = self._trace()
         modules = dict(gm.named_modules())
         env: Dict[str, Any] = {}
         inputs = list(input_tensors)
+        by_name = {t.name: t for t in input_tensors}
         outputs: List[Tensor] = []
 
         def val(x):
@@ -100,7 +232,15 @@ class PyTorchModel:
 
         for node in gm.graph.nodes:
             if node.op == "placeholder":
-                env[node.name] = inputs.pop(0)
+                t = by_name.get(node.target, by_name.get(node.name))
+                if t is not None:
+                    env[node.name] = t
+                    if t in inputs:
+                        inputs.remove(t)
+                else:
+                    assert inputs, \
+                        f"no tensor for placeholder {node.name!r}"
+                    env[node.name] = inputs.pop(0)
             elif node.op == "get_attr":
                 t = self._get_attr(gm, node.target)
                 env[node.name] = ConstValue(t.detach().cpu().numpy())
@@ -239,7 +379,11 @@ class PyTorchModel:
                 out = ff.pool2d(x, kh, kw, sh, sw, ph, pw,
                                 PoolType.POOL_AVG, name=name)
         elif isinstance(m, nn.BatchNorm2d):
-            out = ff.batch_norm(x, relu=False, name=name)
+            # momentum=0.0 is legitimate (frozen running stats); only
+            # None (torch's "cumulative average" mode) needs a default
+            mom = 0.1 if m.momentum is None else m.momentum
+            out = ff.batch_norm(x, relu=False, eps=m.eps,
+                                momentum=mom, name=name)
         elif isinstance(m, nn.LayerNorm):
             axes = list(range(-len(m.normalized_shape), 0))
             out = ff.layer_norm(x, axes, m.elementwise_affine, m.eps,
@@ -290,6 +434,24 @@ class PyTorchModel:
                     "target": f"{node.target}.{i}"})
                 out = self._module_to_ff(ff, sub, fake, [out])
             return out
+        # HF-transformers module classes, matched by name so importing
+        # does not require the specific transformers version (the
+        # reference's frontend special-cases these the same way,
+        # python/flexflow/torch/model.py T5LayerNorm handling)
+        elif type(m).__name__ == "Conv1D" and hasattr(m, "nf"):
+            # transformers.pytorch_utils.Conv1D (GPT-2): a Linear that
+            # stores its kernel (in, out) — FF's native layout
+            out = ff.dense(x, m.nf, use_bias=True, name=name)
+        elif type(m).__name__ in ("T5LayerNorm", "MT5LayerNorm",
+                                  "LlamaRMSNorm", "MistralRMSNorm"):
+            # RMS norm (no mean subtraction, no bias): fuse the whole
+            # module to OP_RMSNORM instead of tracing its pow/mean/rsqrt
+            eps = getattr(m, "variance_epsilon", getattr(m, "eps", 1e-6))
+            out = ff.rms_norm(x, eps=eps, name=name)
+        elif type(m).__name__ in ("NewGELUActivation", "GELUActivation",
+                                  "FastGELUActivation",
+                                  "QuickGELUActivation"):
+            out = ff.gelu(x, name=name)
         else:
             raise NotImplementedError(
                 f"torch module {type(m).__name__} not supported")
@@ -505,12 +667,52 @@ class PyTorchModel:
             return ff.softmax(x, kwargs.get("dim", -1), name=name)
         if method == "relu":
             return ff.relu(x, name=name)
+        if method == "pow":
+            return ff.pow(x, args[1], name=name)
+        if method == "rsqrt":
+            return ff.rsqrt(x, name=name)
+        if method == "sqrt":
+            return ff.sqrt(x, name=name)
+        if method == "exp":
+            return ff.exp(x, name=name)
+        if method == "tanh":
+            return ff.tanh(x, name=name)
+        if method == "sigmoid":
+            return ff.sigmoid(x, name=name)
+        if method == "sum":
+            dims = args[1] if len(args) > 1 else kwargs.get("dim")
+            dims = [dims] if isinstance(dims, int) else list(dims)
+            return ff.reduce_sum(x, dims, kwargs.get("keepdim", False),
+                                 name=name)
+        if method == "masked_fill":
+            mask, value = args[1], args[2]
+            # additive lowering: x + where(mask, value, 0); exact for the
+            # -inf/-1e9 attention-mask pattern this appears in
+            if isinstance(mask, ConstValue):
+                add = ConstValue(np.where(
+                    mask.arr, np.float32(max(value, -1e9)),
+                    np.float32(0.0)))
+                return ff.add(x, self._ensure_tensor(ff, add,
+                                                     f"{name}_mf"),
+                              name=name)
+            raise NotImplementedError("masked_fill with tensor mask")
         if method == "unsqueeze":
             return ff.unsqueeze(x, [args[1]], name=name)
         if method == "squeeze":
             return ff.squeeze(x, [args[1]], name=name)
         if method == "split":
-            return ff.split(x, args[1], kwargs.get("dim", 0), name=name)
+            # torch semantics: split(split_size, dim) = chunks OF SIZE
+            # split_size (FF's int arg means number of chunks)
+            dim = kwargs.get("dim", args[2] if len(args) > 2 else 0)
+            ssz = args[1]
+            if isinstance(ssz, int):
+                d = x.shape[dim % len(x.shape)]
+                sizes = [ssz] * (d // ssz)
+                if d % ssz:
+                    sizes.append(d % ssz)
+            else:
+                sizes = [int(s) for s in ssz]
+            return ff.split(x, sizes, dim, name=name)
         raise NotImplementedError(f"torch method {method} not supported")
 
     # ------------------------------------------------------------------
@@ -543,10 +745,98 @@ class PyTorchModel:
                 ff.set_weights(lname, "bias",
                                mod.bias.detach().cpu().numpy())
             elif isinstance(mod, nn.BatchNorm2d):
-                ff.set_weights(lname, "scale",
+                if mod.affine:
+                    ff.set_weights(lname, "scale",
+                                   mod.weight.detach().cpu().numpy())
+                    ff.set_weights(lname, "bias",
+                                   mod.bias.detach().cpu().numpy())
+                if mod.track_running_stats and lname in ff.state:
+                    ff.set_state(lname, "mean",
+                                 mod.running_mean.detach().cpu().numpy())
+                    ff.set_state(lname, "var",
+                                 mod.running_var.detach().cpu().numpy())
+            elif type(mod).__name__ == "Conv1D" and hasattr(mod, "nf"):
+                # GPT-2 Conv1D kernel is already (in, out)
+                ff.set_weights(lname, "kernel",
                                mod.weight.detach().cpu().numpy())
                 ff.set_weights(lname, "bias",
                                mod.bias.detach().cpu().numpy())
+            elif type(mod).__name__ in ("T5LayerNorm", "MT5LayerNorm",
+                                        "LlamaRMSNorm", "MistralRMSNorm"):
+                ff.set_weights(lname, "scale",
+                               mod.weight.detach().cpu().numpy())
+
+
+    # ------------------------------------------------------------------
+    # file serialization hand-off (reference ``torch_to_file`` /
+    # ``file_to_ff``, python/flexflow/torch/model.py:2408-2604): trace
+    # once where torch is installed, then rebuild + train the FF graph
+    # anywhere WITHOUT torch. Graph structure goes into JSON (the same
+    # program schema the strategy export uses); constant tensors (masks,
+    # folded buffers) ride a sidecar ``<path>.npz``.
+    # ------------------------------------------------------------------
+    def torch_to_file(self, ff: FFModel,
+                      input_tensors: Sequence[Tensor], path: str):
+        """Build the FF graph from the traced module and serialize it
+        (plus its constant inputs) to ``path`` (+ ``path.npz``)."""
+        import json
+        from ..search.serialization import program_to_json
+        outputs = self.torch_to_ff(ff, input_tensors)
+        consts = [t for t in ff.input_tensors
+                  if t.get_tensor() is not None]
+        doc = {
+            "format": "flexflow-tpu-graph-v1",
+            "inputs": [{"name": t.name, "shape": list(t.shape),
+                        "dtype": int(t.dtype)}
+                       for t in input_tensors],
+            "consts": [{"name": t.name, "shape": list(t.shape),
+                        "dtype": int(t.dtype)} for t in consts],
+            "program": program_to_json(
+                ff.layers, list(input_tensors) + consts, outputs[0]),
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        if consts:
+            np.savez(path + ".npz",
+                     **{t.name: np.asarray(t.get_tensor()) for t in consts})
+        return outputs
+
+    export_graph = torch_to_file
+
+    @staticmethod
+    def file_to_ff(path: str, ff: FFModel,
+                   input_tensors: Sequence[Tensor]) -> List[Tensor]:
+        """Rebuild a serialized graph into ``ff`` — no torch needed.
+        ``input_tensors`` bind by position to the recorded inputs."""
+        import json
+        import os
+        from ..search.serialization import program_from_json
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc.get("format") == "flexflow-tpu-graph-v1", \
+            f"not a graph file: {path}"
+        assert len(input_tensors) == len(doc["inputs"]), \
+            (len(input_tensors), len(doc["inputs"]))
+        for t, rec in zip(input_tensors, doc["inputs"]):
+            assert tuple(t.shape) == tuple(rec["shape"]), \
+                f"input {rec['name']}: expected {rec['shape']}, " \
+                f"got {t.shape}"
+            t.name = rec["name"]
+        consts = []
+        if doc["consts"]:
+            data = np.load(path + ".npz")
+            for rec in doc["consts"]:
+                t = ff.create_tensor(tuple(rec["shape"]),
+                                     dtype=DataType(rec["dtype"]),
+                                     create_grad=False, name=rec["name"])
+                t.set_tensor(data[rec["name"]])
+                consts.append(t)
+        layers, out_t = program_from_json(
+            doc["program"], list(input_tensors) + consts)
+        ff.layers.extend(layers)
+        return [out_t]
+
+    import_graph = file_to_ff
 
 
 def torch_to_flexflow_graph(module, ff: FFModel,

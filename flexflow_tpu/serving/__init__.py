@@ -1,0 +1,19 @@
+"""TPU-native inference serving (the reference's Triton backend analog).
+
+Reference parity: ``/root/reference/triton/`` (~16.7k LoC C++) serves
+FlexFlow-compiled models behind Triton's HTTP/gRPC batching frontend.
+TPU-native redesign: the expensive part of serving on TPU is (a) keeping
+one warm jitted forward per bucketed shape (recompiles are seconds) and
+(b) batching requests into those buckets; both live here in
+``InferenceSession`` / ``BatchScheduler``, and a dependency-free HTTP
+frontend (``serve_http``) exposes the Triton-style
+``POST /v2/models/<name>/infer`` JSON API. Models arrive either as a
+live ``FFModel`` or from the torch-frontend's serialization hand-off
+(``ModelRepository.load_graph`` -> ``file_to_ff``).
+"""
+from .session import InferenceSession, ModelRepository
+from .scheduler import BatchScheduler
+from .http_server import serve_http
+
+__all__ = ["InferenceSession", "ModelRepository", "BatchScheduler",
+           "serve_http"]

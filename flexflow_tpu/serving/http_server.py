@@ -1,0 +1,95 @@
+"""Dependency-free HTTP frontend speaking the Triton KServe-style API.
+
+Endpoints (JSON bodies, shapes row-major):
+  - ``GET  /v2/health/ready``            -> 200 when serving
+  - ``GET  /v2/models``                  -> {"models": [names]}
+  - ``POST /v2/models/<name>/infer``     -> {"outputs": [{"data", "shape"}]}
+    body: {"inputs": [{"name": ..., "shape": [...], "data": [flat]}]}
+
+Reference analog: the Triton backend's HTTP surface
+(``/root/reference/triton/README.md``); stdlib-only so it runs anywhere
+the framework does.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+def _make_handler(repo, schedulers):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v2/health/ready":
+                return self._send(200, {"ready": True})
+            if self.path == "/v2/models":
+                return self._send(200, {"models": repo.names()})
+            return self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            parts = self.path.strip("/").split("/")
+            # v2/models/<name>/infer
+            if len(parts) != 4 or parts[:2] != ["v2", "models"] \
+                    or parts[3] != "infer":
+                return self._send(404, {"error": f"no route {self.path}"})
+            name = parts[2]
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n))
+                inputs = {}
+                for rec in doc["inputs"]:
+                    arr = np.asarray(rec["data"], dtype=np.dtype(
+                        rec.get("datatype", "float32").lower()
+                        .replace("fp", "float")))
+                    inputs[rec["name"]] = arr.reshape(rec["shape"])
+                sched = schedulers.get(name)
+                out = sched.infer(inputs) if sched is not None \
+                    else repo.get(name).infer(inputs)
+                self._send(200, {"outputs": [{
+                    "name": "output0", "shape": list(out.shape),
+                    "data": np.asarray(out, np.float32).ravel().tolist()}]})
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+def serve_http(repo, host: str = "127.0.0.1", port: int = 8000,
+               batching: bool = True, block: bool = True,
+               max_batch: int = 64, max_delay_ms: float = 2.0):
+    """Serve a :class:`ModelRepository`. ``block=False`` returns the
+    (server, thread, schedulers) triple for in-process testing."""
+    from .scheduler import BatchScheduler
+    schedulers = {}
+    if batching:
+        for name in repo.names():
+            schedulers[name] = BatchScheduler(
+                repo.get(name), max_batch=max_batch,
+                max_delay_ms=max_delay_ms)
+    srv = ThreadingHTTPServer((host, port), _make_handler(repo, schedulers))
+    if block:
+        try:
+            srv.serve_forever()
+        finally:
+            for s in schedulers.values():
+                s.close()
+        return None
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t, schedulers

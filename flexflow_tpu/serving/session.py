@@ -1,0 +1,115 @@
+"""Inference session: bucketed, cached, eval-mode jitted forwards.
+
+The Triton backend's per-model execution context
+(``/root/reference/triton/src/model_instance_state.cc`` equivalent)
+reduced to what matters on TPU: a warm XLA executable per (batch-bucket,
+input-shape) and zero-copy host->device batch assembly.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _next_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class InferenceSession:
+    """Wraps a compiled FFModel for serving.
+
+    Requests of any batch size are padded up to the nearest bucket so
+    XLA compiles once per bucket (the recompile-avoidance trick Triton
+    gets from its preferred_batch_size config).
+    """
+
+    def __init__(self, ff, batch_buckets: Sequence[int] = (1, 4, 16, 64)):
+        assert ff.executor is not None, "compile() the model first"
+        self.ff = ff
+        self.buckets = sorted(set(int(b) for b in batch_buckets))
+        self._fwd = ff.executor.make_forward()
+        self._lock = threading.Lock()
+
+    @property
+    def input_names(self) -> List[str]:
+        return [t.name for t in self.ff.graph_inputs]
+
+    def infer(self, inputs: Dict[str, np.ndarray]) -> np.ndarray:
+        """Run one batch; pads to the bucket and slices the result.
+        Batches larger than the biggest bucket run in bucket-sized
+        chunks (one executable, several dispatches)."""
+        names = self.input_names
+        missing = [n for n in names if n not in inputs]
+        assert not missing, f"missing inputs: {missing}"
+        n = int(next(iter(inputs.values())).shape[0])
+        cap = self.buckets[-1]
+        if n > cap:
+            return np.concatenate(
+                [self.infer({k: v[i:i + cap] for k, v in inputs.items()})
+                 for i in range(0, n, cap)], axis=0)
+        bucket = _next_bucket(n, self.buckets)
+        padded = {}
+        for name in names:
+            arr = np.ascontiguousarray(inputs[name])
+            assert arr.shape[0] == n, \
+                f"ragged batch: {name} has {arr.shape[0]} rows, want {n}"
+            if bucket != n:
+                pad = np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)
+                arr = np.concatenate([arr, pad], axis=0)
+            padded[name] = arr
+        with self._lock:  # jax dispatch of ONE model's forward at a time
+            out = self._fwd(self.ff.params, self.ff.state, padded)
+        return np.asarray(out)[:n]
+
+
+class ModelRepository:
+    """Name -> session registry (Triton model-repository analog)."""
+
+    def __init__(self):
+        self._models: Dict[str, InferenceSession] = {}
+
+    def register(self, name: str, session: InferenceSession):
+        self._models[name] = session
+
+    def load_graph(self, name: str, path: str,
+                   input_shapes: Sequence[Sequence[int]],
+                   checkpoint_dir: Optional[str] = None,
+                   batch_buckets: Sequence[int] = (1, 4, 16, 64),
+                   config=None):
+        """Serve a serialized graph (``PyTorchModel.torch_to_file`` /
+        strategy-export output) without its source framework: rebuild
+        through ``file_to_ff``, optionally restore trained weights from
+        a checkpoint, and register an eval session."""
+        from ..config import FFConfig
+        from ..model import FFModel
+        from ..runtime.optimizers import SGDOptimizer
+        from ..frontends.torch_fx import PyTorchModel
+
+        cfg = config or FFConfig()
+        cfg.only_data_parallel = True
+        ff = FFModel(cfg)
+        ins = [ff.create_tensor(tuple(s), name=f"in{i}")
+               for i, s in enumerate(input_shapes)]
+        outs = PyTorchModel.file_to_ff(path, ff, ins)
+        ff.compile(SGDOptimizer(0.0), "identity", [],
+                   output_tensor=outs[0])
+        if checkpoint_dir:
+            from ..runtime.checkpoint import restore_model_checkpoint
+            restore_model_checkpoint(ff, checkpoint_dir)
+        sess = InferenceSession(ff, batch_buckets)
+        self.register(name, sess)
+        return sess
+
+    def get(self, name: str) -> InferenceSession:
+        if name not in self._models:
+            raise KeyError(
+                f"model {name!r} not loaded (have {list(self._models)})")
+        return self._models[name]
+
+    def names(self) -> List[str]:
+        return list(self._models)

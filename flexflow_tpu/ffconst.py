@@ -202,6 +202,9 @@ class OperatorType(enum.IntEnum):
     OP_RMSNORM = enum.auto()
     OP_RING_ATTENTION = enum.auto()
     OP_ALLTOALL = enum.auto()
+    # LSTM: the reference ships it only as the hand-rolled legacy NMT app
+    # (nmt/lstm.cu) outside the op registry; here it is a first-class op
+    OP_LSTM = enum.auto()
     OP_INVALID = enum.auto()
 
 

@@ -164,3 +164,42 @@ def build_gpt2(ff: FFModel, batch_size: int, seq_len: int,
     t = ff.layer_norm(t, [-1])
     logits = ff.dense(t, cfg.vocab_size, use_bias=False, name="lm_head")
     return ff.softmax(logits)
+
+
+@dataclasses.dataclass
+class NMTConfig:
+    """LSTM seq2seq with attention (reference legacy ``nmt/`` app:
+    embed -> stacked LSTM encoder/decoder -> attention -> softmax,
+    ``nmt/nmt.cc``/``lstm.cu``)."""
+    src_vocab: int = 32000
+    tgt_vocab: int = 32000
+    embed_dim: int = 512
+    hidden_size: int = 512
+    num_layers: int = 2
+    num_heads: int = 1           # attention over encoder states
+
+
+def build_nmt(ff: FFModel, batch_size: int, src_len: int, tgt_len: int,
+              cfg: NMTConfig | None = None):
+    """Teacher-forcing NMT: encoder LSTM over the source, decoder LSTM
+    over the (shifted) target, decoder attends to encoder states, dense
+    projects to the target vocabulary. Returns (b, tgt_len, tgt_vocab)
+    logits; train with sparse CE against the gold target."""
+    cfg = cfg or NMTConfig()
+    src = ff.create_tensor((batch_size, src_len), dtype=DataType.DT_INT32,
+                           name="src_ids")
+    tgt = ff.create_tensor((batch_size, tgt_len), dtype=DataType.DT_INT32,
+                           name="tgt_ids")
+    enc = ff.embedding(src, cfg.src_vocab, cfg.embed_dim,
+                       AggrMode.AGGR_MODE_NONE, name="src_embed")
+    enc = ff.lstm(enc, cfg.hidden_size, cfg.num_layers, name="encoder")
+    dec = ff.embedding(tgt, cfg.tgt_vocab, cfg.embed_dim,
+                       AggrMode.AGGR_MODE_NONE, name="tgt_embed")
+    dec = ff.lstm(dec, cfg.hidden_size, cfg.num_layers, name="decoder")
+    # attention readout over encoder states (the nmt app's per-step
+    # attention, batched over all decoder positions)
+    ctx = ff.multihead_attention(dec, enc, enc, cfg.hidden_size,
+                                 cfg.num_heads, name="attention")
+    h = ff.add(dec, ctx, name="attn_residual")
+    return ff.dense(h, cfg.tgt_vocab, ActiMode.AC_MODE_NONE,
+                    name="vocab_proj")

@@ -4,4 +4,5 @@ from . import nn_ops        # noqa: F401
 from . import element_ops   # noqa: F401
 from . import tensor_ops    # noqa: F401
 from . import moe_ops       # noqa: F401
+from . import rnn_ops       # noqa: F401
 from . import parallel_ops  # noqa: F401

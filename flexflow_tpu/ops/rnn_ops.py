@@ -1,0 +1,102 @@
+"""Recurrent ops: multi-layer LSTM as a ``lax.scan`` recurrence.
+
+Reference parity: the legacy NMT app's hand-rolled cuDNN LSTM
+(``/root/reference/nmt/lstm.cu``, ``rnn.h`` — per-timestep kernel
+launches outside the op registry). TPU-native redesign: the whole
+recurrence is ONE ``lax.scan`` inside the jitted step — XLA unrolls
+nothing, the (x @ W_ih) input projection for ALL timesteps is hoisted
+into a single big MXU matmul before the scan, and only the (h @ W_hh)
+recurrent matmul rides the sequential carry. Backward is jax.grad
+through the scan (no hand-written BPTT).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import WeightSpec
+from ..ffconst import DataType, InitializerType, OperatorType
+from .registry import OpDef, compute_dtype, register
+
+
+@register
+class LSTMOp(OpDef):
+    """Multi-layer unidirectional LSTM.
+
+    input  (b, s, d) -> output (b, s, h); zero initial state. Weights per
+    layer l: ``w{l}`` ((in_l + h), 4h) with gate order [i, f, g, o] and
+    ``b{l}`` (4h,); forget-gate bias +1 at init (standard practice; the
+    reference's nmt app initializes uniformly).
+    """
+    op_type = OperatorType.OP_LSTM
+
+    def infer(self, params, in_shapes, in_dtypes):
+        b, s, _ = in_shapes[0]
+        return [((b, s, params["hidden_size"]), in_dtypes[0])]
+
+    def weights(self, params, in_shapes, in_dtypes):
+        h = params["hidden_size"]
+        layers = params.get("num_layers", 1)
+        d = in_shapes[0][2]
+        dt = in_dtypes[0]
+        out = []
+        for l in range(layers):
+            in_l = d if l == 0 else h
+            out.append(WeightSpec(f"w{l}", (in_l + h, 4 * h), dt,
+                                  InitializerType.GLOROT_UNIFORM))
+            out.append(WeightSpec(f"b{l}", (4 * h,), dt,
+                                  InitializerType.ZERO))
+        return out
+
+    def emit(self, params, inputs, weights, ctx, name):
+        (x,) = inputs
+        h = params["hidden_size"]
+        layers = params.get("num_layers", 1)
+        b = x.shape[0]
+        mdt = compute_dtype(ctx, x.dtype)
+
+        y = x
+        for l in range(layers):
+            w = weights[f"w{l}"]
+            bias = weights[f"b{l}"].astype(jnp.float32)
+            d_in = y.shape[-1]
+            w_ih, w_hh = w[:d_in], w[d_in:]
+            # hoist the input projection out of the scan: one big matmul
+            # over (b*s, d) instead of s small ones
+            zx = jnp.einsum("bsd,dk->bsk", y.astype(mdt), w_ih.astype(mdt),
+                            preferred_element_type=jnp.float32)
+            zx = jnp.swapaxes(zx + bias, 0, 1)          # (s, b, 4h)
+
+            def step(carry, zx_t, w_hh=w_hh):
+                h_prev, c_prev = carry
+                z = zx_t + jnp.einsum(
+                    "bh,hk->bk", h_prev.astype(mdt), w_hh.astype(mdt),
+                    preferred_element_type=jnp.float32)
+                i, f, g, o = jnp.split(z, 4, axis=-1)
+                # +1 forget bias applied here so the ZERO-initialized
+                # bias weight still starts the gate open
+                c = jax.nn.sigmoid(f + 1.0) * c_prev \
+                    + jax.nn.sigmoid(i) * jnp.tanh(g)
+                hh = jax.nn.sigmoid(o) * jnp.tanh(c)
+                return (hh, c), hh
+
+            init = (jnp.zeros((b, h), jnp.float32),
+                    jnp.zeros((b, h), jnp.float32))
+            _, hs = jax.lax.scan(step, init, zx)
+            y = jnp.swapaxes(hs, 0, 1).astype(x.dtype)  # (b, s, h)
+        return [y]
+
+    def flops(self, params, in_shapes, out_shapes):
+        b, s, d = in_shapes[0]
+        h = params["hidden_size"]
+        layers = params.get("num_layers", 1)
+        total = 0.0
+        for l in range(layers):
+            in_l = d if l == 0 else h
+            total += 2.0 * b * s * (in_l + h) * 4 * h
+        return total
+
+    def backward_flops_factor(self):
+        return 2.0

@@ -10,10 +10,17 @@ perform (``scripts/osdi22ae/*.sh``).
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Callable, Dict, Optional
 
 import numpy as np
+
+# examples are runnable standalone (cwd=examples/) without pip-installing
+# the package: put the repo root on sys.path ahead of the import below
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 # honor JAX_PLATFORMS=cpu even when a TPU platform plugin is ambient
 # (the plugin ignores the env var; jax.config after import does not)

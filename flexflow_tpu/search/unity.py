@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import itertools
+import time
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -233,17 +234,45 @@ def _bytes_of_spec(w) -> int:
 # ---------------------------------------------------------------------------
 # Best-first substitution search (base_optimize)
 # ---------------------------------------------------------------------------
+class SearchPool:
+    """Global work budget shared by every ``base_optimize`` call of one
+    search. The DP recursion fans out over split positions x cut
+    layouts; without a GLOBAL cap the per-call budget multiplies into
+    hours on deep graphs (the reference's budget is likewise a whole-
+    search iteration count, ``substitution.cc`` ``budget--``)."""
+
+    __slots__ = ("remaining", "deadline")
+
+    def __init__(self, expansions: int, seconds: float):
+        self.remaining = expansions
+        self.deadline = time.monotonic() + seconds
+
+    def take(self, want: int) -> int:
+        if time.monotonic() >= self.deadline:
+            return 0
+        got = max(0, min(want, self.remaining))
+        return got
+
+    def spend(self, used: int):
+        self.remaining -= used
+
+
 def base_optimize(graph: Graph, xfers: Sequence[GraphXfer],
                   evaluator: GraphCostEvaluator, budget: int = 32,
                   alpha: float = 1.05, max_num_ops: int = 512,
                   in_pins: Optional[Dict[int, Layout]] = None,
-                  out_pin: Optional[Layout] = None
+                  out_pin: Optional[Layout] = None,
+                  pool: Optional[SearchPool] = None
                   ) -> Tuple[Graph, float]:
     """Cost-ordered best-first search over rewrites
     (reference ``base_optimize``, ``substitution.cc:2229``)."""
     counter = itertools.count()
     start_cost = evaluator.graph_cost(graph, in_pins, out_pin).total
     best, best_cost = graph, start_cost
+    if pool is not None:
+        budget = pool.take(budget)
+        if budget == 0:
+            return best, best_cost
     heap: List[Tuple[float, int, Graph]] = [(start_cost, next(counter),
                                             graph)]
     seen = {graph.hash()}
@@ -264,6 +293,8 @@ def base_optimize(graph: Graph, xfers: Sequence[GraphXfer],
                     best, best_cost = g2, c2
                 if c2 <= alpha * best_cost:
                     heapq.heappush(heap, (c2, next(counter), g2))
+    if pool is not None:
+        pool.spend(expansions)
     return best, best_cost
 
 
@@ -274,13 +305,18 @@ class UnitySearch:
     def __init__(self, evaluator: GraphCostEvaluator,
                  xfers: Sequence[GraphXfer], budget: int = 32,
                  alpha: float = 1.05, base_optimize_threshold: int = 12,
-                 max_num_ops: int = 512):
+                 max_num_ops: int = 512,
+                 pool: Optional[SearchPool] = None):
         self.ev = evaluator
         self.xfers = list(xfers)
         self.budget = budget
         self.alpha = alpha
         self.threshold = base_optimize_threshold
         self.max_num_ops = max_num_ops
+        # whole-search budget: the DP visits many (subgraph, pins) leaves;
+        # give the search `budget` expansions per leaf locally but at most
+        # 16x `budget` expansions / 15+4*budget seconds GLOBALLY
+        self.pool = pool or SearchPool(budget * 16, 15.0 + 4.0 * budget)
         self._memo: Dict[Tuple, Tuple[Graph, float]] = {}
 
     def _cut_layout_candidates(self, t: Tensor,
@@ -354,10 +390,11 @@ class UnitySearch:
                     if graph.in_edges[n] and graph.out_edges[n]
                     and n.op_type not in PARALLEL_OPS
                     and n is not order[-1]]
-        if graph.num_nodes() <= self.threshold or not interior or depth > 6:
+        if graph.num_nodes() <= self.threshold or not interior \
+                or depth > 6 or self.pool.take(1) == 0:
             res = base_optimize(graph, self.xfers, self.ev, self.budget,
                                 self.alpha, self.max_num_ops, in_pins,
-                                out_pin)
+                                out_pin, pool=self.pool)
             self._memo[key] = res
             return res
         # DP over split positions × cut layouts (reference recurses at

@@ -17,6 +17,7 @@ FAST = [
     ("mixture_of_experts.py", ["-b", "16", "--only-data-parallel"]),
     ("candle_uno.py", ["-b", "8", "--only-data-parallel"]),
     ("transformer.py", ["-b", "4", "--only-data-parallel"]),
+    ("nmt.py", ["-b", "8", "--only-data-parallel"]),
 ]
 
 SLOW = [
@@ -32,6 +33,8 @@ SLOW = [
 def _run(script, args):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(EXAMPLES) + os.pathsep \
+        + env.get("PYTHONPATH", "")
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8")
     # examples force CPU via jax.config when JAX_PLATFORMS is exported —

@@ -277,7 +277,8 @@ def base_optimize(graph: Graph, xfers: Sequence[GraphXfer],
                                             graph)]
     seen = {graph.hash()}
     expansions = 0
-    while heap and expansions < budget:
+    while heap and expansions < budget \
+            and (pool is None or time.monotonic() < pool.deadline):
         cost, _, g = heapq.heappop(heap)
         if cost > alpha * best_cost:
             continue  # alpha-pruned

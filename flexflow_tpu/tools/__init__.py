@@ -1,0 +1,5 @@
+"""Tools parity with the reference's ``tools/`` side products:
+``protobuf_to_json`` (rules .pb -> JSON) and ``substitutions_to_dot``.
+"""
+from .pb_rules import rules_pb_to_json  # noqa: F401
+from .subst_dot import substitutions_to_dot  # noqa: F401

@@ -1,0 +1,160 @@
+"""TASO substitution-rule ``.pb`` -> JSON converter.
+
+Reference parity: ``tools/protobuf_to_json`` (C++ + protobuf codegen over
+``rules.proto``). The schema is four tiny proto2 messages (RuleCollection
+> Rule > Operator > Tensor/Parameter, all int32 fields), so instead of a
+protoc dependency this decodes the protobuf wire format directly
+(~60 lines: varints + length-delimited submessages) and emits the same
+JSON shape ``search/substitution_loader.py`` already consumes — giving
+the full .pb -> JSON -> GraphXfer path for the reference's shipped
+``substitutions/graph_subst_3_v2.pb``.
+
+The ``.pb`` carries TASO-era enum numberings, NOT ``ffconst.h``'s — the
+name tables below mirror the reference converter's own translation
+tables (``protobuf_to_json.cc:14-118``; interop schema data, including
+its "OP_CONSTANT_POOl" spelling so output is byte-comparable with the
+shipped JSON).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+# TASO OpType 0..30 (protobuf_to_json.cc:14-46)
+_OP_NAMES = [
+    "OP_INPUT", "OP_WEIGHT", "OP_ANY", "OP_CONV2D", "OP_DROPOUT",
+    "OP_LINEAR", "OP_POOL2D_MAX", "OP_POOL2D_AVG", "OP_RELU", "OP_SIGMOID",
+    "OP_TANH", "OP_BATCHNORM", "OP_CONCAT", "OP_SPLIT", "OP_RESHAPE",
+    "OP_TRANSPOSE", "OP_EW_ADD", "OP_EW_MUL", "OP_MATMUL", "OP_MUL",
+    "OP_ENLARGE", "OP_MERGE_GCONV", "OP_CONSTANT_IMM", "OP_CONSTANT_ICONV",
+    "OP_CONSTANT_ONE", "OP_CONSTANT_POOl", "OP_PARTITION", "OP_COMBINE",
+    "OP_REPLICATE", "OP_REDUCE", "OP_EMBEDDING",
+]
+
+# TASO ParamType 0..16 (protobuf_to_json.cc:80-98)
+_PM_NAMES = [
+    "PM_OP_TYPE", "PM_NUM_INPUTS", "PM_NUM_OUTPUTS", "PM_GROUP",
+    "PM_KERNEL_H", "PM_KERNEL_W", "PM_STRIDE_H", "PM_STRIDE_W", "PM_PAD",
+    "PM_ACTI", "PM_NUMDIM", "PM_AXIS", "PM_PERM", "PM_OUTSHUFFLE",
+    "PM_MERGE_GCONV_COUNT", "PM_PARALLEL_DIM", "PM_PARALLEL_DEGREE",
+]
+
+
+def _op_name(value: int) -> str:
+    if 0 <= value < len(_OP_NAMES):
+        return _OP_NAMES[value]
+    return f"OP_UNKNOWN_{value}"
+
+
+# ----------------------------------------------------------------------
+# protobuf wire format
+# ----------------------------------------------------------------------
+
+def _varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes) -> List[Tuple[int, object]]:
+    """Decode one message into (field_number, value) pairs; value is an
+    int (varint) or bytes (length-delimited submessage)."""
+    out: List[Tuple[int, object]] = []
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, pos = _varint(buf, pos)
+            out.append((field, v))
+        elif wire == 2:
+            n, pos = _varint(buf, pos)
+            out.append((field, buf[pos:pos + n]))
+            pos += n
+        else:  # pragma: no cover - schema uses only wire types 0 and 2
+            raise ValueError(f"unsupported wire type {wire}")
+    return out
+
+
+def _tensor(buf: bytes) -> Dict:
+    d = dict(_fields(buf))
+    return {"_t": "Tensor", "opId": _s32(d[1]), "tsId": _s32(d[2])}
+
+
+def _s32(v) -> int:
+    """proto int32 negatives arrive as 64-bit two's-complement varints."""
+    v = int(v)
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _parameter(buf: bytes) -> Dict:
+    d = dict(_fields(buf))
+    key = _s32(d[1])
+    name = _PM_NAMES[key] if 0 <= key < len(_PM_NAMES) else str(key)
+    return {"_t": "Parameter", "key": name, "value": _s32(d[2])}
+
+
+def _operator(buf: bytes) -> Dict:
+    op: Dict = {"_t": "Operator", "input": [], "para": [], "type": None}
+    for field, v in _fields(buf):
+        if field == 1:
+            op["type"] = _op_name(_s32(v))
+        elif field == 2:
+            op["input"].append(_tensor(v))
+        elif field == 3:
+            op["para"].append(_parameter(v))
+    return op
+
+
+def _map_output(buf: bytes) -> Dict:
+    d = dict(_fields(buf))
+    return {"_t": "MapOutput", "srcOpId": _s32(d[1]), "dstOpId": _s32(d[2]),
+            "srcTsId": _s32(d[3]), "dstTsId": _s32(d[4])}
+
+
+def _rule(buf: bytes, idx: int) -> Dict:
+    rule: Dict = {"_t": "Rule", "name": f"pb_rule_{idx}", "srcOp": [],
+                  "dstOp": [], "mappedOutput": []}
+    for field, v in _fields(buf):
+        if field == 1:
+            rule["srcOp"].append(_operator(v))
+        elif field == 2:
+            rule["dstOp"].append(_operator(v))
+        elif field == 3:
+            rule["mappedOutput"].append(_map_output(v))
+    return rule
+
+
+def rules_pb_to_json(pb_path: str, json_path: str | None = None) -> Dict:
+    """Decode a RuleCollection ``.pb``; optionally write the JSON file."""
+    with open(pb_path, "rb") as f:
+        buf = f.read()
+    rules = [
+        _rule(v, i)
+        for i, (field, v) in enumerate(_fields(buf)) if field == 1]
+    doc = {"_t": "RuleCollection", "rule": rules}
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    return doc
+
+
+def main(argv=None):  # pragma: no cover - thin CLI
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Convert a TASO substitution RuleCollection .pb to "
+                    "the JSON format the search loads")
+    ap.add_argument("pb")
+    ap.add_argument("json")
+    a = ap.parse_args(argv)
+    doc = rules_pb_to_json(a.pb, a.json)
+    print(f"wrote {len(doc['rule'])} rules to {a.json}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

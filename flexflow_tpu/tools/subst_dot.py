@@ -1,0 +1,85 @@
+"""Render substitution rules as graphviz dot.
+
+Reference parity: ``tools/substitutions_to_dot`` (C++). Renders each
+rule's source and destination pattern graphs side by side; works on the
+JSON rule collection or (via ``pb_rules``) directly on a ``.pb``.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+
+def _pattern(ops: List[Dict], prefix: str, label: str,
+             lines: List[str]) -> None:
+    lines.append(f'  subgraph cluster_{prefix} {{ label="{label}";')
+    for i, op in enumerate(ops):
+        paras = ", ".join(f'{p["key"].replace("PM_", "")}={p["value"]}'
+                          for p in op.get("para", []))
+        node_label = op["type"].replace("OP_", "")
+        if paras:
+            node_label += f"\\n{paras}"
+        lines.append(f'    {prefix}{i} [label="{node_label}"];')
+    ext = set()
+    for i, op in enumerate(ops):
+        for t in op.get("input", []):
+            if t["opId"] < 0:
+                ext.add(t["tsId"])
+                lines.append(f'    {prefix}in{t["tsId"]} -> {prefix}{i};')
+            else:
+                lines.append(
+                    f'    {prefix}{t["opId"]} -> {prefix}{i} '
+                    f'[label="{t["tsId"]}"];')
+    for e in sorted(ext):
+        lines.append(
+            f'    {prefix}in{e} [label="input {e}", shape=ellipse];')
+    lines.append("  }")
+
+
+def rule_to_dot(rule: Dict) -> str:
+    lines = [f'digraph "{rule.get("name", "rule")}" {{',
+             "  node [shape=box];"]
+    _pattern(rule.get("srcOp", []), "s", "source pattern", lines)
+    _pattern(rule.get("dstOp", []), "d", "target pattern", lines)
+    for m in rule.get("mappedOutput", []):
+        lines.append(f'  s{m["srcOpId"]} -> d{m["dstOpId"]} '
+                     f'[style=dashed, color=gray, '
+                     f'label="out {m["srcTsId"]}->{m["dstTsId"]}"];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def substitutions_to_dot(rules_path: str, out_path: str,
+                         limit: int | None = None) -> int:
+    """Write one dot digraph per rule (concatenated, graphviz accepts
+    multi-graph files); returns the number rendered."""
+    if rules_path.endswith(".pb"):
+        from .pb_rules import rules_pb_to_json
+        doc = rules_pb_to_json(rules_path)
+    else:
+        with open(rules_path) as f:
+            doc = json.load(f)
+    rules = doc["rule"] if isinstance(doc, dict) else doc
+    if limit:
+        rules = rules[:limit]
+    with open(out_path, "w") as f:
+        for r in rules:
+            f.write(rule_to_dot(r))
+            f.write("\n")
+    return len(rules)
+
+
+def main(argv=None):  # pragma: no cover - thin CLI
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Render substitution rules (.json or .pb) to dot")
+    ap.add_argument("rules")
+    ap.add_argument("out")
+    ap.add_argument("--limit", type=int, default=None)
+    a = ap.parse_args(argv)
+    n = substitutions_to_dot(a.rules, a.out, a.limit)
+    print(f"rendered {n} rules to {a.out}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -33,8 +33,6 @@ SLOW = [
 def _run(script, args):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = os.path.dirname(EXAMPLES) + os.pathsep \
-        + env.get("PYTHONPATH", "")
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8")
     # examples force CPU via jax.config when JAX_PLATFORMS is exported —

@@ -1,0 +1,44 @@
+"""Tools parity: rules .pb -> JSON converter + substitutions-to-dot
+(reference ``tools/protobuf_to_json``, ``tools/substitutions_to_dot``)."""
+import json
+import os
+
+import pytest
+
+REF_PB = "/root/reference/substitutions/graph_subst_3_v2.pb"
+REF_JSON = "/root/reference/substitutions/graph_subst_3_v2.json"
+
+
+@pytest.mark.skipif(not os.path.exists(REF_PB),
+                    reason="reference .pb not mounted")
+def test_pb_to_json_matches_reference_converter(tmp_path):
+    from flexflow_tpu.tools import rules_pb_to_json
+    out = str(tmp_path / "rules.json")
+    doc = rules_pb_to_json(REF_PB, out)
+    with open(REF_JSON) as f:
+        ref = json.load(f)
+    assert len(doc["rule"]) == len(ref["rule"]) == 640
+
+    def strip(r):
+        r = dict(r)
+        r.pop("name", None)
+        return r
+
+    for a, b in zip(doc["rule"], ref["rule"]):
+        assert strip(a) == strip(b)
+    # the written file loads through the search's rule loader
+    from flexflow_tpu.search.substitution_loader import load_rule_collection
+    xfers = load_rule_collection(out)
+    assert len(xfers) > 0
+
+
+@pytest.mark.skipif(not os.path.exists(REF_JSON),
+                    reason="reference rules not mounted")
+def test_substitutions_to_dot(tmp_path):
+    from flexflow_tpu.tools import substitutions_to_dot
+    out = str(tmp_path / "rules.dot")
+    n = substitutions_to_dot(REF_JSON, out, limit=5)
+    assert n == 5
+    text = open(out).read()
+    assert text.count("digraph") == 5
+    assert "source pattern" in text and "target pattern" in text

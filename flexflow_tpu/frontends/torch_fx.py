@@ -177,7 +177,10 @@ class PyTorchModel:
         # explicit trace inputs for HF models whose forward signature the
         # tracer mis-guesses (e.g. T5EncoderModel)
         self.input_names = list(input_names) if input_names else None
-        self._layer_of_module: Dict[str, str] = {}  # torch path -> ff layer
+        # torch module path -> ALL ff layers it produced (a module called
+        # several times, e.g. T5's shared embedding, lowers to several
+        # FF layers — every one must receive the weights)
+        self._layers_of_module: Dict[str, List[str]] = {}
 
     # ------------------------------------------------------------------
     def _trace(self):
@@ -400,7 +403,8 @@ class PyTorchModel:
             q, k, v = args[0], args[1], args[2]
             attn = ff.multihead_attention(q, k, v, m.embed_dim, m.num_heads,
                                           dropout=m.dropout, name=name)
-            self._layer_of_module[node.target] = ff.layers[-1].name
+            self._layers_of_module.setdefault(node.target, []) \
+                .append(ff.layers[-1].name)
             # torch MHA returns (output, weights); traced graphs getitem(0)
             return [attn, None]
         elif isinstance(m, nn.ReLU):
@@ -455,8 +459,9 @@ class PyTorchModel:
         else:
             raise NotImplementedError(
                 f"torch module {type(m).__name__} not supported")
-        self._layer_of_module[node.target if hasattr(node, 'target') else
-                              name] = ff.layers[-1].name
+        self._layers_of_module.setdefault(
+            node.target if hasattr(node, "target") else name, []) \
+            .append(ff.layers[-1].name)
         return out
 
     def _prep(self, ff, v, name, i):
@@ -721,51 +726,54 @@ class PyTorchModel:
         Linear kernels: torch stores (out, in), FF stores (in, out))."""
         import torch.nn as nn
         for path, mod in self.module.named_modules():
-            lname = self._layer_of_module.get(path)
-            if lname is None or lname not in ff.params:
-                continue
-            if isinstance(mod, nn.Linear):
-                ff.set_weights(lname, "kernel",
-                               mod.weight.detach().cpu().numpy().T)
-                if mod.bias is not None:
-                    ff.set_weights(lname, "bias",
-                                   mod.bias.detach().cpu().numpy())
-            elif isinstance(mod, nn.Conv2d):
-                ff.set_weights(lname, "kernel",
-                               mod.weight.detach().cpu().numpy())
-                if mod.bias is not None:
-                    ff.set_weights(lname, "bias",
-                                   mod.bias.detach().cpu().numpy())
-            elif isinstance(mod, (nn.Embedding, nn.EmbeddingBag)):
-                ff.set_weights(lname, "kernel",
-                               mod.weight.detach().cpu().numpy())
-            elif isinstance(mod, nn.LayerNorm) and mod.elementwise_affine:
-                ff.set_weights(lname, "scale",
-                               mod.weight.detach().cpu().numpy())
-                ff.set_weights(lname, "bias",
-                               mod.bias.detach().cpu().numpy())
-            elif isinstance(mod, nn.BatchNorm2d):
-                if mod.affine:
-                    ff.set_weights(lname, "scale",
-                                   mod.weight.detach().cpu().numpy())
-                    ff.set_weights(lname, "bias",
-                                   mod.bias.detach().cpu().numpy())
-                if mod.track_running_stats and lname in ff.state:
-                    ff.set_state(lname, "mean",
-                                 mod.running_mean.detach().cpu().numpy())
-                    ff.set_state(lname, "var",
-                                 mod.running_var.detach().cpu().numpy())
-            elif type(mod).__name__ == "Conv1D" and hasattr(mod, "nf"):
-                # GPT-2 Conv1D kernel is already (in, out)
-                ff.set_weights(lname, "kernel",
-                               mod.weight.detach().cpu().numpy())
-                ff.set_weights(lname, "bias",
-                               mod.bias.detach().cpu().numpy())
-            elif type(mod).__name__ in ("T5LayerNorm", "MT5LayerNorm",
-                                        "LlamaRMSNorm", "MistralRMSNorm"):
-                ff.set_weights(lname, "scale",
-                               mod.weight.detach().cpu().numpy())
+            for lname in self._layers_of_module.get(path, ()):
+                if lname in ff.params or lname in ff.state:
+                    self._copy_module_weights(ff, mod, lname)
 
+    def _copy_module_weights(self, ff: FFModel, mod, lname: str):
+        import torch.nn as nn
+        if isinstance(mod, nn.Linear):
+            ff.set_weights(lname, "kernel",
+                           mod.weight.detach().cpu().numpy().T)
+            if mod.bias is not None:
+                ff.set_weights(lname, "bias",
+                               mod.bias.detach().cpu().numpy())
+        elif isinstance(mod, nn.Conv2d):
+            ff.set_weights(lname, "kernel",
+                           mod.weight.detach().cpu().numpy())
+            if mod.bias is not None:
+                ff.set_weights(lname, "bias",
+                               mod.bias.detach().cpu().numpy())
+        elif isinstance(mod, (nn.Embedding, nn.EmbeddingBag)):
+            ff.set_weights(lname, "kernel",
+                           mod.weight.detach().cpu().numpy())
+        elif isinstance(mod, nn.LayerNorm) and mod.elementwise_affine:
+            ff.set_weights(lname, "scale",
+                           mod.weight.detach().cpu().numpy())
+            if mod.bias is not None:  # nn.LayerNorm(bias=False): FF's
+                ff.set_weights(lname, "bias",  # zero bias is equivalent
+                               mod.bias.detach().cpu().numpy())
+        elif isinstance(mod, nn.BatchNorm2d):
+            if mod.affine:
+                ff.set_weights(lname, "scale",
+                               mod.weight.detach().cpu().numpy())
+                ff.set_weights(lname, "bias",
+                               mod.bias.detach().cpu().numpy())
+            if mod.track_running_stats and lname in ff.state:
+                ff.set_state(lname, "mean",
+                             mod.running_mean.detach().cpu().numpy())
+                ff.set_state(lname, "var",
+                             mod.running_var.detach().cpu().numpy())
+        elif type(mod).__name__ == "Conv1D" and hasattr(mod, "nf"):
+            # GPT-2 Conv1D kernel is already (in, out)
+            ff.set_weights(lname, "kernel",
+                           mod.weight.detach().cpu().numpy())
+            ff.set_weights(lname, "bias",
+                           mod.bias.detach().cpu().numpy())
+        elif type(mod).__name__ in ("T5LayerNorm", "MT5LayerNorm",
+                                    "LlamaRMSNorm", "MistralRMSNorm"):
+            ff.set_weights(lname, "scale",
+                           mod.weight.detach().cpu().numpy())
 
     # ------------------------------------------------------------------
     # file serialization hand-off (reference ``torch_to_file`` /

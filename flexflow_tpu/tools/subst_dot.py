@@ -24,15 +24,20 @@ def _pattern(ops: List[Dict], prefix: str, label: str,
     for i, op in enumerate(ops):
         for t in op.get("input", []):
             if t["opId"] < 0:
-                ext.add(t["tsId"])
-                lines.append(f'    {prefix}in{t["tsId"]} -> {prefix}{i};')
+                # distinct external inputs may share tsId under different
+                # negative opIds — key nodes by the (opId, tsId) pair
+                key = (t["opId"], t["tsId"])
+                ext.add(key)
+                lines.append(f'    {prefix}in{-key[0]}_{key[1]} '
+                             f'-> {prefix}{i};')
             else:
                 lines.append(
                     f'    {prefix}{t["opId"]} -> {prefix}{i} '
                     f'[label="{t["tsId"]}"];')
-    for e in sorted(ext):
+    for oid, tid in sorted(ext):
         lines.append(
-            f'    {prefix}in{e} [label="input {e}", shape=ellipse];')
+            f'    {prefix}in{-oid}_{tid} '
+            f'[label="input {oid}/{tid}", shape=ellipse];')
     lines.append("  }")
 
 

@@ -1,0 +1,81 @@
+"""Run every OSDI'22-artifact A/B (searched strategy vs data parallel)
+and record the results as JSON — the reference's ``scripts/osdi22ae/``
+produce these numbers by hand; here one command captures them all.
+
+Default platform: whatever jax exposes (real TPU under the driver, or
+force the 8-device CPU mesh with ``JAX_PLATFORMS=cpu XLA_FLAGS=
+--xla_force_host_platform_device_count=8``). Each model runs in its own
+subprocess so one failure cannot take down the sweep.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+EXAMPLES = os.path.dirname(HERE)
+
+# (script, extra args) — batch sizes sized for the CPU sim; pass
+# --batch-size on the command line to override for a real chip
+MODELS = [
+    ("mnist_mlp.py", ["-b", "32"]),
+    ("alexnet_cifar10.py", ["-b", "8"]),
+    ("dlrm.py", ["-b", "32"]),
+    ("xdl.py", ["-b", "32"]),
+    ("candle_uno.py", ["-b", "16"]),
+    ("transformer.py", ["-b", "8"]),
+    ("bert.py", ["-b", "4"]),
+    ("inception.py", ["-b", "4"]),
+    ("resnext50.py", ["-b", "4"]),
+]
+
+_LINE = re.compile(r"\[(?P<name>[\w-]+)\] (?P<mode>data-parallel|searched):"
+                   r" (?P<sps>[\d.]+) samples/s")
+_RATIO = re.compile(r"searched vs data-parallel: (?P<ratio>[\d.]+)x")
+
+
+def main():
+    extra = sys.argv[1:]
+    results = {}
+    for script, args in MODELS:
+        cmd = [sys.executable, os.path.join(EXAMPLES, script), "--ab",
+               "--budget", "8"] + args + extra
+        t0 = time.time()
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=1800, cwd=EXAMPLES)
+            out = r.stdout
+            entry = {"rc": r.returncode,
+                     "wall_s": round(time.time() - t0, 1)}
+            for m in _LINE.finditer(out):
+                key = "dp_sps" if m.group("mode") == "data-parallel" \
+                    else "searched_sps"
+                entry[key] = float(m.group("sps"))
+            m = _RATIO.search(out)
+            if m:
+                entry["searched_vs_dp"] = float(m.group("ratio"))
+            if r.returncode != 0:
+                entry["error"] = (r.stderr.strip().splitlines()
+                                  or ["?"])[-1][:200]
+        except subprocess.TimeoutExpired:
+            entry = {"rc": -1, "error": "timeout",
+                     "wall_s": round(time.time() - t0, 1)}
+        results[script] = entry
+        print(f"{script}: {entry}", flush=True)
+    # platform info WITHOUT initializing a backend in this process (the
+    # ambient TPU plugin ignores JAX_PLATFORMS and can hang on a dead
+    # tunnel); the per-model subprocesses already ran on the right one
+    doc = {"jax_platforms_env": os.environ.get("JAX_PLATFORMS", "default"),
+           "results": results}
+    out_path = os.path.join(HERE, "osdi22ae_results.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -189,6 +189,69 @@ class ONNXModel:
     def handle_Cast(self, ff, node, env):
         return env[node.input[0]]  # dtype policy handled by the executor
 
+    def handle_Constant(self, ff, node, env):
+        import onnx.numpy_helper as nh
+        a = _attrs(node)
+        if "value" in a:
+            return nh.to_array(a["value"])
+        for k in ("value_float", "value_int"):  # scalar attribute forms
+            if k in a:
+                return np.asarray(a[k])
+        raise NotImplementedError("Constant without tensor value")
+
+    def handle_Dense(self, ff, node, env):
+        # keras2onnx legacy spelling (reference handleDense): weight is
+        # stored (in, out), optional bias third input
+        w = env[node.input[1]]
+        t = ff.dense(env[node.input[0]], w.shape[1],
+                     use_bias=len(node.input) > 2, name=node.name or None)
+        self._stash_weight(ff, node, env, transpose=False)
+        return t
+
+    def handle_Pad(self, ff, node, env):
+        # zero padding is a no-op when all pads are 0 (the common
+        # keras2onnx artifact the reference special-cases); real spatial
+        # padding folds into the consuming conv/pool's pad attributes
+        a = _attrs(node)
+        pads = a.get("pads")
+        if pads is None and len(node.input) > 1:
+            pads = np.asarray(env[node.input[1]]).tolist()
+        if pads and any(int(p) for p in pads):
+            raise NotImplementedError(
+                "explicit non-zero Pad: fold pads into the consumer")
+        return env[node.input[0]]
+
+    def handle_Range(self, ff, node, env):
+        start, limit, delta = (np.asarray(env[i]).item()
+                               for i in node.input)
+        return np.arange(start, limit, delta)
+
+    def handle_Unsqueeze(self, ff, node, env):
+        a = _attrs(node)
+        axes = a.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = np.asarray(env[node.input[1]]).tolist()
+        if axes is None:  # required by the ONNX spec in every opset
+            raise ValueError(f"Unsqueeze node {node.name!r} has no axes")
+        x = env[node.input[0]]
+        if isinstance(x, np.ndarray):
+            return np.expand_dims(x, tuple(int(ax) for ax in axes))
+        return ff.unsqueeze(x, [int(ax) for ax in axes],
+                            name=node.name or None)
+
+    def handle_Squeeze(self, ff, node, env):
+        a = _attrs(node)
+        axes = a.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = np.asarray(env[node.input[1]]).tolist()
+        x = env[node.input[0]]
+        if axes is None:  # spec-legal: squeeze every size-1 dim
+            axes = [d for d, s in enumerate(x.shape) if s == 1]
+        if isinstance(x, np.ndarray):
+            return np.squeeze(x, tuple(int(ax) for ax in axes))
+        return ff.squeeze(x, [int(ax) for ax in axes],
+                          name=node.name or None)
+
     # ------------------------------------------------------------------
     def _binary(self, ff, builder, node, env):
         a, b = env[node.input[0]], env[node.input[1]]

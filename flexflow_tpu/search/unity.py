@@ -653,6 +653,29 @@ def extract_strategy(graph: Graph, info: GraphProgramInfo,
 # ---------------------------------------------------------------------------
 # Top-level entry
 # ---------------------------------------------------------------------------
+def data_parallel_graph(layers: Sequence[Layer],
+                        input_tensors: Sequence[Tensor],
+                        output_tensors: Sequence[Tensor],
+                        dmesh: DeviceMesh) -> Graph:
+    """The canonical data-parallel PCG: every op whose leading output dim
+    divides the device count is batch-partitioned (the reference's
+    ``--only-data-parallel`` view, ``graph.cc:1939``). Scoring this with
+    the SAME evaluator as the search gives the search a floor: its
+    answer is never predicted-worse than plain DP."""
+    g = Graph.from_layers(layers, input_tensors, output_tensors)
+    d = dmesh.num_devices
+    for n in g.in_edges:
+        if n.op_type in (OperatorType.OP_INPUT, OperatorType.OP_NOOP,
+                         OperatorType.OP_WEIGHT) or d <= 1:
+            continue
+        outs = tuple((i, 0, "dp")
+                     for i, t in enumerate(n.layer.outputs)
+                     if t.shape and t.shape[0] % d == 0)
+        if outs:
+            n.ann = ParAnn(groups=(("dp", d),), out=outs)
+    return g
+
+
 def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
                  output_tensors: Sequence[Tensor], dmesh: DeviceMesh,
                  cost_model: OpCostModel, budget: int = 32,
@@ -687,6 +710,16 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
                              base_optimize_threshold=base_optimize_threshold)
         g, _ = search.optimize(graph)
         gc = ev.graph_cost(g)
+        # DP floor: never return a strategy predicted worse than the
+        # canonical data-parallel view (the reference search starts FROM
+        # per-op data-parallel configs, so DP is always in its space; our
+        # rewrite search seeds from the serial graph and can exhaust its
+        # budget before reaching full batch partitioning on small models)
+        dp_g = data_parallel_graph(layers, input_tensors, output_tensors,
+                                   dmesh)
+        dp_gc = ev.graph_cost(dp_g)
+        if dp_gc.total < gc.total:
+            g, gc = dp_g, dp_gc
     info = g.to_program()
     strategy = extract_strategy(g, info, dmesh)
     return info, strategy, gc, g

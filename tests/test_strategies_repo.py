@@ -1,0 +1,45 @@
+"""The repo's pre-searched strategies (reference analog:
+``examples/cpp/DLRM/strategies/*.pb``) import and train.
+
+Regenerate with e.g.:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+  python examples/dlrm.py -b 32 --budget 16 \\
+      --export strategies/dlrm_searched_8dev.json
+"""
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models import DLRMConfig, build_dlrm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DLRM_STRATEGY = os.path.join(REPO, "strategies", "dlrm_searched_8dev.json")
+
+
+@pytest.mark.skipif(not os.path.exists(DLRM_STRATEGY),
+                    reason="strategy artifact missing")
+def test_dlrm_strategy_imports_and_trains():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device mesh")
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.import_strategy_file = DLRM_STRATEGY
+    ff = FFModel(cfg)
+    out = build_dlrm(ff, 32, DLRMConfig())
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    rng = np.random.default_rng(0)
+    dcfg = DLRMConfig()
+    batch = {}
+    for t in ff.graph_inputs:
+        if t.dtype is not None and "int" in str(t.dtype).lower():
+            batch[t.name] = rng.integers(
+                0, 100, size=t.shape).astype(np.int32)
+        else:
+            batch[t.name] = rng.normal(size=t.shape).astype(np.float32)
+    batch["label"] = rng.integers(0, 2, size=(32, 1)).astype(np.int32)
+    bm = ff._run_train_step(ff.executor.make_train_step(), batch)
+    assert np.isfinite(float(np.asarray(bm["loss"])))

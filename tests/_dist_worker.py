@@ -44,8 +44,38 @@ def main():
     loss0, loss1 = hist[0]["loss"], hist[-1]["loss"]
     assert np.isfinite(loss1), loss1
     assert loss1 < loss0, (loss0, loss1)
-    print(f"DIST_OK pid={pid} loss0={loss0:.6f} loss1={loss1:.6f}",
-          flush=True)
+
+    # dp across hosts (dcn) x tp within each host: cross-process
+    # parameter sharding + activation collectives over the "DCN" boundary
+    from flexflow_tpu import DeviceMesh, MachineSpec
+    from flexflow_tpu.models import BertConfig, build_bert
+    from flexflow_tpu.parallel.presets import transformer_strategy
+    spec = MachineSpec.detect()
+    dmesh = DeviceMesh(spec)
+    assert dmesh.axis_names[0] == "dcn", dmesh.axis_sizes
+    cfg2 = FFConfig()
+    cfg2.batch_size = 4
+    ff2 = FFModel(cfg2)
+    bcfg = BertConfig.tiny()
+    bcfg.max_position = 8
+    out2 = build_bert(ff2, 4, 8, bcfg)
+    strat = transformer_strategy(ff2.layers, ff2.input_tensors, dmesh,
+                                 dp_axes=("dcn",),
+                                 tp_axes=dmesh.axis_names[1:])
+    ff2.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+                [], strategy=strat, output_tensor=out2)
+    rng = np.random.default_rng(1)
+    b2 = {"input_ids": rng.integers(0, bcfg.vocab_size,
+                                    size=(4, 8)).astype(np.int32),
+          "position_ids": np.tile(np.arange(8, dtype=np.int32), (4, 1)),
+          "label": rng.integers(0, bcfg.num_labels,
+                                size=(4, 1)).astype(np.int32)}
+    bm2 = ff2._run_train_step(ff2.executor.make_train_step(), b2)
+    tp_loss = float(np.asarray(bm2["loss"]))
+    assert np.isfinite(tp_loss), tp_loss
+
+    print(f"DIST_OK pid={pid} loss0={loss0:.6f} loss1={loss1:.6f} "
+          f"tp_loss={tp_loss:.6f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,37 @@
+"""FFModel.fit callbacks (signature: ``on_epoch_end(epoch, logs, model)``).
+
+The reference has no fault-tolerance mechanism (SURVEY.md §5: "failure
+detection / elastic recovery: absent"); checkpoint-based recovery is a
+TPU-native addition here. ``PeriodicCheckpoint`` + ``FFModel.
+restore_checkpoint`` give preemption-safe training — the standard
+requirement on TPU pods, which are preemptible by design.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class PeriodicCheckpoint:
+    """Save params/optimizer/state/strategy every N epochs (and at train
+    end via the last epoch), with retention. Resume with
+    ``FFModel.restore_checkpoint(directory)`` — restored arrays re-place
+    under the CURRENT strategy, so resume works across strategy changes.
+    """
+
+    def __init__(self, directory: str, every_epochs: int = 1,
+                 max_to_keep: int = 3):
+        self.directory = directory
+        self.every = max(1, every_epochs)
+        self.max_to_keep = max_to_keep
+        self.saved_steps = []
+
+    def on_epoch_end(self, epoch: int, logs=None, model=None):
+        if model is None or (epoch + 1) % self.every:
+            return
+        import jax
+        # one writer in a multi-controller world
+        if jax.process_index() != 0:
+            return
+        model.save_checkpoint(self.directory,
+                              max_to_keep=self.max_to_keep)
+        self.saved_steps.append(model._step)

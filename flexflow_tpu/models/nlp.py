@@ -203,3 +203,116 @@ def build_nmt(ff: FFModel, batch_size: int, src_len: int, tgt_len: int,
     h = ff.add(dec, ctx, name="attn_residual")
     return ff.dense(h, cfg.tgt_vocab, ActiMode.AC_MODE_NONE,
                     name="vocab_proj")
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    """LLaMA-family decoder (RMSNorm, SwiGLU, rotary embeddings) — built
+    from framework primitives (rms_norm / dense / batch_matmul / rotate
+    via slice+concat), no special attention op. TPU-native addition:
+    the reference predates this family."""
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    max_position: int = 2048
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=96, hidden_size=32, intermediate_size=64,
+                   num_layers=2, num_heads=4, max_position=64)
+
+
+def _rope_tables(seq_len: int, head_dim: int, theta: float):
+    import numpy as np
+    inv = 1.0 / theta ** (np.arange(0, head_dim, 2) / head_dim)
+    freqs = np.outer(np.arange(seq_len), inv)          # (s, d/2)
+    emb = np.concatenate([freqs, freqs], axis=-1)      # (s, d) half-split
+    shape = (1, 1, seq_len, head_dim)
+    return (np.cos(emb).reshape(shape).astype(np.float32),
+            np.sin(emb).reshape(shape).astype(np.float32))
+
+
+def build_llama(ff: FFModel, batch_size: int, seq_len: int,
+                cfg: LlamaConfig | None = None, lm_head: bool = True):
+    """Causal LM: (b, s) token ids -> (b, s, vocab) logits (or final
+    hidden states when ``lm_head=False``). HF weight layout compatible
+    (q/k/v/o + gate/up/down per layer, half-split rotate RoPE)."""
+    import math
+    import numpy as np
+    cfg = cfg or LlamaConfig()
+    b, s = batch_size, seq_len
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+
+    ids = ff.create_tensor((b, s), DataType.DT_INT32, name="input_ids")
+    h = ff.embedding(ids, cfg.vocab_size, cfg.hidden_size,
+                     AggrMode.AGGR_MODE_NONE, name="embed_tokens")
+
+    cos_np, sin_np = _rope_tables(s, hd, cfg.rope_theta)
+    cos_t = ff.create_tensor(cos_np.shape, create_grad=False,
+                             name="rope_cos")
+    cos_t.set_tensor(cos_np)
+    sin_t = ff.create_tensor(sin_np.shape, create_grad=False,
+                             name="rope_sin")
+    sin_t.set_tensor(sin_np)
+    mask_np = np.triu(np.full((1, 1, s, s), -1e9, np.float32), 1)
+    mask_t = ff.create_tensor(mask_np.shape, create_grad=False,
+                              name="causal_mask")
+    mask_t.set_tensor(mask_np)
+
+    def heads(x, name):
+        # (b, s, H) -> (b, nh, s, hd)
+        return ff.transpose(ff.reshape(x, (b, s, nh, hd),
+                                       name=f"{name}_split"),
+                            (0, 2, 1, 3), name=f"{name}_t")
+
+    def rope(x, name):
+        x1 = ff.slice_tensor(x, [0], [hd // 2], [3], name=f"{name}_lo")
+        x2 = ff.slice_tensor(x, [hd // 2], [hd], [3], name=f"{name}_hi")
+        rot = ff.concat([ff.scalar_multiply(x2, -1.0), x1], axis=-1,
+                        name=f"{name}_rot")
+        return ff.add(ff.multiply(x, cos_t), ff.multiply(rot, sin_t),
+                      name=f"{name}_rope")
+
+    for i in range(cfg.num_layers):
+        x = ff.rms_norm(h, eps=cfg.rms_eps, name=f"input_norm_{i}")
+        q = rope(heads(ff.dense(x, cfg.hidden_size, use_bias=False,
+                                name=f"q_proj_{i}"), f"q{i}"), f"q{i}")
+        k = rope(heads(ff.dense(x, cfg.hidden_size, use_bias=False,
+                                name=f"k_proj_{i}"), f"k{i}"), f"k{i}")
+        v = heads(ff.dense(x, cfg.hidden_size, use_bias=False,
+                           name=f"v_proj_{i}"), f"v{i}")
+        kt = ff.transpose(k, (0, 1, 3, 2), name=f"kT_{i}")
+        scores = ff.scalar_multiply(
+            ff.batch_matmul(q, kt, name=f"qk_{i}"), 1.0 / math.sqrt(hd))
+        probs = ff.softmax(ff.add(scores, mask_t), axis=-1,
+                           name=f"probs_{i}")
+        ctx = ff.batch_matmul(probs, v, name=f"ctx_{i}")
+        merged = ff.reshape(ff.transpose(ctx, (0, 2, 1, 3)),
+                            (b, s, cfg.hidden_size), name=f"merge_{i}")
+        attn_out = ff.dense(merged, cfg.hidden_size, use_bias=False,
+                            name=f"o_proj_{i}")
+        h = ff.add(h, attn_out, name=f"attn_res_{i}")
+
+        x2 = ff.rms_norm(h, eps=cfg.rms_eps, name=f"post_norm_{i}")
+        gate = ff.dense(x2, cfg.intermediate_size, use_bias=False,
+                        name=f"gate_proj_{i}")
+        up = ff.dense(x2, cfg.intermediate_size, use_bias=False,
+                      name=f"up_proj_{i}")
+        silu = ff.multiply(gate, ff.sigmoid(gate), name=f"silu_{i}")
+        down = ff.dense(ff.multiply(silu, up), cfg.hidden_size,
+                        use_bias=False, name=f"down_proj_{i}")
+        h = ff.add(h, down, name=f"mlp_res_{i}")
+
+    h = ff.rms_norm(h, eps=cfg.rms_eps, name="final_norm")
+    if not lm_head:
+        return h
+    # final softmax so the executor fuses CE-on-logits (the stable loss
+    # path engages on OP_SOFTMAX outputs, executor.py; same convention
+    # as build_gpt2/build_bert)
+    return ff.softmax(ff.dense(h, cfg.vocab_size, use_bias=False,
+                               name="lm_head"))

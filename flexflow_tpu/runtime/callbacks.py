@@ -12,10 +12,15 @@ from typing import Optional
 
 
 class PeriodicCheckpoint:
-    """Save params/optimizer/state/strategy every N epochs (and at train
-    end via the last epoch), with retention. Resume with
+    """Save params/optimizer/state/strategy every N epochs, with
+    retention (align ``every_epochs`` with the total epoch count to
+    capture the final epoch). Resume with
     ``FFModel.restore_checkpoint(directory)`` — restored arrays re-place
     under the CURRENT strategy, so resume works across strategy changes.
+
+    Multi-controller safe: every process participates in the save (the
+    cross-host shard gather is a collective); process 0 writes the files
+    (``CheckpointManager.save``).
     """
 
     def __init__(self, directory: str, every_epochs: int = 1,
@@ -27,10 +32,6 @@ class PeriodicCheckpoint:
 
     def on_epoch_end(self, epoch: int, logs=None, model=None):
         if model is None or (epoch + 1) % self.every:
-            return
-        import jax
-        # one writer in a multi-controller world
-        if jax.process_index() != 0:
             return
         model.save_checkpoint(self.directory,
                               max_to_keep=self.max_to_keep)

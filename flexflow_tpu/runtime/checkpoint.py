@@ -19,8 +19,23 @@ import numpy as np
 
 
 def _tree_to_numpy(tree):
+    """Fetch a pytree to host numpy.
+
+    Multi-controller: arrays sharded across processes are not locally
+    addressable; ``process_allgather`` (a COLLECTIVE — every process
+    must call this) assembles the global value on each host. Callers
+    then write on process 0 only.
+    """
     import jax
-    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
+    return jax.tree.map(fetch, tree)
 
 
 class CheckpointManager:
@@ -60,17 +75,28 @@ class CheckpointManager:
     # ------------------------------------------------------------------
     def save(self, step: int, state: Dict[str, Any],
              metadata: Optional[Dict[str, Any]] = None):
-        """state: arbitrary pytree (params/opt_state/op state)."""
+        """state: arbitrary pytree (params/opt_state/op state).
+
+        Collective in a multi-controller world: EVERY process must call
+        (cross-host shards gather collectively); process 0 writes."""
+        import jax
+        host_state = _tree_to_numpy(state)  # collective gather
+        if jax.process_index() != 0:
+            return
         sdir = self._step_dir(step)
         os.makedirs(sdir, exist_ok=True)
         path = os.path.join(sdir, "state")
-        if self._ocp is not None:
+        # orbax synchronizes across ALL jax processes inside save(); with
+        # a single writer that barrier would deadlock — multi-controller
+        # saves use the plain local writer (the state is already host
+        # numpy here)
+        if self._ocp is not None and jax.process_count() == 1:
             with self._ocp.PyTreeCheckpointer() as ckptr:
-                ckptr.save(path, _tree_to_numpy(state), force=True)
+                ckptr.save(path, host_state, force=True)
         else:
             import pickle
             with open(path + ".pkl", "wb") as f:
-                pickle.dump(_tree_to_numpy(state), f)
+                pickle.dump(host_state, f)
         with open(os.path.join(sdir, "meta.json"), "w") as f:
             json.dump({"step": step, **(metadata or {})}, f)
         self._gc()

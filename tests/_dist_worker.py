@@ -74,6 +74,15 @@ def main():
     tp_loss = float(np.asarray(bm2["loss"]))
     assert np.isfinite(tp_loss), tp_loss
 
+    # multi-controller checkpoint: params sharded ACROSS processes
+    # gather collectively; process 0 writes
+    ckpt_dir = f"/tmp/ff_dist_ckpt_{port}"
+    ff2.save_checkpoint(ckpt_dir)
+    if jax.process_index() == 0:
+        import os as _os
+        assert any(_os.path.isdir(_os.path.join(ckpt_dir, d))
+                   for d in _os.listdir(ckpt_dir)), ckpt_dir
+
     print(f"DIST_OK pid={pid} loss0={loss0:.6f} loss1={loss1:.6f} "
           f"tp_loss={tp_loss:.6f}", flush=True)
 

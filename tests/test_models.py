@@ -178,3 +178,78 @@ def test_nmt_copy_task_learns():
                             axis=1)
     hist = ff.fit([ids, dec_in], ids, epochs=3, verbose=False)
     assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_llama_matches_hf_numerics():
+    """build_llama (native LLaMA family: RMSNorm/SwiGLU/RoPE from
+    primitives) matches HF LlamaModel forward with copied weights."""
+    import numpy as np
+    import pytest
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import LlamaConfig as HFLlamaConfig, LlamaModel
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import LlamaConfig, build_llama
+
+    lcfg = LlamaConfig.tiny()
+    hf = LlamaModel(HFLlamaConfig(
+        vocab_size=lcfg.vocab_size, hidden_size=lcfg.hidden_size,
+        intermediate_size=lcfg.intermediate_size,
+        num_hidden_layers=lcfg.num_layers,
+        num_attention_heads=lcfg.num_heads,
+        num_key_value_heads=lcfg.num_heads,
+        max_position_embeddings=lcfg.max_position,
+        rope_theta=lcfg.rope_theta, rms_norm_eps=lcfg.rms_eps,
+        attention_bias=False, mlp_bias=False)).eval()
+
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    cfg.use_bf16_compute = False
+    ff = FFModel(cfg)
+    out = build_llama(ff, 2, 16, lcfg, lm_head=False)
+    ff.compile(SGDOptimizer(0.01), "identity", [], output_tensor=out)
+
+    def w(mod):
+        return mod.weight.detach().numpy()
+
+    ff.set_weights("embed_tokens", "kernel", w(hf.embed_tokens))
+    ff.set_weights("final_norm", "scale", w(hf.norm))
+    for i, blk in enumerate(hf.layers):
+        ff.set_weights(f"input_norm_{i}", "scale", w(blk.input_layernorm))
+        ff.set_weights(f"post_norm_{i}", "scale",
+                       w(blk.post_attention_layernorm))
+        for ours, theirs in ((f"q_proj_{i}", blk.self_attn.q_proj),
+                             (f"k_proj_{i}", blk.self_attn.k_proj),
+                             (f"v_proj_{i}", blk.self_attn.v_proj),
+                             (f"o_proj_{i}", blk.self_attn.o_proj),
+                             (f"gate_proj_{i}", blk.mlp.gate_proj),
+                             (f"up_proj_{i}", blk.mlp.up_proj),
+                             (f"down_proj_{i}", blk.mlp.down_proj)):
+            ff.set_weights(ours, "kernel", w(theirs).T)
+
+    x = np.random.default_rng(0).integers(
+        0, lcfg.vocab_size, size=(2, 16)).astype(np.int32)
+    y = np.asarray(ff.executor.make_forward()(ff.params, ff.state,
+                                              {"input_ids": x}))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(x.astype(np.int64))) \
+            .last_hidden_state.numpy()
+    np.testing.assert_allclose(y, ref, atol=3e-3, rtol=3e-3)
+
+
+def test_llama_trains():
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import LlamaConfig, build_llama
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    out = build_llama(ff, 8, 12, LlamaConfig.tiny())
+    ff.compile(SGDOptimizer(0.1), "sparse_categorical_crossentropy",
+               ["accuracy"], output_tensor=out)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 96, size=(32, 12)).astype(np.int32)
+    hist = ff.fit([ids], ids, epochs=3, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]

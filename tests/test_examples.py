@@ -18,6 +18,7 @@ FAST = [
     ("candle_uno.py", ["-b", "8", "--only-data-parallel"]),
     ("transformer.py", ["-b", "4", "--only-data-parallel"]),
     ("nmt.py", ["-b", "8", "--only-data-parallel"]),
+    ("llama.py", ["-b", "8", "--only-data-parallel"]),
 ]
 
 SLOW = [

@@ -48,10 +48,13 @@ def _emit(obj):
 def _apply_platform_env():
     """The ambient TPU plugin ignores JAX_PLATFORMS; when the parent asks
     for CPU, force it through jax.config too (same fix as
-    tests/conftest.py)."""
+    tests/conftest.py). Also enable the persistent compilation cache so
+    each staged subprocess doesn't pay the full (remote) compile cost."""
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu.utils.compilation_cache import enable_compilation_cache
+    enable_compilation_cache()
 
 
 def _sync_fetch(x):

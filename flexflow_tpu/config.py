@@ -77,6 +77,9 @@ class FFConfig:
     # searched sharding strategy and pick the winner
     enable_pipeline_search: bool = False
     use_bf16_compute: bool = True                  # matmuls in bf16, fp32 accum
+    # persistent XLA compilation cache dir; "" = off unless
+    # JAX_COMPILATION_CACHE_DIR is set (see utils/compilation_cache.py)
+    compilation_cache_dir: str = ""
     # "auto": Pallas flash attention when compiled on TPU; "true": always
     # (interpret mode off-TPU — slow, test-only); "false": plain XLA attention
     use_flash_attention: str = "auto"

@@ -17,6 +17,7 @@ TPU-native differences:
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -459,6 +460,11 @@ class FFModel:
         """Lower graph → (strategy, jitted step). Reference call stack:
         ``FFModel::compile`` → graph_optimize → convert_graph_to_operators
         → NCCL setup (``model.cc:2803-3168``)."""
+        if self.config.compilation_cache_dir \
+                or os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            from .utils.compilation_cache import enable_compilation_cache
+            enable_compilation_cache(
+                self.config.compilation_cache_dir or None)
         if optimizer is not None:
             self.optimizer = optimizer
         if self.optimizer is None:

@@ -1,0 +1,137 @@
+"""North-star demonstration (BASELINE.md): Unity-searched BERT-large on a
+v5e-32 pod slice vs pure data parallelism.
+
+The target machine is described by ``machine_configs/v5e-32.json`` (4x8
+ICI torus, 8 hosts) — the analog of the reference's
+``--machine-model-file`` (``machine_config_example``) — and strategies
+are scored by the native link-level task-graph simulator (machine model
+v1, ``search/tasksim.py`` + ``native/src/ffruntime.cc``), the analog of
+``Simulator::simulate_runtime`` (``src/runtime/simulator.cc``). No
+multi-chip hardware is needed: a 32-virtual-device CPU mesh stands in
+for the pod (same mechanism as ``tests/conftest.py``), exactly how the
+reference searches for N-GPU strategies from a simulator-equipped
+single process (``graph.cc:2046``).
+
+Usage:
+  python examples/northstar_bert_large.py [--budget 16] [--batch 256]
+      [--seq 512] [--out bench_results/northstar_v5e32_sim.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=32").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models import BertConfig, build_bert  # noqa: E402
+from flexflow_tpu.parallel.machine import DeviceMesh  # noqa: E402
+from flexflow_tpu.parallel.topology import load_machine_file  # noqa: E402
+from flexflow_tpu.search.costmodel import OpCostModel  # noqa: E402
+from flexflow_tpu.search.tasksim import TaskGraphEvaluator  # noqa: E402
+from flexflow_tpu.search.unity import (data_parallel_graph,  # noqa: E402
+                                       unity_search)
+from flexflow_tpu.pcg.graph import Graph  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--machine", default=os.path.join(
+        REPO, "machine_configs", "v5e-32.json"))
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "bench_results", "northstar_v5e32_sim.json"))
+    a = ap.parse_args()
+
+    spec = load_machine_file(a.machine)
+    assert len(jax.devices()) >= spec.num_devices, \
+        f"need {spec.num_devices} virtual devices"
+    dmesh = DeviceMesh(spec, mesh_shape=spec.ici_shape)
+    print(f"machine: {spec.generation} x{spec.num_devices} "
+          f"ici={spec.ici_shape} hosts={spec.num_hosts}", flush=True)
+
+    cfg = FFConfig()
+    cfg.batch_size = a.batch
+    ff = FFModel(cfg)
+    bcfg = BertConfig()          # defaults are BERT-large
+    bcfg.max_position = a.seq
+    out = build_bert(ff, a.batch, a.seq, bcfg)
+    n_ops = len(ff.layers)
+    print(f"bert-large graph: {n_ops} layers, batch {a.batch}, "
+          f"seq {a.seq}", flush=True)
+
+    cost_model = OpCostModel(spec)
+    ev = TaskGraphEvaluator(cost_model, dmesh)
+    inputs = ff.graph_inputs + getattr(ff, "const_inputs", [])
+
+    dp_g = data_parallel_graph(ff.layers, inputs, [out], dmesh)
+    dp_cost = ev.graph_cost(dp_g)
+    print(f"data-parallel simulated step: {dp_cost.total * 1e3:.3f} ms "
+          f"(compute {dp_cost.compute * 1e3:.3f} xfer "
+          f"{dp_cost.xfer * 1e3:.3f} sync {dp_cost.sync * 1e3:.3f})",
+          flush=True)
+
+    t0 = time.perf_counter()
+    info, strategy, gc, graph = unity_search(
+        ff.layers, inputs, [out], dmesh, cost_model,
+        budget=a.budget, evaluator_cls=TaskGraphEvaluator)
+    best = {"kind": "sharding", "cost": gc.total}
+    # pipeline candidates compete on cost exactly as in the product path
+    # (optimizer._maybe_pipeline / --enable-pipeline-search)
+    from flexflow_tpu.search.pipeline_score import best_pipeline
+    cand = best_pipeline(ff.layers, dmesh, cost_model)
+    if cand is not None:
+        print(f"pipeline candidate: S={cand.n_stages} M="
+              f"{cand.n_microbatches} v={cand.n_chunks} "
+              f"dp={cand.dp_size} cost {cand.cost * 1e3:.3f} ms",
+              flush=True)
+        if cand.cost < best["cost"]:
+            kind = (f"pipeline_dp{cand.dp_size}xpp{cand.n_stages}"
+                    f"_m{cand.n_microbatches}")
+            if cand.n_chunks > 1:
+                kind += f"_interleaved{cand.n_chunks}"
+            best = {"kind": kind, "cost": cand.cost}
+    search_s = time.perf_counter() - t0
+    speedup = dp_cost.total / max(best["cost"], 1e-12)
+    print(f"searched simulated step:      {best['cost'] * 1e3:.3f} ms "
+          f"({best['kind']})", flush=True)
+    print(f"search time: {search_s:.1f}s (budget {a.budget})", flush=True)
+    print(f"SEARCHED vs DATA-PARALLEL: {speedup:.2f}x "
+          f"(north star: >= 1.5x)", flush=True)
+
+    doc = {
+        "_comment": "Simulated (machine-model-v1 link-level task sim) "
+                    "searched-vs-DP step time for BERT-large on the "
+                    "v5e-32 description — BASELINE.md north-star config. "
+                    "Regenerate: python examples/northstar_bert_large.py",
+        "machine": os.path.basename(a.machine),
+        "model": "bert-large",
+        "batch": a.batch,
+        "seq": a.seq,
+        "budget": a.budget,
+        "n_ops": n_ops,
+        "dp_ms": round(dp_cost.total * 1e3, 3),
+        "searched_ms": round(best["cost"] * 1e3, 3),
+        "winner": best["kind"],
+        "speedup": round(speedup, 3),
+        "search_time_s": round(search_s, 1),
+    }
+    os.makedirs(os.path.dirname(a.out), exist_ok=True)
+    with open(a.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {a.out}", flush=True)
+    return 0 if speedup >= 1.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

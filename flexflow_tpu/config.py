@@ -73,6 +73,9 @@ class FFConfig:
     # maximal repeated-block region into this many GPipe stages
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0                 # 0 = 2 * stages
+    # interleaved (circular) schedule: chunks per stage (1 = plain GPipe;
+    # v > 1 cuts the pipeline bubble to (S-1)/(M*v))
+    pipeline_chunks: int = 1
     # let the search score a pipeline candidate (bubble model) against the
     # searched sharding strategy and pick the winner
     enable_pipeline_search: bool = False
@@ -219,6 +222,8 @@ class FFConfig:
                 cfg.pipeline_stages = int(take())
             elif a in ("--num-microbatches", "--pipeline-microbatches"):
                 cfg.pipeline_microbatches = int(take())
+            elif a in ("--pipeline-chunks", "--interleave"):
+                cfg.pipeline_chunks = int(take())
             elif a == "--enable-pipeline-search":
                 cfg.enable_pipeline_search = True
             elif a == "--seed":

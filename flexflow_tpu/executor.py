@@ -181,9 +181,12 @@ class Executor:
     def _init_pipeline_params(self, rng):
         """Stacked region params: for each template layer, one leaf of
         shape (S,) + spec.shape — stage s initialized independently —
-        sharded P(pp_axis, ...) so each pipeline rank holds its stage."""
+        sharded P(pp_axis, ...) so each pipeline rank holds its stage.
+        Interleaved schedule (n_chunks = v > 1): (v, S) + spec.shape,
+        sharded P(None, pp_axis, ...) — [k, s] is global chunk s + k*S."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         pipe = self.pipe
+        S, v = pipe.n_stages, pipe.n_chunks
         out: Dict[str, Dict[str, Any]] = {}
         for lj, layer in enumerate(pipe.template):
             op = get_op_def(layer.op_type)
@@ -196,14 +199,22 @@ class Executor:
             lp = {}
             for wi, spec in enumerate(specs):
                 slices = []
-                for s in range(pipe.n_stages):
+                for c in range(S * v):
                     k = jax.random.fold_in(jax.random.fold_in(
-                        jax.random.fold_in(rng, 7000 + lj), wi), s)
+                        jax.random.fold_in(rng, 7000 + lj), wi), c)
                     slices.append(initialize(spec, k, to_jnp(spec.dtype)))
                 stacked = jnp.stack(slices)
-                sh = NamedSharding(
-                    self.dmesh.mesh,
-                    P(pipe.pp_axis, *([None] * len(spec.shape))))
+                if v > 1:
+                    # [k, s] = chunk s + k*S: stack order is chunk-major,
+                    # so the (v, S) reshape lands chunk c at [c//S, c%S]
+                    stacked = stacked.reshape((v, S) + tuple(spec.shape))
+                    sh = NamedSharding(
+                        self.dmesh.mesh,
+                        P(None, pipe.pp_axis, *([None] * len(spec.shape))))
+                else:
+                    sh = NamedSharding(
+                        self.dmesh.mesh,
+                        P(pipe.pp_axis, *([None] * len(spec.shape))))
                 lp[spec.name] = jax.device_put(stacked, sh)
             out[pipe.param_name(layer)] = lp
         return out
@@ -239,22 +250,26 @@ class Executor:
         from jax.sharding import PartitionSpec as P
         from .parallel.pipeline import gpipe
         pipe = self.pipe
-        S, M = pipe.n_stages, pipe.n_microbatches
+        S, M, v = pipe.n_stages, pipe.n_microbatches, pipe.n_chunks
         stacked = {pipe.param_name(l): params[pipe.param_name(l)]
                    for l in pipe.template
                    if pipe.param_name(l) in params}
         if training:
             base = jax.random.fold_in(jax.random.key(self.seed + 2), step)
-            stage_keys = jax.vmap(
-                lambda i: jax.random.fold_in(base, i))(jnp.arange(S))
-            stacked = dict(stacked, __rng__=stage_keys)
+            chunk_keys = jax.vmap(
+                lambda i: jax.random.fold_in(base, i))(jnp.arange(S * v))
+            if v > 1:
+                chunk_keys = chunk_keys.reshape(v, S)
+            stacked = dict(stacked, __rng__=chunk_keys)
         assert x.shape[0] % M == 0, \
             f"batch {x.shape[0]} not divisible into {M} microbatches"
         xs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
         engine = gpipe(self._make_stage_fn(training), pipe.pp_axis, M,
-                       with_step_arg=True)
+                       with_step_arg=True, n_chunks=v)
+        pp_lead = (pipe.pp_axis,) if v == 1 else (None, pipe.pp_axis)
         param_specs = jax.tree.map(
-            lambda v: P(pipe.pp_axis, *([None] * (v.ndim - 1))), stacked)
+            lambda a: P(*pp_lead, *([None] * (a.ndim - len(pp_lead)))),
+            stacked)
         dp = pipe.dp_axes if pipe.dp_axes else None
         dp = dp[0] if dp is not None and len(dp) == 1 else dp
         xs_spec = P(None, dp, *([None] * (xs.ndim - 2)))

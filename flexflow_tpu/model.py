@@ -533,7 +533,8 @@ class FFModel:
             from .parallel.presets import pipeline_strategy
             strategy = pipeline_strategy(
                 self.layers, self.graph_inputs, self.dmesh, n_stages=pp,
-                n_microbatches=self.config.pipeline_microbatches)
+                n_microbatches=self.config.pipeline_microbatches,
+                n_chunks=self.config.pipeline_chunks)
         if strategy is not None:
             self.strategy = strategy
         else:

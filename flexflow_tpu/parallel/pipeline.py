@@ -34,7 +34,8 @@ def _squeeze_stage(params):
 
 
 def gpipe(stage_fn: Callable[..., Any], axis_name: str,
-          n_microbatches: int, with_step_arg: bool = False):
+          n_microbatches: int, with_step_arg: bool = False,
+          n_chunks: int = 1):
     """Build the pipelined apply for use INSIDE shard_map over `axis_name`.
 
     stage_fn(stage_params, x) -> y with y.shape == x.shape.
@@ -44,50 +45,76 @@ def gpipe(stage_fn: Callable[..., Any], axis_name: str,
 
     Returned fn(stacked_params_local, xs) where:
       - stacked_params_local: pytree whose leaves have local shape
-        (1, ...) — this stage's slice of the (S, ...) stacked params;
+        (1, ...) — this stage's slice of the (S, ...) stacked params —
+        or (v, 1, ...) with ``n_chunks = v > 1`` (see below);
       - xs: (M, mb, ...) microbatched input (replicated across stages);
     returns (M, mb, ...) outputs of the final stage (replicated).
 
-    Schedule: T = M + S - 1 steps; at step t stage s computes microbatch
-    t - s (bubble steps compute masked garbage that receives no gradient).
+    Schedule, ``n_chunks == 1`` (GPipe): T = M + S - 1 steps; at step t
+    stage s computes microbatch t - s (bubble steps compute masked
+    garbage that receives no gradient).
+
+    Schedule, ``n_chunks = v > 1`` (interleaved / circular, the
+    Megatron-interleaved bubble reduction): the block stack is split into
+    v*S chunks; device s owns chunks {s, S+s, ..., (v-1)S+s} and the
+    activation ring wraps S-1 -> 0, so each microbatch circles the ring v
+    times. T = M*v + S - 1 steps and the bubble fraction drops from
+    (S-1)/M to (S-1)/(M*v). stage_fn receives ONE chunk's params per
+    step. Requires M % S == 0 (round-robin microbatch rotation).
     """
+    v = n_chunks
 
     def apply(stacked_params_local, xs):
         S = lax.psum(1, axis_name)
         stage = lax.axis_index(axis_name)
         M = n_microbatches
-        params = _squeeze_stage(stacked_params_local)
-        # neighbor hand-off, no wraparound: stage s -> s+1
-        perm = [(i, i + 1) for i in range(S - 1)]
+        if v == 1:
+            params = _squeeze_stage(stacked_params_local)
+            # neighbor hand-off, no wraparound: stage s -> s+1
+            perm = [(i, i + 1) for i in range(S - 1)]
+        else:
+            # local leaves are (v, 1, ...): drop the sharded stage dim
+            params = jax.tree.map(lambda x: x[:, 0], stacked_params_local)
+            perm = [(i, (i + 1) % S) for i in range(S)]  # ring
 
         outputs0 = jnp.zeros_like(xs)
         state0 = jnp.zeros_like(xs[0])
 
         def body(carry, t):
             state, outputs = carry
-            # stage 0 pulls microbatch t from the local queue; later stages
-            # consume the activation handed off by the previous stage
-            mb_t = lax.dynamic_index_in_dim(
-                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            x_in = jnp.where(stage == 0, mb_t, state)
-            y = stage_fn(params, x_in, t) if with_step_arg \
-                else stage_fn(params, x_in)
-            # final stage owns microbatch t-(S-1) at step t
-            out_idx = t - (S - 1)
-            valid = jnp.logical_and(stage == S - 1,
-                                    jnp.logical_and(out_idx >= 0,
-                                                    out_idx < M))
-            write_idx = jnp.clip(out_idx, 0, M - 1)
-            cur = lax.dynamic_index_in_dim(outputs, write_idx, 0,
-                                           keepdims=False)
+            # local clock: how many chunk-computations this device has
+            # started. chunk slot k and microbatch m follow the circular
+            # round-robin (v == 1 reduces to m = u, k = 0).
+            u = jnp.clip(t - stage, 0, M * v - 1)
+            k = (u // S) % v
+            m = jnp.clip((u % S) + S * (u // (S * v)), 0, M - 1)
+            if v == 1:
+                chunk_params = params
+            else:
+                chunk_params = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, k, 0,
+                                                       keepdims=False),
+                    params)
+            # stage 0 pulls a fresh microbatch on its first chunk; all
+            # other (stage, chunk) slots consume the handed-off activation
+            mb_t = lax.dynamic_index_in_dim(xs, m, 0, keepdims=False)
+            x_in = jnp.where(jnp.logical_and(stage == 0, k == 0),
+                             mb_t, state)
+            y = stage_fn(chunk_params, x_in, t) if with_step_arg \
+                else stage_fn(chunk_params, x_in)
+            # the last chunk of the last stage finishes microbatch m
+            out_idx = t - stage
+            valid = jnp.logical_and(
+                jnp.logical_and(stage == S - 1, k == v - 1),
+                jnp.logical_and(out_idx >= 0, out_idx < M * v))
+            cur = lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
             upd = jnp.where(valid, y, cur)
-            outputs = lax.dynamic_update_index_in_dim(outputs, upd,
-                                                      write_idx, 0)
+            outputs = lax.dynamic_update_index_in_dim(outputs, upd, m, 0)
             state = lax.ppermute(y, axis_name, perm)
             return (state, outputs), None
 
         (_, outputs), _ = lax.scan(body, (state0, outputs0),
-                                   jnp.arange(M + S - 1))
+                                   jnp.arange(M * v + S - 1))
         # broadcast final-stage outputs to every stage (masked psum)
         outputs = lax.psum(
             jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
@@ -108,23 +135,36 @@ class PipelinedBlocks:
 
     def __init__(self, mesh: Mesh, stage_fn, n_stages: int,
                  n_microbatches: int, dp_axis: str = "dp",
-                 pp_axis: str = "pp"):
+                 pp_axis: str = "pp", n_chunks: int = 1):
         assert pp_axis in mesh.axis_names, (pp_axis, mesh.axis_names)
         pp_size = mesh.shape[pp_axis]
         assert n_stages == pp_size, \
             (f"n_stages ({n_stages}) must equal the '{pp_axis}' axis size "
              f"({pp_size}): one stage per pipeline rank")
+        if n_chunks > 1:
+            assert n_microbatches % n_stages == 0, \
+                (f"interleaved schedule needs M % S == 0, got "
+                 f"M={n_microbatches} S={n_stages}")
         self.mesh = mesh
         self.stage_fn = stage_fn
         self.n_stages = n_stages
         self.n_microbatches = n_microbatches
+        self.n_chunks = n_chunks
         self.dp_axis = dp_axis
         self.pp_axis = pp_axis
 
+    def _pp_lead(self):
+        return (self.pp_axis,) if self.n_chunks == 1 \
+            else (None, self.pp_axis)
+
     def shard_params(self, stacked_params):
-        """Place (S, ...)-stacked params: stage dim over the pp axis."""
+        """Place stacked params: (S, ...) with the stage dim over the pp
+        axis, or (v, S, ...) for the interleaved schedule ([k, s] is
+        global chunk s + k*S, see ``gpipe(n_chunks=v)``)."""
+        lead = self._pp_lead()
+
         def put(x):
-            spec = P(self.pp_axis, *([None] * (x.ndim - 1)))
+            spec = P(*lead, *([None] * (x.ndim - len(lead))))
             return jax.device_put(x, NamedSharding(self.mesh, spec))
         return jax.tree.map(put, stacked_params)
 
@@ -138,9 +178,11 @@ class PipelinedBlocks:
         """Differentiable pipelined forward of the block stack.
         x: (B, ...) full batch (dp-sharded on the batch dim outside)."""
         xs = self.microbatch(x)
-        engine = gpipe(self.stage_fn, self.pp_axis, self.n_microbatches)
+        engine = gpipe(self.stage_fn, self.pp_axis, self.n_microbatches,
+                       n_chunks=self.n_chunks)
+        lead = self._pp_lead()
         in_param_spec = jax.tree.map(
-            lambda v: P(self.pp_axis, *([None] * (v.ndim - 1))),
+            lambda v: P(*lead, *([None] * (v.ndim - len(lead)))),
             stacked_params)
         dp = self.dp_axis if self.dp_axis in self.mesh.axis_names else None
         xs_spec = P(None, dp, *([None] * (xs.ndim - 2)))

@@ -51,12 +51,17 @@ class PipelineRegion:
     n_microbatches: int
     entry_guid: int             # activation entering stage 0
     exit_guid: int              # activation leaving stage n_stages-1
-    template: List[Layer]       # stage 0's layers (the stage program)
+    template: List[Layer]       # chunk 0's layers (the chunk program)
     template_entry_guid: int
-    # for stage s, layer j of that stage corresponds to template[j];
-    # stage_layer_names[s][j] is its original (per-stage) layer name,
-    # used to initialize per-stage weights before stacking
+    # for global chunk c (= stage + k*n_stages under the interleaved
+    # schedule; == stage when n_chunks == 1), layer j corresponds to
+    # template[j]; stage_layer_names[c][j] is its original layer name,
+    # used to initialize per-chunk weights before stacking
     stage_layer_names: List[List[str]]
+    # interleaved (circular) schedule: chunks per stage. 1 = plain GPipe;
+    # v > 1 splits the region into v*S chunks, device s owning chunks
+    # {s + k*S} — the template then describes ONE CHUNK, not one stage.
+    n_chunks: int = 1
     # mesh binding, filled in by parallel.presets.pipeline_strategy
     pp_axis: Optional[str] = None
     dp_axes: Tuple[str, ...] = ()
@@ -124,16 +129,18 @@ def _has_state(layer: Layer) -> bool:
 
 
 def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
-                         n_microbatches: int = 0
+                         n_microbatches: int = 0, n_chunks: int = 1
                          ) -> Optional[PipelineRegion]:
     """Find the maximal run of identical single-input/single-output chunks
-    divisible into ``n_stages`` stages. Returns None when the graph has no
+    divisible into ``n_stages`` stages (x ``n_chunks`` chunks per stage
+    for the interleaved schedule). Returns None when the graph has no
     such region (the caller falls back to non-pipelined execution)."""
     layers = list(layers)
     n = len(layers)
+    n_parts = n_stages * max(n_chunks, 1)   # total chunk count to divide by
     sigs = [layer_signature(l) for l in layers]
     best: Optional[Tuple[int, int, int]] = None  # (total_len, start, unit)
-    for unit in range(1, n // max(n_stages, 2) + 1):
+    for unit in range(1, n // max(n_parts, 2) + 1):
         for start in range(n - unit * 2 + 1):
             # count consecutive repeats of layers[start:start+unit]
             reps = 1
@@ -144,8 +151,8 @@ def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
                 if sigs[nxt:nxt + unit] != sigs[start:start + unit]:
                     break
                 reps += 1
-            reps -= reps % n_stages          # whole stages only
-            if reps >= n_stages and reps * unit > (best or (0,))[0]:
+            reps -= reps % n_parts           # whole chunks only
+            if reps >= n_parts and reps * unit > (best or (0,))[0]:
                 # verify structure before accepting
                 if _verify_run(layers, start, unit, reps):
                     best = (reps * unit, start, unit)
@@ -153,21 +160,21 @@ def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
         return None
     total, start, unit = best
     reps = total // unit
-    per_stage = (reps // n_stages) * unit
+    per_chunk = (reps // n_parts) * unit
     end = start + total
     region = layers[start:end]
-    # stage boundaries must each cross exactly one tensor
+    # chunk boundaries must each cross exactly one tensor
     entry = _single_crossing(layers[:start] + region, start, start + total)
     if entry is None:
         return None
     boundaries = [entry]
-    for s in range(1, n_stages):
-        g = _single_crossing(region, s * per_stage, total)
+    for c in range(1, n_parts):
+        g = _single_crossing(region, c * per_chunk, total)
         if g is None:
             return None
         boundaries.append(g)
     exit_guid = region[-1].outputs[0].guid
-    # chunk shape preservation: entry and exit tensors of each stage match
+    # chunk shape preservation: entry and exit tensors of each chunk match
     by_guid = {t.guid: t for l in layers for t in l.outputs}
     for l in layers:
         for t in l.inputs:
@@ -176,25 +183,34 @@ def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
               if g in by_guid}
     if len(shapes) != 1:
         return None
-    # stages must be isomorphic to stage 0 and stateless
-    template = region[:per_stage]
+    # chunks must be isomorphic to chunk 0 and stateless
+    template = region[:per_chunk]
     if any(_has_state(l) for l in template):
         return None
-    for s in range(1, n_stages):
-        chunk = region[s * per_stage:(s + 1) * per_stage]
+    for c in range(1, n_parts):
+        chunk = region[c * per_chunk:(c + 1) * per_chunk]
         if not _chunks_isomorphic(template, chunk, boundaries[0],
-                                  boundaries[s]):
+                                  boundaries[c]):
             return None
     if n_microbatches <= 0:
         n_microbatches = 2 * n_stages
+    elif max(n_chunks, 1) > 1 and n_microbatches % n_stages:
+        # the circular schedule's round-robin needs M % S == 0; a
+        # user-chosen M that violates it must fail loudly here, not at
+        # the executor's batch-divisibility assert with a rounded M
+        raise ValueError(
+            f"interleaved schedule (n_chunks={n_chunks}) requires "
+            f"n_microbatches % n_stages == 0, got M={n_microbatches} "
+            f"S={n_stages}")
     return PipelineRegion(
         start=start, end=end, n_stages=n_stages,
-        n_microbatches=n_microbatches, entry_guid=entry,
+        n_microbatches=n_microbatches, n_chunks=max(n_chunks, 1),
+        entry_guid=entry,
         exit_guid=exit_guid, template=list(template),
         template_entry_guid=boundaries[0],
         stage_layer_names=[
-            [l.name for l in region[s * per_stage:(s + 1) * per_stage]]
-            for s in range(n_stages)])
+            [l.name for l in region[c * per_chunk:(c + 1) * per_chunk]]
+            for c in range(n_parts)])
 
 
 def _verify_run(layers: Sequence[Layer], start: int, unit: int,

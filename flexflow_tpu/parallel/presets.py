@@ -119,7 +119,8 @@ def transformer_strategy(layers, input_tensors, dmesh: DeviceMesh,
 def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
                       n_stages: int, n_microbatches: int = 0,
                       pp_axis: Optional[str] = None,
-                      dp_axes: Optional[Sequence[str]] = None
+                      dp_axes: Optional[Sequence[str]] = None,
+                      n_chunks: int = 1
                       ) -> ShardingStrategy:
     """dp×pp strategy through the product path: the maximal repeated-block
     region (found by ``find_pipeline_region``) becomes ``n_stages`` GPipe
@@ -143,11 +144,13 @@ def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
         dp_axes = tuple(a for a in dmesh.axis_names if a != pp_axis)
     dp = _norm(dp_axes)
     dp_size = _size(dmesh, dp)
-    region = find_pipeline_region(layers, n_stages, n_microbatches)
+    region = find_pipeline_region(layers, n_stages, n_microbatches,
+                                  n_chunks)
     if region is None:
         raise ValueError(
             f"graph has no repeated-block region divisible into "
-            f"{n_stages} identical stages")
+            f"{n_stages} identical stages"
+            + (f" x {n_chunks} chunks" if n_chunks > 1 else ""))
     region.pp_axis = pp_axis
     region.dp_axes = tuple(dp_axes)
     st = ShardingStrategy(dmesh)

@@ -93,7 +93,8 @@ def _maybe_pipeline(ff, cost_model, searched_cost, searched_result):
     dmesh2 = DeviceMesh(ff.dmesh.spec, mesh_shape=shape)
     st = pipeline_strategy(ff.layers, ff.graph_inputs, dmesh2,
                            n_stages=cand.n_stages,
-                           n_microbatches=cand.n_microbatches)
+                           n_microbatches=cand.n_microbatches,
+                           n_chunks=cand.n_chunks)
     if cfg.profiling:
         print(f"pipeline candidate S={cand.n_stages} wins: "
               f"{cand.cost * 1e3:.3f} ms < {searched_cost * 1e3:.3f} ms")

@@ -36,31 +36,51 @@ class PipelineCandidate:
     dp_size: int
     cost: float                  # estimated step time, seconds
     region: PipelineRegion
+    n_chunks: int = 1            # interleaved (circular) chunks per stage
 
 
 def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
                    n_stages: int, n_devices: int,
-                   n_microbatches: int = 0) -> Optional[PipelineCandidate]:
+                   n_microbatches: int = 0,
+                   n_chunks: int = 1,
+                   region: Optional[PipelineRegion] = None
+                   ) -> Optional[PipelineCandidate]:
     """Estimated train-step time for an S-stage GPipe split of the
     graph's repeated-block region on ``n_devices`` (dp = n/S). None when
-    the graph has no S-divisible region."""
-    region = find_pipeline_region(layers, n_stages, n_microbatches)
+    the graph has no S-divisible region. ``n_chunks = v > 1`` scores the
+    interleaved (circular) schedule: T = (M*v + S - 1) chunk steps, so
+    the bubble fraction drops from (S-1)/M to (S-1)/(M*v).
+
+    ``region`` (discovery depends only on (S, v), not M) lets sweeps
+    reuse one O(n^2) ``find_pipeline_region`` across microbatch counts.
+    """
+    if region is None:
+        region = find_pipeline_region(layers, n_stages, n_microbatches,
+                                      n_chunks)
+    elif n_microbatches > 0:
+        if n_chunks > 1 and n_microbatches % n_stages:
+            return None
+        region = dataclasses.replace(region,
+                                     n_microbatches=n_microbatches)
     if region is None:
         return None
-    S, M = n_stages, region.n_microbatches
+    S, M, v = n_stages, region.n_microbatches, region.n_chunks
     dp = max(n_devices // S, 1)
     batch_deg = {0: dp * M}
-    t_stage = 0.0
+    t_stage = 0.0                # one CHUNK's per-microbatch time
     for l in region.template:
         cm = cost_model.op_cost(l, batch_deg)
         t_stage += cm.forward_time + cm.backward_time
     # handoff: the boundary activation (one microbatch, dp-sharded)
     by_guid = {t.guid: t for l in layers for t in l.outputs}
     entry_t = by_guid.get(region.entry_guid)
+    if entry_t is not None and entry_t.shape \
+            and entry_t.shape[0] % max(dp * M, 1):
+        return None  # microbatches don't divide the global batch
     act_bytes = (int(np.prod(entry_t.shape)) * itemsize(entry_t.dtype)
                  / max(dp * M, 1)) if entry_t is not None else 0.0
     t_handoff = act_bytes / spec.ici_bandwidth + spec.ici_latency_us * 1e-6
-    t_region = (M + S - 1) * (t_stage + t_handoff)
+    t_region = (M * v + S - 1) * (t_stage + t_handoff)
     # outside layers at plain dp
     region_idx = set(range(region.start, region.end))
     t_out, w_bytes_out = 0.0, 0.0
@@ -81,8 +101,10 @@ def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
             [t.dtype for t in l.inputs])
         w_bytes_stage += sum(int(np.prod(ws.shape)) * itemsize(ws.dtype)
                              for ws in specs)
+    w_bytes_stage *= v           # a stage holds v chunks' weights
     t_sync = cost_model.weight_sync_cost(w_bytes_stage + w_bytes_out, dp)
-    return PipelineCandidate(S, M, dp, t_region + t_out + t_sync, region)
+    return PipelineCandidate(S, M, dp, t_region + t_out + t_sync, region,
+                             n_chunks=v)
 
 
 def best_pipeline(layers, dmesh: DeviceMesh,
@@ -95,8 +117,19 @@ def best_pipeline(layers, dmesh: DeviceMesh,
     for S in range(2, n + 1):
         if n % S:
             continue
-        cand = score_pipeline(layers, dmesh.spec, cost_model, S, n,
-                              microbatches)
-        if cand is not None and (best is None or cand.cost < best.cost):
-            best = cand
+        # sweep microbatch count (bubble (M+S-1)/M shrinks with M;
+        # per-microbatch efficiency and handoff latency grow) and the
+        # interleaved chunk count (bubble /v; weights stream per chunk).
+        # Region discovery depends only on (S, v) — do it once per pair.
+        ms = (microbatches,) if microbatches else (0, S, 4 * S, 8 * S)
+        for v in (1, 2, 3, 4):
+            region = find_pipeline_region(layers, S, 0, v)
+            if region is None:
+                continue
+            for M in ms:
+                cand = score_pipeline(layers, dmesh.spec, cost_model,
+                                      S, n, M, v, region=region)
+                if cand is not None and (best is None
+                                         or cand.cost < best.cost):
+                    best = cand
     return best

@@ -589,6 +589,128 @@ def create_partition_embedding_combine(degree: int) -> GraphXfer:
                      [dst, comb], [(src.out(), comb.out())])
 
 
+# ---------------------------------------------------------------------------
+# Composed 2D machine views. The reference enumerates per-op MachineViews
+# with multiple parallel degrees at once (``graph.h:205``: a view can
+# partition batch AND an attribute dim). Single-group xfers cannot compose
+# — every ``cond`` requires an unannotated source — so the composed view
+# must be reachable in ONE rewrite. These rules take a serial op directly
+# to a batch(dp) x feature/head(tp) hybrid, the strategy family Megatron/
+# Unity find for transformer blocks.
+# ---------------------------------------------------------------------------
+def create_partition_linear_combine_2d(dp: int, tp: int) -> GraphXfer:
+    """Batch-partition by ``dp`` AND column-parallel the kernel by ``tp``
+    in one rewrite (composed analog of ``create_partition_linear_combine``
+    + ``create_replicate_linear_combine``)."""
+    g1, g2 = f"dp{dp}", f"tp{tp}"
+    x = TensorX()
+
+    def cond(n: PNode, gr: Graph) -> bool:
+        if not _unannotated(n, gr):
+            return False
+        o = n.layer.outputs[0].shape
+        return len(o) >= 2 and o[0] % dp == 0 and o[0] >= dp \
+            and o[-1] % tp == 0 and o[-1] >= tp
+
+    src = OpX(OperatorType.OP_LINEAR, [x], cond=cond)
+    part = _partition(x, 0, dp, g1)
+    rep = _replicate(part.out(), tp, g2)
+
+    def ann(mapping):
+        r = _rank_of(mapping[src])
+        return ParAnn(groups=((g1, dp), (g2, tp)),
+                      out=((0, 0, g1), (0, r - 1, g2)),
+                      weights=(("kernel", 1, g2), ("bias", 0, g2)))
+
+    dst = OpX(OperatorType.OP_LINEAR, [rep.out()], share=src, ann=ann)
+
+    def comb_params(mapping):
+        return {"dim": _rank_of(mapping[src]) - 1, "degree": tp,
+                "group": g2}
+
+    comb_tp = OpX(OperatorType.OP_COMBINE, [dst.out()], params=comb_params)
+    comb_dp = _combine(comb_tp.out(), 0, dp, g1)
+    return GraphXfer(f"partition_linear_combine_2d_dp{dp}xtp{tp}", [src],
+                     [part, rep, dst, comb_tp, comb_dp],
+                     [(src.out(), comb_dp.out())])
+
+
+def create_partition_linear_reduce_2d(dp: int, tp: int) -> GraphXfer:
+    """Batch-partition by ``dp`` AND row-parallel the kernel's contraction
+    dim by ``tp``: outputs are partial sums resolved by a Reduction within
+    each batch shard."""
+    g1, g2 = f"dp{dp}", f"rp{tp}"
+    x = TensorX()
+
+    def cond(n: PNode, gr: Graph) -> bool:
+        if not _unannotated(n, gr):
+            return False
+        o = n.layer.outputs[0].shape
+        ish = n.layer.inputs[0].shape
+        return bool(o) and o[0] % dp == 0 and o[0] >= dp and bool(ish) \
+            and ish[-1] % tp == 0 and ish[-1] >= tp
+
+    src = OpX(OperatorType.OP_LINEAR, [x], cond=cond)
+    part_b = _partition(x, 0, dp, g1)
+
+    def part_params(mapping):
+        r = len(mapping[src].layer.inputs[0].shape)
+        return {"dim": r - 1, "degree": tp, "group": g2}
+
+    part_k = OpX(OperatorType.OP_REPARTITION, [part_b.out()],
+                 params=part_params, ann=ParAnn(groups=((g2, tp),)))
+    dst = OpX(OperatorType.OP_LINEAR, [part_k.out()], share=src,
+              ann=ParAnn(groups=((g1, dp), (g2, tp)), out=((0, 0, g1),),
+                         weights=(("kernel", 0, g2),), reduce=g2))
+    red = _reduction(dst.out(), tp, g2)
+    comb = _combine(red.out(), 0, dp, g1)
+    return GraphXfer(f"partition_linear_reduce_2d_dp{dp}xrp{tp}", [src],
+                     [part_b, part_k, dst, red, comb],
+                     [(src.out(), comb.out())])
+
+
+def create_partition_attention_combine_2d(dp: int, tp: int) -> GraphXfer:
+    """Batch-partition by ``dp`` AND head-parallel MultiHeadAttention by
+    ``tp`` (composed analog of ``create_partition_attention_combine``,
+    ``substitution.cc:1756``)."""
+    g1, g2 = f"dp{dp}", f"hp{tp}"
+    q, k, v = TensorX(), TensorX(), TensorX()
+
+    def cond(n: PNode, gr: Graph) -> bool:
+        if not _unannotated(n, gr):
+            return False
+        o = n.layer.outputs[0].shape
+        h = n.layer.params.get("num_heads", 1)
+        return bool(o) and o[0] % dp == 0 and o[0] >= dp \
+            and h % tp == 0 and h >= tp
+
+    src = OpX(OperatorType.OP_MULTIHEAD_ATTENTION, [q, k, v], cond=cond)
+    parts = [_partition(t, 0, dp, g1) for t in (q, k, v)]
+    reps = [_replicate(p.out(), tp, g2) for p in parts]
+    dst = OpX(OperatorType.OP_MULTIHEAD_ATTENTION,
+              [r.out() for r in reps], share=src,
+              ann=ParAnn(groups=((g1, dp), (g2, tp)),
+                         out=((0, 0, g1),),
+                         weights=(("wq", 1, g2), ("wk", 1, g2),
+                                  ("wv", 1, g2), ("wo", 0, g2),
+                                  ("bq", 0, g2), ("bk", 0, g2),
+                                  ("bv", 0, g2)),
+                         reduce=g2))
+    red = _reduction(dst.out(), tp, g2)
+    comb = _combine(red.out(), 0, dp, g1)
+    return GraphXfer(f"partition_attention_combine_2d_dp{dp}xhp{tp}", [src],
+                     parts + reps + [dst, red, comb],
+                     [(src.out(), comb.out())])
+
+
+def degree_pairs(degrees: Sequence[int]) -> List[Tuple[int, int]]:
+    """(dp, tp) pairs whose product is itself a realizable degree —
+    the composed-2D rule instantiation set."""
+    ds = sorted({d for d in degrees if d > 1})
+    dset = set(ds)
+    return [(a, b) for a in ds for b in ds if a * b in dset]
+
+
 def create_partition_combine_elimination(dim: int, degree: int) -> GraphXfer:
     """Repartition(dim,d) then Combine(dim,d) → identity."""
     x = TensorX()
@@ -670,4 +792,8 @@ def generate_all_pcg_xfers(degrees: Sequence[int],
                 xfers.append(create_combine_partition_elimination(dim, d))
                 xfers.append(create_partition_combine_elimination(dim, d))
             xfers.append(create_reduction_replicate_elimination(d))
+    for dp, tp in degree_pairs(degrees):
+        xfers.append(create_partition_linear_combine_2d(dp, tp))
+        xfers.append(create_partition_linear_reduce_2d(dp, tp))
+        xfers.append(create_partition_attention_combine_2d(dp, tp))
     return xfers

@@ -19,7 +19,8 @@ from ..ffconst import OperatorType
 from ..pcg.graph import Graph, PNode
 from .. import native
 from .costmodel import OpCostModel
-from .unity import GraphCost, GraphCostEvaluator, _bytes_of, _bytes_of_spec
+from .unity import (GraphCost, GraphCostEvaluator, _bytes_of,
+                    _bytes_of_spec, _coll_bytes, propagate_layouts)
 
 
 def _compute_and_place_degree(ann) -> Tuple[int, int]:
@@ -134,10 +135,19 @@ class TaskGraphBuilder:
           -> gradient all-reduce comm + optimizer update per weighted op.
         """
         topo = graph.topo_order()
+        lay = propagate_layouts(graph)
         # per (node, phase): list of task ids; phase 0 fwd, 1 bwd
         fwd_tasks: Dict[int, List[int]] = {}
         bwd_tasks: Dict[int, List[int]] = {}
         mem = 0
+
+        def in_region(n: PNode, in_bytes: int, own: int = 1) -> int:
+            """Collective-group region bytes given the producer layout
+            (same composed-view correction as GraphCostEvaluator)."""
+            e0 = graph.producer(n, 0)
+            in_lay = lay.get((e0.src.guid, e0.src_idx), ()) \
+                if e0 is not None else ()
+            return _coll_bytes(in_bytes, in_lay, own)
 
         def producer_tasks(n: PNode, table) -> List[int]:
             out = []
@@ -164,15 +174,20 @@ class TaskGraphBuilder:
                 # forward collective per parallel op; REPLICATE fwd is free
                 # under SPMD (input already replicated) — same semantics as
                 # GraphCostEvaluator
+                # REPARTITION fwd: slicing owned/replicated data is
+                # (near-)local under SPMD — its cost is charged on the
+                # backward cotangent gather (mirrors GraphCostEvaluator)
                 deg = n.layer.params.get("degree", 1)
-                coll = {OperatorType.OP_REPARTITION: "all_to_all",
+                coll = {OperatorType.OP_REPARTITION: None,
                         OperatorType.OP_COMBINE: "all_gather",
                         OperatorType.OP_REPLICATE: None,
                         OperatorType.OP_REDUCTION: "all_reduce"}[t]
                 if coll is None:
                     fwd_tasks[n.guid] = preds
                     continue
-                secs = self.cost.xfer_cost(in_bytes, coll, deg)
+                own = deg if t == OperatorType.OP_COMBINE else 1
+                secs = self.cost.xfer_cost(in_region(n, in_bytes, own),
+                                           coll, deg)
                 devs = self.shard_devices(deg)
                 fwd_tasks[n.guid] = self.comm_tasks(devs, secs, preds)
                 continue
@@ -231,7 +246,9 @@ class TaskGraphBuilder:
                 if coll is None:
                     bwd_tasks[n.guid] = succs
                     continue
-                secs = self.cost.xfer_cost(in_bytes, coll, deg)
+                own = deg if t == OperatorType.OP_COMBINE else 1
+                secs = self.cost.xfer_cost(in_region(n, in_bytes, own),
+                                           coll, deg)
                 devs = self.shard_devices(deg)
                 bwd_tasks[n.guid] = self.comm_tasks(devs, secs, succs)
                 continue

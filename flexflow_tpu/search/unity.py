@@ -49,6 +49,22 @@ def _layout(d: Dict[int, int]) -> Layout:
     return tuple(sorted((k, v) for k, v in d.items() if v > 1))
 
 
+def _coll_bytes(full_bytes: int, in_lay: Layout, own_degree: int = 1) -> int:
+    """Logical bytes moved by ONE parallel-op collective group when the
+    tensor is co-partitioned by other groups/dims.
+
+    A Combine(dim, d) on a tensor also batch-partitioned by b gathers a
+    region of ``full/b`` bytes within each batch shard — charging the
+    full tensor would overprice composed (2D) machine views by the
+    co-partition factor. ``own_degree`` is the collective's own degree
+    when it already appears in the producer layout (Combine)."""
+    prod = 1
+    for _, d in in_lay:
+        prod *= d
+    prod = max(1, prod // max(own_degree, 1))
+    return max(full_bytes // prod, 1) if full_bytes else 0
+
+
 def _bytes_of(t: Tensor) -> int:
     return int(np.prod(t.shape)) * itemsize(t.dtype) if t.shape else 0
 
@@ -114,7 +130,14 @@ class GraphCostEvaluator:
         if ann.replicate is not None:
             return ()
         if ann.reduce is not None and in_idx == 0 and in_shape:
-            return _layout({len(in_shape) - 1: ann.degree_of(ann.reduce)})
+            # contraction dim partitioned by the reduce group, PLUS any
+            # co-partitioned output dims (e.g. the dp batch dim of the
+            # composed row-parallel 2D rule) that pass through the input
+            degs = {len(in_shape) - 1: ann.degree_of(ann.reduce)}
+            for d, v in ann.out_degrees(0).items():
+                if d < len(in_shape) - 1 and in_shape[d] % v == 0:
+                    degs[d] = v
+            return _layout(degs)
         degs = {d: v for d, v in ann.out_degrees(0).items()
                 if in_shape and d < len(in_shape)
                 and in_shape[d] % v == 0}
@@ -157,30 +180,31 @@ class GraphCostEvaluator:
                      OperatorType.OP_WEIGHT):
                 continue
             if t == OperatorType.OP_REPARTITION:
-                dim = n.layer.params["dim"]
                 deg = n.layer.params["degree"]
-                dst = dict(in_lay)
-                dst[dim] = dst.get(dim, 1) * deg
-                xfer += self.cost.resharding_cost(in_bytes, dict(in_lay),
-                                                  dst)
-                # backward: cotangent moves the other way
-                xfer += self.cost.resharding_cost(in_bytes, dst,
-                                                  dict(in_lay))
+                # fwd: slicing replicated/owned data is (near-)local under
+                # SPMD; bwd: the cotangent re-gathers within the group.
+                # Charged on the per-existing-shard region so composed
+                # (2D) views aren't overpriced by the co-partition factor.
+                xfer += self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
+                                            "all_to_all", deg)
                 continue
             if t == OperatorType.OP_COMBINE:
                 deg = n.layer.params["degree"]
-                xfer += self.cost.xfer_cost(in_bytes, "all_gather", deg)
-                xfer += self.cost.xfer_cost(in_bytes, "all_to_all", deg)
+                eff = _coll_bytes(in_bytes, in_lay, deg)
+                xfer += self.cost.xfer_cost(eff, "all_gather", deg)
+                xfer += self.cost.xfer_cost(eff, "all_to_all", deg)
                 continue
             if t == OperatorType.OP_REPLICATE:
                 deg = n.layer.params["degree"]
                 # fwd free under SPMD when input already replicated;
                 # bwd: all-reduce of input cotangent across the group
-                xfer += self.cost.xfer_cost(in_bytes, "all_reduce", deg)
+                xfer += self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
+                                            "all_reduce", deg)
                 continue
             if t == OperatorType.OP_REDUCTION:
                 deg = n.layer.params["degree"]
-                xfer += self.cost.xfer_cost(in_bytes, "all_reduce", deg)
+                xfer += self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
+                                            "all_reduce", deg)
                 continue
             if t in (OperatorType.OP_PIPELINE,
                      OperatorType.OP_FUSED_PARALLEL):
@@ -676,6 +700,64 @@ def data_parallel_graph(layers: Sequence[Layer],
     return g
 
 
+def saturate_xfers(graph: Graph, xfers: Sequence[GraphXfer],
+                   max_apply: int = 2048, max_num_ops: int = 4096) -> Graph:
+    """Apply each xfer greedily (first match, repeat) until fixpoint."""
+    applied = True
+    while applied and max_apply > 0:
+        applied = False
+        for xf in xfers:
+            while max_apply > 0:
+                g2 = next(iter(xf.run(graph, max_num_ops)), None)
+                if g2 is None:
+                    break
+                graph = g2
+                applied = True
+                max_apply -= 1
+    return graph
+
+
+def hybrid_template_graphs(layers: Sequence[Layer],
+                           input_tensors: Sequence[Tensor],
+                           output_tensors: Sequence[Tensor],
+                           dmesh: DeviceMesh
+                           ) -> List[Tuple[str, Graph]]:
+    """Uniform composed-2D candidate strategies, one per (dp, tp)
+    factorization of the machine: batch x column-parallel every Linear,
+    batch x head-parallel every attention, batch-partition everything
+    else by dp, then cancel adjacent combine/partition pairs.
+
+    The reference's search starts FROM per-op data-parallel MachineViews
+    (``graph.cc:1939``) so hybrid corners of the space are a few moves
+    away; our rewrite search seeds from the serial graph, so these
+    templates (like the DP floor) guarantee the well-known strategy
+    families are always in the candidate set, whatever the budget."""
+    from .substitution import (_ELEMENTWISE_PARTITIONABLE,
+                               _NORM_PARTITIONABLE,
+                               create_combine_partition_elimination,
+                               create_partition_attention_combine_2d,
+                               create_partition_linear_combine_2d,
+                               create_partition_op_combine)
+    n = dmesh.num_devices
+    degs = set(d for d in dmesh.valid_degrees() if d > 1)
+    out: List[Tuple[str, Graph]] = []
+    for dp in sorted(degs):
+        tp = n // dp
+        if dp >= n or n % dp or tp not in degs:
+            continue
+        base = Graph.from_layers(layers, input_tensors, output_tensors)
+        xfers = [create_partition_linear_combine_2d(dp, tp),
+                 create_partition_attention_combine_2d(dp, tp)]
+        for op_type, n_in in (_ELEMENTWISE_PARTITIONABLE
+                              + _NORM_PARTITIONABLE
+                              + ((OperatorType.OP_EMBEDDING, 1),)):
+            xfers.append(create_partition_op_combine(op_type, n_in, 0, dp))
+        xfers.append(create_combine_partition_elimination(0, dp))
+        out.append((f"2d_dp{dp}xtp{tp}",
+                    saturate_xfers(base, xfers)))
+    return out
+
+
 def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
                  output_tensors: Sequence[Tensor], dmesh: DeviceMesh,
                  cost_model: OpCostModel, budget: int = 32,
@@ -720,6 +802,12 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
         dp_gc = ev.graph_cost(dp_g)
         if dp_gc.total < gc.total:
             g, gc = dp_g, dp_gc
+        # hybrid composed-2D template floor (see hybrid_template_graphs)
+        for _name, tg in hybrid_template_graphs(layers, input_tensors,
+                                                output_tensors, dmesh):
+            tgc = ev.graph_cost(tg)
+            if tgc.total < gc.total:
+                g, gc = tg, tgc
     info = g.to_program()
     strategy = extract_strategy(g, info, dmesh)
     return info, strategy, gc, g

@@ -29,18 +29,19 @@ def enable_compilation_cache(path: str | None = None, *,
     default. Caches every entry (min-compile-time 0) because on
     remote-compile backends even small programs are expensive.
 
-    TPU-only by default: under a remote-compile tunnel, XLA:CPU AOT
-    results can be produced on a machine whose CPU features differ from
-    the local host — reloading such a cache entry risks SIGILL (observed
-    as "Machine type used for XLA:CPU compilation doesn't match" on the
-    axon relay). CPU compiles are cheap anyway. Returns the path used, or
-    None when skipped.
+    Skipped on CPU backends by default: under a remote-compile tunnel,
+    XLA:CPU AOT results can be produced on a machine whose CPU features
+    differ from the local host — reloading such a cache entry risks
+    SIGILL (observed as "Machine type used for XLA:CPU compilation
+    doesn't match" on the axon relay). CPU compiles are cheap anyway;
+    accelerator backends (TPU, GPU) always cache. Returns the path used,
+    or None when skipped.
     """
     import jax
 
     if not allow_cpu:
         try:
-            if jax.default_backend() != "tpu":
+            if jax.default_backend() == "cpu":
                 return None
         except RuntimeError:
             return None  # no backend at all — nothing to cache

@@ -307,3 +307,114 @@ class TestSearchedExecution:
         step = ff.executor.make_train_step()
         bm = ff._run_train_step(step, {"input": xs, "label": ys})
         assert np.isfinite(float(np.asarray(bm["loss"])))
+
+
+# ---------------------------------------------------------------------------
+# composed 2D machine-view rules (batch x feature/head in ONE rewrite)
+# ---------------------------------------------------------------------------
+class Test2DRules:
+    def test_linear_2d_annotation_and_parallel_ops(self):
+        from flexflow_tpu.search.substitution import \
+            create_partition_linear_combine_2d
+        ff, x, out = mlp_model(batch=16, depth=1)
+        g = Graph.from_layers(ff.layers, [x], [out])
+        xfer = create_partition_linear_combine_2d(2, 4)
+        res = list(xfer.run(g))
+        assert res
+        g2 = res[0]
+        assert not g2.check_consistency()
+        ann = [n for n in g2.topo_order()
+               if n.op_type == OperatorType.OP_LINEAR
+               and len(n.ann.groups) == 2]
+        assert len(ann) == 1
+        # batch dim carries dp, last dim carries tp
+        degs = ann[0].ann.out_degrees(0)
+        assert degs[0] == 2 and degs[len(
+            ann[0].layer.outputs[0].shape) - 1] == 4
+        kinds = [n.op_type for n in g2.topo_order()]
+        assert kinds.count(OperatorType.OP_COMBINE) >= 2  # tp + dp combines
+
+    def test_linear_2d_strategy_extracts_and_validates(self):
+        from flexflow_tpu.search.substitution import \
+            create_partition_linear_combine_2d
+        ff, x, out = mlp_model(batch=16, depth=1)
+        g = Graph.from_layers(ff.layers, [x], [out])
+        g2 = next(iter(create_partition_linear_combine_2d(2, 4).run(g)))
+        info = g2.to_program()
+        st = extract_strategy(g2, info, mesh8())
+        assert not st.validate()
+
+    def test_degree_pairs(self):
+        from flexflow_tpu.search.substitution import degree_pairs
+        pairs = degree_pairs([2, 4, 8])
+        assert (2, 4) in pairs and (4, 2) in pairs and (2, 2) in pairs
+        assert (4, 4) not in pairs          # 16 not a valid degree
+        assert all(a * b in {2, 4, 8} for a, b in pairs)
+
+    def test_attention_2d(self):
+        from flexflow_tpu.search.substitution import \
+            create_partition_attention_combine_2d
+        ff = FFModel(FFConfig())
+        x = ff.create_tensor([8, 16, 32], name="input")
+        a = ff.multihead_attention(x, x, x, 32, 4, name="attn")
+        out = ff.dense(a, 8, name="head")
+        g = Graph.from_layers(ff.layers, [x], [out])
+        res = list(create_partition_attention_combine_2d(2, 2).run(g))
+        assert res
+        ann = [n for n in res[0].topo_order()
+               if n.op_type == OperatorType.OP_MULTIHEAD_ATTENTION
+               and len(n.ann.groups) == 2]
+        assert len(ann) == 1
+        assert ann[0].ann.reduce is not None        # head-parallel reduce
+
+
+class TestHybridTemplates:
+    def test_templates_generated_and_consistent(self):
+        from flexflow_tpu.search.unity import hybrid_template_graphs
+        ff, x, out = mlp_model(batch=16, hidden=64, depth=2)
+        dmesh = mesh8()
+        ts = hybrid_template_graphs(ff.layers, [x], [out], dmesh)
+        assert ts, "8-device mesh must yield at least one (dp, tp) pair"
+        for name, g in ts:
+            assert not g.check_consistency(), name
+            ann2d = [n for n in g.topo_order() if len(n.ann.groups) == 2]
+            assert ann2d, f"{name}: no composed-2D node"
+
+    def test_template_floor_never_worse_than_serial(self):
+        """unity_search must return min(search, DP, templates)."""
+        ff, x, out = mlp_model(batch=16, hidden=64, depth=2)
+        dmesh = mesh8()
+        cm = OpCostModel(dmesh.spec)
+        info, st, gc, g = unity_search(ff.layers, [x], [out], dmesh, cm,
+                                       budget=2)
+        ev = GraphCostEvaluator(cm, dmesh)
+        serial = ev.graph_cost(Graph.from_layers(ff.layers, [x], [out]))
+        assert gc.total <= serial.total + 1e-12
+        assert not st.validate()
+
+    def test_linear_reduce_2d_not_overpriced(self):
+        """Row-parallel 2D: the evaluator's expected-input layout must
+        include the co-partitioned batch dim, or the rule is charged a
+        spurious full-tensor resharding (round-2 review finding)."""
+        from flexflow_tpu.search.substitution import \
+            create_partition_linear_reduce_2d
+        ff, x, out = mlp_model(batch=16, depth=1)
+        g = Graph.from_layers(ff.layers, [x], [out])
+        res = list(create_partition_linear_reduce_2d(2, 4).run(g))
+        assert res
+        g2 = res[0]
+        assert not g2.check_consistency()
+        dmesh = mesh8()
+        cm = OpCostModel(dmesh.spec)
+        ev = GraphCostEvaluator(cm, dmesh)
+        c2 = ev.graph_cost(g2)
+        lin = [n for n in g2.topo_order()
+               if n.op_type == OperatorType.OP_LINEAR
+               and not n.ann.is_trivial()][0]
+        want = ev._expected_input(lin, 0, lin.layer.inputs[0].shape)
+        # contraction dim (last) by rp=4 AND batch dim by dp=2
+        assert dict(want) == {0: 2, len(lin.layer.inputs[0].shape) - 1: 4}
+        # with the input layout matched, no mismatch penalty: the 2D
+        # rewrite of a big-batch linear must not cost more than 3x serial
+        serial = ev.graph_cost(Graph.from_layers(ff.layers, [x], [out]))
+        assert c2.total < 3 * serial.total

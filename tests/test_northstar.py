@@ -1,0 +1,49 @@
+"""North-star regression (BASELINE.md): Unity-searched BERT-large on the
+v5e-32 machine description must beat pure data parallelism by >= 1.5x in
+the machine-model-v1 simulator. Runs the same path as
+examples/northstar_bert_large.py but through the library API.
+
+Fast: graph build + candidate sweep take ~2 s (region discovery is
+cached per (S, v))."""
+import os
+
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.models import BertConfig, build_bert
+from flexflow_tpu.parallel.machine import DeviceMesh
+from flexflow_tpu.parallel.topology import load_machine_file
+from flexflow_tpu.search.costmodel import OpCostModel
+from flexflow_tpu.search.pipeline_score import best_pipeline
+from flexflow_tpu.search.tasksim import TaskGraphEvaluator
+from flexflow_tpu.search.unity import data_parallel_graph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_northstar_searched_beats_dp_1p5x():
+    spec = load_machine_file(os.path.join(REPO, "machine_configs",
+                                          "v5e-32.json"))
+    # the simulator needs only the machine description, not 32 devices;
+    # DeviceMesh reuses the 8 CPU devices' mesh object for axis naming
+    dmesh = DeviceMesh.__new__(DeviceMesh)
+    dmesh.spec = spec
+    dmesh.axis_sizes = {"x0": 4, "x1": 8}
+    dmesh.dcn_axis = None
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    ff = FFModel(cfg)
+    bcfg = BertConfig()          # defaults = BERT-large
+    bcfg.max_position = 512
+    out = build_bert(ff, 64, 512, bcfg)
+    cm = OpCostModel(spec)
+    ev = TaskGraphEvaluator(cm, dmesh)
+    ins = ff.graph_inputs + getattr(ff, "const_inputs", [])
+    dp = ev.graph_cost(data_parallel_graph(ff.layers, ins, [out], dmesh))
+    cand = best_pipeline(ff.layers, dmesh, cm)
+    assert cand is not None
+    speedup = dp.total / cand.cost
+    assert speedup >= 1.5, (
+        f"searched {cand.cost*1e3:.1f} ms vs DP {dp.total*1e3:.1f} ms "
+        f"= {speedup:.2f}x < 1.5x north star")
+    assert cand.n_chunks >= 1 and cand.n_microbatches % cand.n_stages == 0

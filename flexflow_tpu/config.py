@@ -76,6 +76,9 @@ class FFConfig:
     # interleaved (circular) schedule: chunks per stage (1 = plain GPipe;
     # v > 1 cuts the pipeline bubble to (S-1)/(M*v))
     pipeline_chunks: int = 1
+    # ZeRO-1: shard optimizer moments over the replicated mesh axes
+    # (runtime/zero.py); the reference keeps full state per replica
+    shard_optimizer_states: bool = False
     # let the search score a pipeline candidate (bubble model) against the
     # searched sharding strategy and pick the winner
     enable_pipeline_search: bool = False
@@ -224,6 +227,8 @@ class FFConfig:
                 cfg.pipeline_microbatches = int(take())
             elif a in ("--pipeline-chunks", "--interleave"):
                 cfg.pipeline_chunks = int(take())
+            elif a in ("--zero", "--shard-optimizer-states"):
+                cfg.shard_optimizer_states = True
             elif a == "--enable-pipeline-search":
                 cfg.enable_pipeline_search = True
             elif a == "--seed":

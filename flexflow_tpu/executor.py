@@ -114,6 +114,9 @@ class Executor:
         self.seed = seed
         self._train_step = None
         self._eval_step = None
+        # ZeRO-1 (runtime/zero.py): NamedSharding pytree for the updated
+        # optimizer state, set by FFModel.compile when enabled
+        self.opt_state_constraints = None
         # pipeline region (parallel/pipeline_lowering): pre/post layer
         # split + GPipe lowering of the repeated-block region
         self.pipe = getattr(strategy, "pipeline", None)
@@ -348,6 +351,13 @@ class Executor:
             grads, (new_state, bm) = jax.grad(loss_fn, has_aux=True)(params)
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, step + 1)
+            if self.opt_state_constraints is not None:
+                # ZeRO-1 pin: keep the updated moments on their sharded
+                # placement (GSPMD lowers the update to reduce-scatter +
+                # sharded math instead of replicating the state back)
+                new_opt_state = jax.tree.map(
+                    jax.lax.with_sharding_constraint,
+                    new_opt_state, self.opt_state_constraints)
             return new_params, new_opt_state, new_state, bm
 
         self._train_step = jax.jit(step_fn, donate_argnums=(0, 1, 2))

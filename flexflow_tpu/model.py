@@ -561,6 +561,16 @@ class FFModel:
                                  seed=self.config.seed)
         self.params, self.state = self.executor.init_params_and_state()
         self.opt_state = self.optimizer.init_state(self.params)
+        if self.config.shard_optimizer_states and self.opt_state:
+            # ZeRO-1: moments sharded over the axes their weight is
+            # replicated on (runtime/zero.py); the executor pins the
+            # updated state to the same placement inside the step
+            from .runtime.zero import (shard_optimizer_state,
+                                       state_constraints)
+            self.opt_state = shard_optimizer_state(self.opt_state,
+                                                   self.dmesh)
+            self.executor.opt_state_constraints = \
+                state_constraints(self.opt_state)
         self._step = 0
 
     def _optimize_strategy(self):

@@ -79,6 +79,9 @@ class FFConfig:
     # ZeRO-1: shard optimizer moments over the replicated mesh axes
     # (runtime/zero.py); the reference keeps full state per replica
     shard_optimizer_states: bool = False
+    # rematerialization: "none" | "blocks" (jax.checkpoint around each
+    # repeated block — HBM-for-FLOPs; executor._emit_remat)
+    remat: str = "none"
     # let the search score a pipeline candidate (bubble model) against the
     # searched sharding strategy and pick the winner
     enable_pipeline_search: bool = False
@@ -229,6 +232,8 @@ class FFConfig:
                 cfg.pipeline_chunks = int(take())
             elif a in ("--zero", "--shard-optimizer-states"):
                 cfg.shard_optimizer_states = True
+            elif a == "--remat":
+                cfg.remat = "blocks"
             elif a == "--enable-pipeline-search":
                 cfg.enable_pipeline_search = True
             elif a == "--seed":

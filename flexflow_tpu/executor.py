@@ -99,6 +99,34 @@ class GraphProgram:
         return [env[t.guid] for t in self.output_tensors]
 
 
+def _find_remat_blocks(layers):
+    """Block boundaries for ``--remat``: the maximal repeated-block run,
+    each block single-input/single-output, containing no stateful or
+    aux-loss-emitting ops (their side-channel writes cannot cross a
+    ``jax.checkpoint`` boundary). Returns
+    ``(start, unit, reps, entry_guids, exit_guids)`` or None."""
+    from .parallel.pipeline_lowering import (_has_state, chunk_boundaries,
+                                             find_repeated_run)
+    run = find_repeated_run(list(layers), 1)
+    if run is None:
+        return None
+    total, start, unit = run
+    reps = total // unit
+    layers = list(layers)
+    region = layers[start:start + total]
+    # ops whose emit writes ctx side-channels (aux losses / state) cannot
+    # sit inside a jax.checkpoint boundary; AggregateSpec inherits
+    # Aggregate's aux-loss emit
+    aux_ops = {OperatorType.OP_AGGREGATE, OperatorType.OP_AGG_SPEC}
+    if any(_has_state(l) or l.op_type in aux_ops for l in region):
+        return None
+    entries = chunk_boundaries(layers, start, unit, reps)
+    if entries is None:
+        return None
+    exits = entries[1:] + [region[-1].outputs[0].guid]
+    return start, unit, reps, entries, exits
+
+
 class Executor:
     def __init__(self, program: GraphProgram, config, dmesh: DeviceMesh,
                  strategy: ShardingStrategy, optimizer: Optimizer,
@@ -120,6 +148,20 @@ class Executor:
         # pipeline region (parallel/pipeline_lowering): pre/post layer
         # split + GPipe lowering of the repeated-block region
         self.pipe = getattr(strategy, "pipeline", None)
+        # --remat: per-block jax.checkpoint over the repeated-block run
+        # (HBM-for-FLOPs trade; the pipelined region already recomputes
+        # via its scan, so remat applies to the non-pipelined path only)
+        self._remat = None
+        if getattr(config, "remat", "none") == "blocks" \
+                and self.pipe is None:
+            self._remat = _find_remat_blocks(program.layers)
+            if self._remat is None:
+                import logging
+                logging.getLogger("flexflow_tpu").warning(
+                    "--remat requested but the graph has no eligible "
+                    "repeated-block region (needs >= 2 identical "
+                    "single-crossing blocks without stateful/aux-loss "
+                    "ops); running without rematerialization")
         if self.pipe is not None:
             self._pre_layers = program.layers[:self.pipe.start]
             self._post_layers = program.layers[self.pipe.end:]
@@ -297,7 +339,9 @@ class Executor:
         ctx = EmitCtx(training=training, rngs=rngs, state=state,
                       config=self.config)
         capture: Dict[int, Any] = {}
-        if self.pipe is None:
+        if self.pipe is None and self._remat is not None:
+            outs = self._emit_remat(params, batch, ctx, capture)
+        elif self.pipe is None:
             outs = self.program.emit(params, batch, ctx, self.strategy,
                                      capture)
         else:
@@ -315,6 +359,41 @@ class Executor:
         for k, v in ctx.new_state.items():
             new_state[k] = v
         return outs, new_state, ctx.aux_losses, capture
+
+    def _emit_remat(self, params, batch, ctx, capture):
+        """Forward with each repeated block wrapped in ``jax.checkpoint``:
+        block-internal activations are recomputed in the backward pass
+        instead of living in HBM for the whole step."""
+        start, unit, reps, entries, exits = self._remat
+        layers = self.program.layers
+        env = self.program.init_env(batch)
+        self.program.emit_layers(layers[:start], env, params, ctx,
+                                 self.strategy, capture)
+        x = env[entries[0]]
+        for b in range(reps):
+            block = layers[start + b * unit:start + (b + 1) * unit]
+            entry_g, exit_g = entries[b], exits[b]
+
+            def block_fn(x_, p_, _block=block, _entry=entry_g,
+                         _exit=exit_g):
+                benv = {_entry: x_}
+                bctx = EmitCtx(training=ctx.training, rngs=ctx.rngs,
+                               state=ctx.state, config=self.config,
+                               seq_length=ctx.seq_length)
+                self.program.emit_layers(_block, benv, p_, bctx,
+                                         self.strategy, None)
+                assert not bctx.new_state and not bctx.aux_losses, \
+                    "stateful/aux op inside a rematted block"
+                return benv[_exit]
+
+            bp = {l.name: params[l.name] for l in block
+                  if l.name in params}
+            x = jax.checkpoint(block_fn)(x, bp)
+            env[exit_g] = x
+            capture[exit_g] = x
+        self.program.emit_layers(layers[start + reps * unit:], env,
+                                 params, ctx, self.strategy, capture)
+        return [env[t.guid] for t in self.program.output_tensors]
 
     def _loss_and_metrics(self, outs, capture, label, aux_losses):
         pred = outs[0]

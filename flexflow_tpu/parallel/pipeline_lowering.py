@@ -136,26 +136,8 @@ def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
     for the interleaved schedule). Returns None when the graph has no
     such region (the caller falls back to non-pipelined execution)."""
     layers = list(layers)
-    n = len(layers)
     n_parts = n_stages * max(n_chunks, 1)   # total chunk count to divide by
-    sigs = [layer_signature(l) for l in layers]
-    best: Optional[Tuple[int, int, int]] = None  # (total_len, start, unit)
-    for unit in range(1, n // max(n_parts, 2) + 1):
-        for start in range(n - unit * 2 + 1):
-            # count consecutive repeats of layers[start:start+unit]
-            reps = 1
-            while True:
-                nxt = start + reps * unit
-                if nxt + unit > n:
-                    break
-                if sigs[nxt:nxt + unit] != sigs[start:start + unit]:
-                    break
-                reps += 1
-            reps -= reps % n_parts           # whole chunks only
-            if reps >= n_parts and reps * unit > (best or (0,))[0]:
-                # verify structure before accepting
-                if _verify_run(layers, start, unit, reps):
-                    best = (reps * unit, start, unit)
+    best = find_repeated_run(layers, n_parts)
     if best is None:
         return None
     total, start, unit = best
@@ -164,15 +146,10 @@ def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
     end = start + total
     region = layers[start:end]
     # chunk boundaries must each cross exactly one tensor
-    entry = _single_crossing(layers[:start] + region, start, start + total)
-    if entry is None:
+    boundaries = chunk_boundaries(layers, start, per_chunk, n_parts)
+    if boundaries is None:
         return None
-    boundaries = [entry]
-    for c in range(1, n_parts):
-        g = _single_crossing(region, c * per_chunk, total)
-        if g is None:
-            return None
-        boundaries.append(g)
+    entry = boundaries[0]
     exit_guid = region[-1].outputs[0].guid
     # chunk shape preservation: entry and exit tensors of each chunk match
     by_guid = {t.guid: t for l in layers for t in l.outputs}
@@ -211,6 +188,55 @@ def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
         stage_layer_names=[
             [l.name for l in region[c * per_chunk:(c + 1) * per_chunk]]
             for c in range(n_parts)])
+
+
+def find_repeated_run(layers: Sequence[Layer], n_parts: int = 1
+                      ) -> Optional[Tuple[int, int, int]]:
+    """The maximal verified run of identical consecutive chunks whose
+    repeat count is divisible by ``n_parts``. Returns
+    ``(total_len, start, unit)`` or None. Shared by the pipeline region
+    finder and the block-rematerialization pass."""
+    layers = list(layers)
+    n = len(layers)
+    sigs = [layer_signature(l) for l in layers]
+    best: Optional[Tuple[int, int, int]] = None  # (total_len, start, unit)
+    for unit in range(1, n // max(n_parts, 2) + 1):
+        for start in range(n - unit * 2 + 1):
+            # count consecutive repeats of layers[start:start+unit]
+            reps = 1
+            while True:
+                nxt = start + reps * unit
+                if nxt + unit > n:
+                    break
+                if sigs[nxt:nxt + unit] != sigs[start:start + unit]:
+                    break
+                reps += 1
+            reps -= reps % n_parts           # whole chunks only
+            if reps >= max(n_parts, 2) and reps * unit > (best or (0,))[0]:
+                # verify structure before accepting
+                if _verify_run(layers, start, unit, reps):
+                    best = (reps * unit, start, unit)
+    return best
+
+
+def chunk_boundaries(layers: Sequence[Layer], start: int, unit: int,
+                     reps: int) -> Optional[List[int]]:
+    """Entry-tensor guid of each of the ``reps`` unit chunks of the run,
+    or None if any boundary crosses more than one tensor. Shared by the
+    pipeline region finder and the block-rematerialization pass."""
+    layers = list(layers)
+    total = reps * unit
+    region = layers[start:start + total]
+    e0 = _single_crossing(layers[:start] + region, start, start + total)
+    if e0 is None:
+        return None
+    out = [e0]
+    for b in range(1, reps):
+        g = _single_crossing(region, b * unit, total)
+        if g is None:
+            return None
+        out.append(g)
+    return out
 
 
 def _verify_run(layers: Sequence[Layer], start: int, unit: int,

@@ -82,6 +82,9 @@ class FFConfig:
     # rematerialization: "none" | "blocks" (jax.checkpoint around each
     # repeated block — HBM-for-FLOPs; executor._emit_remat)
     remat: str = "none"
+    # micro-batch gradient accumulation (one optimizer update per
+    # `gradient_accumulation_steps` micro-batches; batch_size must divide)
+    gradient_accumulation_steps: int = 1
     # let the search score a pipeline candidate (bubble model) against the
     # searched sharding strategy and pick the winner
     enable_pipeline_search: bool = False
@@ -234,6 +237,8 @@ class FFConfig:
                 cfg.shard_optimizer_states = True
             elif a == "--remat":
                 cfg.remat = "blocks"
+            elif a in ("--gradient-accumulation-steps", "--accum"):
+                cfg.gradient_accumulation_steps = int(take())
             elif a == "--enable-pipeline-search":
                 cfg.enable_pipeline_search = True
             elif a == "--seed":

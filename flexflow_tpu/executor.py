@@ -339,7 +339,10 @@ class Executor:
         ctx = EmitCtx(training=training, rngs=rngs, state=state,
                       config=self.config)
         capture: Dict[int, Any] = {}
-        if self.pipe is None and self._remat is not None:
+        # checkpointing only matters under differentiation: eval/serving
+        # forwards skip the remat path (prevent_cse barriers would only
+        # inhibit XLA fusion there)
+        if self.pipe is None and self._remat is not None and training:
             outs = self._emit_remat(params, batch, ctx, capture)
         elif self.pipe is None:
             outs = self.program.emit(params, batch, ctx, self.strategy,
@@ -418,16 +421,49 @@ class Executor:
         if self._train_step is not None:
             return self._train_step
 
+        accum = max(getattr(self.config, "gradient_accumulation_steps", 1),
+                    1)
+        assert self.config.batch_size % accum == 0, \
+            (f"--gradient-accumulation-steps {accum} must divide the "
+             f"batch size {self.config.batch_size}")
+
+        def loss_fn(p, st, mb, sub_step):
+            outs, new_state, aux, capture = self._forward(
+                p, st, mb, True, sub_step)
+            loss, bm = self._loss_and_metrics(outs, capture, mb["label"],
+                                              aux)
+            return loss, (new_state, bm)
+
         def step_fn(params, opt_state, state, step, batch):
-            label = batch["label"]
+            if accum <= 1:
+                grads, (new_state, bm) = jax.grad(
+                    loss_fn, has_aux=True)(params, state, batch, step)
+            else:
+                # gradient accumulation: scan over A micro-batches,
+                # summing grads (mean losses => mean of micro grads ==
+                # full-batch grad); one optimizer update per step.
+                # Activations live one micro-batch at a time — an HBM
+                # lever composing with --remat.
+                def micro(carry, xs):
+                    g_acc, st = carry
+                    mb, i = xs
+                    g, (st2, bm_i) = jax.grad(loss_fn, has_aux=True)(
+                        params, st, mb, step * accum + i)
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    return (g_acc, st2), bm_i
 
-            def loss_fn(p):
-                outs, new_state, aux, capture = self._forward(
-                    p, state, batch, True, step)
-                loss, bm = self._loss_and_metrics(outs, capture, label, aux)
-                return loss, (new_state, bm)
-
-            grads, (new_state, bm) = jax.grad(loss_fn, has_aux=True)(params)
+                mbs = jax.tree.map(
+                    lambda v: v.reshape((accum, v.shape[0] // accum)
+                                        + v.shape[1:]), batch)
+                g0 = jax.tree.map(jnp.zeros_like, params)
+                (g_sum, new_state), bms = jax.lax.scan(
+                    micro, (g0, state), (mbs, jnp.arange(accum)))
+                grads = jax.tree.map(lambda g: g / accum, g_sum)
+                # mean-valued metrics average across micro-batches;
+                # count-valued ones (accuracy_correct) must SUM
+                bm = {k: (jnp.sum(v, axis=0) if k == "accuracy_correct"
+                          else jnp.mean(v, axis=0))
+                      for k, v in bms.items()}
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, step + 1)
             if self.opt_state_constraints is not None:

@@ -452,16 +452,25 @@ class Executor:
                     g_acc = jax.tree.map(jnp.add, g_acc, g)
                     return (g_acc, st2), bm_i
 
-                mbs = jax.tree.map(
-                    lambda v: v.reshape((accum, v.shape[0] // accum)
-                                        + v.shape[1:]), batch)
+                def to_micro(v):
+                    # the RUNTIME batch (fit(batch_size=...) may differ
+                    # from config.batch_size) must also divide
+                    assert v.shape[0] % accum == 0, \
+                        (f"batch dim {v.shape[0]} not divisible into "
+                         f"{accum} accumulation micro-batches")
+                    return v.reshape((accum, v.shape[0] // accum)
+                                     + v.shape[1:])
+
+                mbs = jax.tree.map(to_micro, batch)
                 g0 = jax.tree.map(jnp.zeros_like, params)
                 (g_sum, new_state), bms = jax.lax.scan(
                     micro, (g0, state), (mbs, jnp.arange(accum)))
                 grads = jax.tree.map(lambda g: g / accum, g_sum)
                 # mean-valued metrics average across micro-batches;
-                # count-valued ones (accuracy_correct) must SUM
-                bm = {k: (jnp.sum(v, axis=0) if k == "accuracy_correct"
+                # count-valued ones must SUM (ownership of the
+                # distinction lives with the metrics module)
+                bm = {k: (jnp.sum(v, axis=0)
+                          if k in metrics_mod.COUNT_KEYS
                           else jnp.mean(v, axis=0))
                       for k, v in bms.items()}
             new_params, new_opt_state = self.optimizer.update(

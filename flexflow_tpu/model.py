@@ -696,6 +696,59 @@ class FFModel:
         self._last_fwd = fwd(self.params, self.state, batch)
         return self._last_fwd
 
+    def generate(self, prompt_ids, prompt_len: int,
+                 max_new_tokens: int, temperature: float = 0.0,
+                 seed: int = 0, extra_inputs=None):
+        """Autoregressive generation for causal LMs (GPT-2 / LLaMA /
+        transformer-LM family; the reference has no generation path —
+        its Triton backend serves fixed forwards only).
+
+        ``prompt_ids``: (batch, seq_len) int32, the prompt in columns
+        [0, prompt_len) and anything (e.g. zeros) after — the model's
+        causal mask guarantees positions < t ignore columns >= t, so a
+        full re-forward per step is exact. One jitted ``lax.scan`` over
+        ``max_new_tokens`` steps; tokens are written in place up to
+        ``prompt_len + max_new_tokens`` (must be <= the built seq_len).
+        ``temperature`` 0 = greedy argmax, > 0 = softmax sampling.
+        Returns the completed (batch, seq_len) ids."""
+        assert self.executor is not None, "call compile() first"
+        ids0 = jnp.asarray(prompt_ids, jnp.int32)
+        b, L = ids0.shape
+        assert prompt_len >= 1, \
+            "prompt_len must be >= 1 (the first token conditions decode)"
+        assert prompt_len + max_new_tokens <= L, \
+            (prompt_len, max_new_tokens, L)
+        fwd = self.executor.make_forward()
+        names = {t.name for t in self.graph_inputs}
+        fixed = {k: jnp.asarray(v)
+                 for k, v in (extra_inputs or {}).items()}
+        if "position_ids" in names and "position_ids" not in fixed:
+            fixed["position_ids"] = jnp.tile(
+                jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
+        params, state = self.params, self.state
+
+        def step(carry, i):
+            ids, key = carry
+            out = fwd(params, state, {"input_ids": ids, **fixed})
+            probs = out[0] if isinstance(out, (list, tuple)) else out
+            cur = prompt_len + i              # index being generated
+            row = jax.lax.dynamic_slice_in_dim(probs, cur - 1, 1,
+                                               axis=1)[:, 0, :]
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                logp = jnp.log(jnp.clip(row, 1e-20)) / temperature
+                nxt = jax.random.categorical(sub, logp, axis=-1)
+            else:
+                nxt = jnp.argmax(row, axis=-1)
+            ids = jax.lax.dynamic_update_slice_in_dim(
+                ids, nxt.astype(jnp.int32)[:, None], cur, axis=1)
+            return (ids, key), nxt
+
+        key0 = jax.random.key(seed)
+        (ids, _), _ = jax.lax.scan(
+            step, (ids0, key0), jnp.arange(max_new_tokens))
+        return ids
+
     def zero_gradients(self):
         pass  # grads are recomputed functionally each step
 

@@ -15,6 +15,11 @@ import jax.numpy as jnp
 
 from ..ffconst import LossType, MetricsType
 
+# batch-metric keys that are COUNTS over samples (vs per-sample means):
+# accumulation/reduction layers must SUM these across micro-batches,
+# never average (see Executor.make_train_step)
+COUNT_KEYS = frozenset({"accuracy_correct"})
+
 
 @dataclasses.dataclass
 class PerfMetrics:

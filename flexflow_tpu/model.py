@@ -725,29 +725,39 @@ class FFModel:
         if "position_ids" in names and "position_ids" not in fixed:
             fixed["position_ids"] = jnp.tile(
                 jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
-        params, state = self.params, self.state
 
-        def step(carry, i):
-            ids, key = carry
-            out = fwd(params, state, {"input_ids": ids, **fixed})
-            probs = out[0] if isinstance(out, (list, tuple)) else out
-            cur = prompt_len + i              # index being generated
-            row = jax.lax.dynamic_slice_in_dim(probs, cur - 1, 1,
-                                               axis=1)[:, 0, :]
-            if temperature > 0.0:
-                key, sub = jax.random.split(key)
-                logp = jnp.log(jnp.clip(row, 1e-20)) / temperature
-                nxt = jax.random.categorical(sub, logp, axis=-1)
-            else:
-                nxt = jnp.argmax(row, axis=-1)
-            ids = jax.lax.dynamic_update_slice_in_dim(
-                ids, nxt.astype(jnp.int32)[:, None], cur, axis=1)
-            return (ids, key), nxt
+        def decode(params, state, ids0, key0, fixed, plen):
+            def step(carry, i):
+                ids, key = carry
+                out = fwd(params, state, {"input_ids": ids, **fixed})
+                probs = out[0] if isinstance(out, (list, tuple)) else out
+                cur = plen + i                # index being generated
+                row = jax.lax.dynamic_slice_in_dim(probs, cur - 1, 1,
+                                                   axis=1)[:, 0, :]
+                if temperature > 0.0:
+                    key, sub = jax.random.split(key)
+                    logp = jnp.log(jnp.clip(row, 1e-20)) / temperature
+                    nxt = jax.random.categorical(sub, logp, axis=-1)
+                else:
+                    nxt = jnp.argmax(row, axis=-1)
+                ids = jax.lax.dynamic_update_slice_in_dim(
+                    ids, nxt.astype(jnp.int32)[:, None], cur, axis=1)
+                return (ids, key), nxt
 
-        key0 = jax.random.key(seed)
-        (ids, _), _ = jax.lax.scan(
-            step, (ids0, key0), jnp.arange(max_new_tokens))
-        return ids
+            (ids, _), _ = jax.lax.scan(
+                step, (ids0, key0), jnp.arange(max_new_tokens))
+            return ids
+
+        # jit cached per (shape, steps, temperature); prompt_len is a
+        # TRACED argument so serving traffic with varying prompt lengths
+        # reuses one compiled program per shape instead of one per length
+        cache = self.executor.__dict__.setdefault("_decode_cache", {})
+        ck = (b, L, max_new_tokens, float(temperature))
+        fn = cache.get(ck)
+        if fn is None:
+            fn = cache[ck] = jax.jit(decode)
+        return fn(self.params, self.state, ids0, jax.random.key(seed),
+                  fixed, jnp.int32(prompt_len))
 
     def zero_gradients(self):
         pass  # grads are recomputed functionally each step

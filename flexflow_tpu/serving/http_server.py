@@ -5,6 +5,10 @@ Endpoints (JSON bodies, shapes row-major):
   - ``GET  /v2/models``                  -> {"models": [names]}
   - ``POST /v2/models/<name>/infer``     -> {"outputs": [{"data", "shape"}]}
     body: {"inputs": [{"name": ..., "shape": [...], "data": [flat]}]}
+  - ``POST /v2/models/<name>/generate``  -> {"outputs": [{"name":
+    "output_ids", ...}]} — causal-LM decode; body adds
+    {"parameters": {"prompt_len", "max_new_tokens", "temperature",
+    "seed"}}
 
 Reference analog: the Triton backend's HTTP surface
 (``/root/reference/triton/README.md``); stdlib-only so it runs anywhere
@@ -42,11 +46,11 @@ def _make_handler(repo, schedulers):
 
         def do_POST(self):
             parts = self.path.strip("/").split("/")
-            # v2/models/<name>/infer
+            # v2/models/<name>/{infer,generate}
             if len(parts) != 4 or parts[:2] != ["v2", "models"] \
-                    or parts[3] != "infer":
+                    or parts[3] not in ("infer", "generate"):
                 return self._send(404, {"error": f"no route {self.path}"})
-            name = parts[2]
+            name, verb = parts[2], parts[3]
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 doc = json.loads(self.rfile.read(n))
@@ -56,6 +60,25 @@ def _make_handler(repo, schedulers):
                         rec.get("datatype", "float32").lower()
                         .replace("fp", "float")))
                     inputs[rec["name"]] = arr.reshape(rec["shape"])
+                if verb == "generate":
+                    sess = repo.get(name)      # unknown model -> 404
+                    p = doc.get("parameters", {})
+                    missing = [k for k in ("prompt_len",
+                                           "max_new_tokens") if k not in p]
+                    if missing or "input_ids" not in inputs:
+                        return self._send(400, {
+                            "error": "generate needs inputs.input_ids "
+                                     f"and parameters {missing or ''}"})
+                    out = sess.generate(
+                        inputs["input_ids"],
+                        prompt_len=int(p["prompt_len"]),
+                        max_new_tokens=int(p["max_new_tokens"]),
+                        temperature=float(p.get("temperature", 0.0)),
+                        seed=int(p.get("seed", 0)))
+                    return self._send(200, {"outputs": [{
+                        "name": "output_ids", "shape": list(out.shape),
+                        "data": np.asarray(out, np.int32)
+                        .ravel().tolist()}]})
                 sched = schedulers.get(name)
                 out = sched.infer(inputs) if sched is not None \
                     else repo.get(name).infer(inputs)

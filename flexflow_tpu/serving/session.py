@@ -66,6 +66,32 @@ class InferenceSession:
             out = self._fwd(self.ff.params, self.ff.state, padded)
         return np.asarray(out)[:n]
 
+    def generate(self, input_ids: np.ndarray, prompt_len: int,
+                 max_new_tokens: int, temperature: float = 0.0,
+                 seed: int = 0) -> np.ndarray:
+        """Autoregressive decode for causal-LM sessions. Batch is padded
+        to the bucket (decode programs cache per bucket inside
+        ``FFModel.generate``); the padded rows' outputs are sliced off."""
+        ids = np.ascontiguousarray(np.asarray(input_ids, np.int32))
+        n = int(ids.shape[0])
+        cap = self.buckets[-1]
+        if n > cap:
+            # per-chunk seed: identical prompts in different chunks must
+            # not draw identical sampling streams
+            return np.concatenate(
+                [self.generate(ids[i:i + cap], prompt_len,
+                               max_new_tokens, temperature,
+                               seed + i // cap)
+                 for i in range(0, n, cap)], axis=0)
+        bucket = _next_bucket(n, self.buckets)
+        if bucket != n:
+            pad = np.zeros((bucket - n,) + ids.shape[1:], ids.dtype)
+            ids = np.concatenate([ids, pad], axis=0)
+        with self._lock:
+            out = self.ff.generate(ids, prompt_len, max_new_tokens,
+                                   temperature=temperature, seed=seed)
+        return np.asarray(out)[:n]
+
 
 class ModelRepository:
     """Name -> session registry (Triton model-repository analog)."""

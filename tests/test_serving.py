@@ -137,3 +137,61 @@ def test_session_oversized_batch_chunks():
     assert out.shape == (11, 4)
     np.testing.assert_allclose(out[:4], sess.infer({"input": x[:4]}),
                                rtol=1e-5, atol=1e-5)
+
+
+def _gpt2_session():
+    from flexflow_tpu.models import GPTConfig, build_gpt2
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    cfg.only_data_parallel = True
+    g = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                  num_heads=4, max_position=16, dropout=0.0)
+    ff = FFModel(cfg)
+    out = build_gpt2(ff, 2, 16, g)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return InferenceSession(ff, batch_buckets=(1, 2, 4)), g
+
+
+def test_session_generate_pads_and_matches_direct():
+    sess, g = _gpt2_session()
+    rng = np.random.default_rng(0)
+    ids = np.zeros((2, 16), np.int32)
+    ids[:, :4] = rng.integers(0, g.vocab_size, size=(2, 4))
+    direct = np.asarray(sess.ff.generate(ids, 4, 5))
+    # batch-1 request pads to bucket 1; rows must match the direct run
+    one = sess.generate(ids[:1], prompt_len=4, max_new_tokens=5)
+    np.testing.assert_array_equal(one[0, :9], direct[0, :9])
+
+
+def test_http_generate_roundtrip():
+    sess, g = _gpt2_session()
+    repo = ModelRepository()
+    repo.register("gpt2", sess)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv, thread, scheds = serve_http(repo, port=port, block=False,
+                                     batching=False)
+    try:
+        ids = np.zeros((2, 16), np.int32)
+        ids[:, 0] = 3
+        body = json.dumps({
+            "inputs": [{"name": "input_ids", "shape": [2, 16],
+                        "datatype": "int32",
+                        "data": ids.ravel().tolist()}],
+            "parameters": {"prompt_len": 1, "max_new_tokens": 4},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v2/models/gpt2/generate",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            out = json.load(r)["outputs"][0]
+        assert out["name"] == "output_ids" and out["shape"] == [2, 16]
+        got = np.asarray(out["data"], np.int32).reshape(2, 16)
+        want = np.asarray(sess.ff.generate(ids, 1, 4))
+        np.testing.assert_array_equal(got[:, :5], want[:, :5])
+    finally:
+        srv.shutdown()
+        for s_ in scheds.values():
+            s_.close()

@@ -698,7 +698,8 @@ class FFModel:
 
     def generate(self, prompt_ids, prompt_len: int,
                  max_new_tokens: int, temperature: float = 0.0,
-                 seed: int = 0, extra_inputs=None):
+                 seed: int = 0, extra_inputs=None,
+                 eos_token_id: int | None = None):
         """Autoregressive generation for causal LMs (GPT-2 / LLaMA /
         transformer-LM family; the reference has no generation path —
         its Triton backend serves fixed forwards only).
@@ -710,7 +711,9 @@ class FFModel:
         ``max_new_tokens`` steps; tokens are written in place up to
         ``prompt_len + max_new_tokens`` (must be <= the built seq_len).
         ``temperature`` 0 = greedy argmax, > 0 = softmax sampling.
-        Returns the completed (batch, seq_len) ids."""
+        ``eos_token_id``: rows that emit it keep emitting it for the
+        remaining steps (the scan length stays static — standard jit
+        practice). Returns the completed (batch, seq_len) ids."""
         assert self.executor is not None, "call compile() first"
         ids0 = jnp.asarray(prompt_ids, jnp.int32)
         b, L = ids0.shape
@@ -727,8 +730,10 @@ class FFModel:
                 jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
 
         def decode(params, state, ids0, key0, fixed, plen):
+            done0 = jnp.zeros((b,), jnp.bool_)
+
             def step(carry, i):
-                ids, key = carry
+                ids, key, done = carry
                 out = fwd(params, state, {"input_ids": ids, **fixed})
                 probs = out[0] if isinstance(out, (list, tuple)) else out
                 cur = plen + i                # index being generated
@@ -740,19 +745,24 @@ class FFModel:
                     nxt = jax.random.categorical(sub, logp, axis=-1)
                 else:
                     nxt = jnp.argmax(row, axis=-1)
+                nxt = nxt.astype(jnp.int32)
+                if eos_token_id is not None:
+                    eos = jnp.int32(eos_token_id)
+                    nxt = jnp.where(done, eos, nxt)
+                    done = jnp.logical_or(done, nxt == eos)
                 ids = jax.lax.dynamic_update_slice_in_dim(
-                    ids, nxt.astype(jnp.int32)[:, None], cur, axis=1)
-                return (ids, key), nxt
+                    ids, nxt[:, None], cur, axis=1)
+                return (ids, key, done), nxt
 
-            (ids, _), _ = jax.lax.scan(
-                step, (ids0, key0), jnp.arange(max_new_tokens))
+            (ids, _, _), _ = jax.lax.scan(
+                step, (ids0, key0, done0), jnp.arange(max_new_tokens))
             return ids
 
-        # jit cached per (shape, steps, temperature); prompt_len is a
-        # TRACED argument so serving traffic with varying prompt lengths
-        # reuses one compiled program per shape instead of one per length
+        # jit cached per (shape, steps, temperature, eos); prompt_len is
+        # a TRACED argument so serving traffic with varying prompt
+        # lengths reuses one compiled program per shape, not per length
         cache = self.executor.__dict__.setdefault("_decode_cache", {})
-        ck = (b, L, max_new_tokens, float(temperature))
+        ck = (b, L, max_new_tokens, float(temperature), eos_token_id)
         fn = cache.get(ck)
         if fn is None:
             fn = cache[ck] = jax.jit(decode)

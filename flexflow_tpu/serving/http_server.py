@@ -69,12 +69,14 @@ def _make_handler(repo, schedulers):
                         return self._send(400, {
                             "error": "generate needs inputs.input_ids "
                                      f"and parameters {missing or ''}"})
+                    eos = p.get("eos_token_id")
                     out = sess.generate(
                         inputs["input_ids"],
                         prompt_len=int(p["prompt_len"]),
                         max_new_tokens=int(p["max_new_tokens"]),
                         temperature=float(p.get("temperature", 0.0)),
-                        seed=int(p.get("seed", 0)))
+                        seed=int(p.get("seed", 0)),
+                        eos_token_id=None if eos is None else int(eos))
                     return self._send(200, {"outputs": [{
                         "name": "output_ids", "shape": list(out.shape),
                         "data": np.asarray(out, np.int32)

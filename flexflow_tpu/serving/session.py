@@ -68,7 +68,8 @@ class InferenceSession:
 
     def generate(self, input_ids: np.ndarray, prompt_len: int,
                  max_new_tokens: int, temperature: float = 0.0,
-                 seed: int = 0) -> np.ndarray:
+                 seed: int = 0,
+                 eos_token_id: "int | None" = None) -> np.ndarray:
         """Autoregressive decode for causal-LM sessions. Batch is padded
         to the bucket (decode programs cache per bucket inside
         ``FFModel.generate``); the padded rows' outputs are sliced off."""
@@ -81,7 +82,7 @@ class InferenceSession:
             return np.concatenate(
                 [self.generate(ids[i:i + cap], prompt_len,
                                max_new_tokens, temperature,
-                               seed + i // cap)
+                               seed + i // cap, eos_token_id)
                  for i in range(0, n, cap)], axis=0)
         bucket = _next_bucket(n, self.buckets)
         if bucket != n:
@@ -89,7 +90,8 @@ class InferenceSession:
             ids = np.concatenate([ids, pad], axis=0)
         with self._lock:
             out = self.ff.generate(ids, prompt_len, max_new_tokens,
-                                   temperature=temperature, seed=seed)
+                                   temperature=temperature, seed=seed,
+                                   eos_token_id=eos_token_id)
         return np.asarray(out)[:n]
 
 

@@ -94,3 +94,20 @@ def test_generate_llama():
     # determinism of the greedy path
     again = np.asarray(ff.generate(ids, prompt_len=3, max_new_tokens=4))
     np.testing.assert_array_equal(got, again)
+
+
+def test_generate_eos_latches():
+    """Once a row emits eos_token_id, it keeps emitting it."""
+    ff, g = _compiled_gpt2()
+    rng = np.random.default_rng(3)
+    ids = np.zeros((BATCH, SEQ), np.int32)
+    ids[:, :2] = rng.integers(0, g.vocab_size, size=(BATCH, 2))
+    # pick the very first greedily generated token as the "eos" so it
+    # latches immediately on step 0
+    free = np.asarray(ff.generate(ids, 2, 5))
+    eos = int(free[0, 2])
+    got = np.asarray(ff.generate(ids, 2, 5, eos_token_id=eos))
+    assert (got[0, 2:7] == eos).all(), got[0, 2:7]
+    # the latch is PER ROW: a row that never emits eos is unaffected
+    if not (free[1, 2:7] == eos).any():
+        np.testing.assert_array_equal(got[1, :7], free[1, :7])

@@ -8,7 +8,7 @@ Endpoints (JSON bodies, shapes row-major):
   - ``POST /v2/models/<name>/generate``  -> {"outputs": [{"name":
     "output_ids", ...}]} — causal-LM decode; body adds
     {"parameters": {"prompt_len", "max_new_tokens", "temperature",
-    "seed"}}
+    "seed", "eos_token_id"}}
 
 Reference analog: the Triton backend's HTTP surface
 (``/root/reference/triton/README.md``); stdlib-only so it runs anywhere

@@ -19,6 +19,7 @@ FAST = [
     ("transformer.py", ["-b", "4", "--only-data-parallel"]),
     ("nmt.py", ["-b", "8", "--only-data-parallel"]),
     ("llama.py", ["-b", "8", "--only-data-parallel"]),
+    ("generate_lm.py", ["--steps", "40", "--serve"]),
 ]
 
 SLOW = [

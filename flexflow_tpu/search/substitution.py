@@ -598,31 +598,38 @@ def create_partition_embedding_combine(degree: int) -> GraphXfer:
 # to a batch(dp) x feature/head(tp) hybrid, the strategy family Megatron/
 # Unity find for transformer blocks.
 # ---------------------------------------------------------------------------
-def create_partition_linear_combine_2d(dp: int, tp: int) -> GraphXfer:
-    """Batch-partition by ``dp`` AND column-parallel the kernel by ``tp``
-    in one rewrite (composed analog of ``create_partition_linear_combine``
-    + ``create_replicate_linear_combine``)."""
-    g1, g2 = f"dp{dp}", f"tp{tp}"
-    x = TensorX()
-
+def _col_linear_cond(dp: int, tp: int):
+    """Shared eligibility for batch(dp) x column(tp) linear rewrites."""
     def cond(n: PNode, gr: Graph) -> bool:
         if not _unannotated(n, gr):
             return False
         o = n.layer.outputs[0].shape
         return len(o) >= 2 and o[0] % dp == 0 and o[0] >= dp \
             and o[-1] % tp == 0 and o[-1] >= tp
+    return cond
 
-    src = OpX(OperatorType.OP_LINEAR, [x], cond=cond)
-    part = _partition(x, 0, dp, g1)
-    rep = _replicate(part.out(), tp, g2)
 
+def _col_linear_ann(src: OpX, dp: int, tp: int, g1: str, g2: str):
+    """Shared annotation: batch on g1, kernel output-dim on g2."""
     def ann(mapping):
         r = _rank_of(mapping[src])
         return ParAnn(groups=((g1, dp), (g2, tp)),
                       out=((0, 0, g1), (0, r - 1, g2)),
                       weights=(("kernel", 1, g2), ("bias", 0, g2)))
+    return ann
 
-    dst = OpX(OperatorType.OP_LINEAR, [rep.out()], share=src, ann=ann)
+
+def create_partition_linear_combine_2d(dp: int, tp: int) -> GraphXfer:
+    """Batch-partition by ``dp`` AND column-parallel the kernel by ``tp``
+    in one rewrite (composed analog of ``create_partition_linear_combine``
+    + ``create_replicate_linear_combine``)."""
+    g1, g2 = f"dp{dp}", f"tp{tp}"
+    x = TensorX()
+    src = OpX(OperatorType.OP_LINEAR, [x], cond=_col_linear_cond(dp, tp))
+    part = _partition(x, 0, dp, g1)
+    rep = _replicate(part.out(), tp, g2)
+    dst = OpX(OperatorType.OP_LINEAR, [rep.out()], share=src,
+              ann=_col_linear_ann(src, dp, tp, g1, g2))
 
     def comb_params(mapping):
         return {"dim": _rank_of(mapping[src]) - 1, "degree": tp,
@@ -701,6 +708,35 @@ def create_partition_attention_combine_2d(dp: int, tp: int) -> GraphXfer:
     return GraphXfer(f"partition_attention_combine_2d_dp{dp}xhp{tp}", [src],
                      parts + reps + [dst, red, comb],
                      [(src.out(), comb.out())])
+
+
+def create_partition_ffn_2d(dp: int, tp: int) -> GraphXfer:
+    """Megatron-paired FFN in one rewrite: Linear -> Linear becomes
+    batch-partition(dp) x [column-parallel d1 -> row-parallel d2] with a
+    SINGLE tp all-reduce after d2 — the intermediate (the wide dim)
+    never leaves the shard, unlike rewriting the two linears
+    independently (which gathers the wide activation). The canonical
+    transformer-FFN machine view (Megatron-LM); the reference's rule set
+    reaches it only through multi-step substitution chains."""
+    g1, g2 = f"dp{dp}", f"mp{tp}"
+    x = TensorX()
+    l1 = OpX(OperatorType.OP_LINEAR, [x], cond=_col_linear_cond(dp, tp))
+    # l2's input IS l1's output, so cond1's last-dim % tp check already
+    # guarantees l2's contraction-dim divisibility
+    l2 = OpX(OperatorType.OP_LINEAR, [l1.out()], cond=_unannotated)
+
+    part = _partition(x, 0, dp, g1)
+    rep = _replicate(part.out(), tp, g2)
+    d1 = OpX(OperatorType.OP_LINEAR, [rep.out()], share=l1,
+             ann=_col_linear_ann(l1, dp, tp, g1, g2))
+    d2 = OpX(OperatorType.OP_LINEAR, [d1.out()], share=l2,
+             ann=ParAnn(groups=((g1, dp), (g2, tp)), out=((0, 0, g1),),
+                        weights=(("kernel", 0, g2),), reduce=g2))
+    red = _reduction(d2.out(), tp, g2)
+    comb = _combine(red.out(), 0, dp, g1)
+    return GraphXfer(f"partition_ffn_2d_dp{dp}xmp{tp}", [l1, l2],
+                     [part, rep, d1, d2, red, comb],
+                     [(l2.out(), comb.out())])
 
 
 def degree_pairs(degrees: Sequence[int]) -> List[Tuple[int, int]]:
@@ -796,4 +832,5 @@ def generate_all_pcg_xfers(degrees: Sequence[int],
         xfers.append(create_partition_linear_combine_2d(dp, tp))
         xfers.append(create_partition_linear_reduce_2d(dp, tp))
         xfers.append(create_partition_attention_combine_2d(dp, tp))
+        xfers.append(create_partition_ffn_2d(dp, tp))
     return xfers

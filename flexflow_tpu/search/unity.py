@@ -736,6 +736,7 @@ def hybrid_template_graphs(layers: Sequence[Layer],
                                _NORM_PARTITIONABLE,
                                create_combine_partition_elimination,
                                create_partition_attention_combine_2d,
+                               create_partition_ffn_2d,
                                create_partition_linear_combine_2d,
                                create_partition_op_combine)
     n = dmesh.num_devices
@@ -746,7 +747,10 @@ def hybrid_template_graphs(layers: Sequence[Layer],
         if dp >= n or n % dp or tp not in degs:
             continue
         base = Graph.from_layers(layers, input_tensors, output_tensors)
-        xfers = [create_partition_linear_combine_2d(dp, tp),
+        # paired-FFN rule FIRST: it claims linear->linear chains before
+        # the per-op column rule can split them apart
+        xfers = [create_partition_ffn_2d(dp, tp),
+                 create_partition_linear_combine_2d(dp, tp),
                  create_partition_attention_combine_2d(dp, tp)]
         for op_type, n_in in (_ELEMENTWISE_PARTITIONABLE
                               + _NORM_PARTITIONABLE

@@ -418,3 +418,54 @@ class TestHybridTemplates:
         # rewrite of a big-batch linear must not cost more than 3x serial
         serial = ev.graph_cost(Graph.from_layers(ff.layers, [x], [out]))
         assert c2.total < 3 * serial.total
+
+    def test_ffn_2d_single_reduce(self):
+        """Paired Megatron FFN: one Reduction, no combine of the WIDE
+        intermediate — strictly fewer collectives than two independent
+        linear rewrites."""
+        from flexflow_tpu.search.substitution import \
+            create_partition_ffn_2d
+        ff = FFModel(FFConfig())
+        x = ff.create_tensor([16, 64], name="input")
+        h = ff.dense(x, 256, activation="gelu", name="up")
+        y = ff.dense(h, 64, name="down")
+        out = ff.softmax(ff.dense(y, 8, name="head"))
+        g = Graph.from_layers(ff.layers, [x], [out])
+        res = list(create_partition_ffn_2d(2, 4).run(g))
+        assert res
+        g2 = res[0]
+        assert not g2.check_consistency()
+        kinds = [n.op_type for n in g2.topo_order()]
+        assert kinds.count(OperatorType.OP_REDUCTION) == 1
+        ann = [n for n in g2.topo_order()
+               if n.op_type == OperatorType.OP_LINEAR
+               and len(n.ann.groups) == 2]
+        assert len(ann) == 2             # d1 (col) + d2 (row)
+        assert any(n.ann.reduce for n in ann)
+        # the d1 -> d2 edge carries the SHARDED wide activation: no
+        # parallel op sits between the two rewritten linears
+        d1 = next(n for n in ann if not n.ann.reduce)
+        cons = [e.dst.op_type for e in g2.out_edges[d1]]
+        assert cons == [OperatorType.OP_LINEAR]
+        # extract + validate on the mesh
+        info = g2.to_program()
+        st = extract_strategy(g2, info, mesh8())
+        assert not st.validate()
+
+    def test_ffn_2d_cheaper_than_independent_columns(self):
+        """The evaluator must price the paired form at most as high as
+        two independent column rewrites of the same pair."""
+        from flexflow_tpu.search.substitution import (
+            create_partition_ffn_2d, create_partition_linear_combine_2d)
+        ff = FFModel(FFConfig())
+        x = ff.create_tensor([16, 64], name="input")
+        h = ff.dense(x, 256, activation="gelu", name="up")
+        out = ff.dense(h, 64, name="down")
+        g = Graph.from_layers(ff.layers, [x], [out])
+        dmesh = mesh8()
+        ev = GraphCostEvaluator(OpCostModel(dmesh.spec), dmesh)
+        paired = next(iter(create_partition_ffn_2d(2, 4).run(g)))
+        col = create_partition_linear_combine_2d(2, 4)
+        indep = next(iter(col.run(next(iter(col.run(g))))))
+        assert ev.graph_cost(paired).total \
+            <= ev.graph_cost(indep).total + 1e-12

@@ -240,6 +240,7 @@ def test_torch_fx_huggingface_bert():
     tcfg = HFBertConfig(vocab_size=128, hidden_size=32,
                         num_hidden_layers=2, num_attention_heads=4,
                         intermediate_size=64, max_position_embeddings=64)
+    torch.manual_seed(0)
     m = BertModel(tcfg)
     pm = PyTorchModel(m, is_hf_model=True, batch_size=2)
     cfg = FFConfig()
@@ -274,6 +275,7 @@ def test_torch_fx_huggingface_gpt2():
     tcfg = HFGPT2Config(vocab_size=96, n_embd=32, n_layer=2, n_head=4,
                         n_positions=32, resid_pdrop=0.0, embd_pdrop=0.0,
                         attn_pdrop=0.0)
+    torch.manual_seed(0)
     m = GPT2Model(tcfg)
     pm = PyTorchModel(m, is_hf_model=True, batch_size=2)
     cfg = FFConfig()
@@ -457,6 +459,7 @@ def test_torch_fx_huggingface_mt5():
 
     tcfg = MT5Config(vocab_size=96, d_model=32, d_kv=8, d_ff=64,
                      num_layers=2, num_heads=4, dropout_rate=0.0)
+    torch.manual_seed(0)
     m = MT5Model(tcfg).eval()
     pm = PyTorchModel(m, is_hf_model=True, batch_size=2)
     cfg = FFConfig()

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every ~8 min; on success, write a flag file and exit.
+# Used during round builds so on-chip capture can start the moment the tunnel recovers.
+FLAG=/root/repo/bench_results/tpu_alive.flag
+LOG=/root/repo/bench_results/tpu_probe_loop.log
+rm -f "$FLAG"
+for i in $(seq 1 100); do
+  echo "[$(date +%H:%M:%S)] probe attempt $i" >> "$LOG"
+  PYTHONPATH=/root/repo:/root/.axon_site JAX_PLATFORMS=axon timeout 180 python -c "
+import jax, numpy as np
+x = jax.numpy.ones((256,256))
+print('probe-ok', float(np.asarray((x@x).sum())))
+" >> "$LOG" 2>&1
+  if [ $? -eq 0 ]; then
+    echo "[$(date +%H:%M:%S)] TPU ALIVE" >> "$LOG"
+    date +%s > "$FLAG"
+    exit 0
+  fi
+  sleep 420
+done
+exit 1

@@ -42,8 +42,11 @@ def main():
     extra = sys.argv[1:]
     results = {}
     for script, args in MODELS:
+        # --floor-guard true: the searched leg times itself against the
+        # DP program and falls back when it measures slower, so no A/B
+        # row can lose to data parallel by more than timing noise
         cmd = [sys.executable, os.path.join(EXAMPLES, script), "--ab",
-               "--budget", "8"] + args + extra
+               "--budget", "8", "--floor-guard", "true"] + args + extra
         t0 = time.time()
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,

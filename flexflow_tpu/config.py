@@ -95,6 +95,15 @@ class FFConfig:
     # "auto": Pallas flash attention when compiled on TPU; "true": always
     # (interpret mode off-TPU — slow, test-only); "false": plain XLA attention
     use_flash_attention: str = "auto"
+    # measured DP-floor guard on search adoption: after the search picks a
+    # strategy, compile+time a few real steps of it AND of plain data
+    # parallel, and keep DP when the searched program measures slower (the
+    # reference trusts its calibrated simulator, simulator.cc:537; we
+    # enforce the floor by measurement). "auto" = on when running on a
+    # real accelerator, off on the CPU simulator (double-compile is
+    # expensive there and tests exercise the guard explicitly).
+    search_floor_guard: str = "auto"   # "auto" | "true" | "false"
+    floor_guard_steps: int = 3
     seed: int = 0
 
     def __post_init__(self):
@@ -179,6 +188,10 @@ class FFConfig:
                 cfg.search_algo = take()
             elif a == "--substitution-json":
                 cfg.substitution_json_path = take()
+            elif a == "--floor-guard":
+                cfg.search_floor_guard = take().lower()
+            elif a == "--no-floor-guard":
+                cfg.search_floor_guard = "false"
             elif a == "--simulator-workspace-size":
                 cfg.simulator_workspace_mb = int(take())
             elif a == "--machine-model-version":

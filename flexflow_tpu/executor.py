@@ -153,6 +153,14 @@ class Executor:
         # via its scan, so remat applies to the non-pipelined path only)
         self._remat = None
         if getattr(config, "remat", "none") == "blocks" \
+                and self.pipe is not None:
+            import logging
+            logging.getLogger("flexflow_tpu").warning(
+                "--remat is skipped when a pipeline region is active: "
+                "the GPipe scan already recomputes stage activations "
+                "per microbatch (pre/post-region layers are never "
+                "rematerialized)")
+        if getattr(config, "remat", "none") == "blocks" \
                 and self.pipe is None:
             self._remat = _find_remat_blocks(program.layers)
             if self._remat is None:
@@ -467,12 +475,17 @@ class Executor:
                     micro, (g0, state), (mbs, jnp.arange(accum)))
                 grads = jax.tree.map(lambda g: g / accum, g_sum)
                 # mean-valued metrics average across micro-batches;
-                # count-valued ones must SUM (ownership of the
+                # count-valued ones must SUM; sqrt-of-mean ones (RMSE)
+                # average the squares and sqrt once (ownership of the
                 # distinction lives with the metrics module)
-                bm = {k: (jnp.sum(v, axis=0)
-                          if k in metrics_mod.COUNT_KEYS
-                          else jnp.mean(v, axis=0))
-                      for k, v in bms.items()}
+                def reduce_metric(k, v):
+                    if k in metrics_mod.COUNT_KEYS:
+                        return jnp.sum(v, axis=0)
+                    if k in metrics_mod.RMS_KEYS:
+                        return jnp.sqrt(jnp.mean(v * v, axis=0))
+                    return jnp.mean(v, axis=0)
+
+                bm = {k: reduce_metric(k, v) for k, v in bms.items()}
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, step + 1)
             if self.opt_state_constraints is not None:
@@ -513,3 +526,55 @@ class Executor:
 
         self._forward_fn = jax.jit(fwd)
         return self._forward_fn
+
+    # ------------------------------------------------------------------
+    # generation support (serving; the reference has no generate path)
+    # ------------------------------------------------------------------
+    def scored_forward(self, params, state, batch):
+        """Forward returning log-domain next-token scores (B, L, V):
+        the pre-softmax logits when the graph ends in Softmax (numerically
+        exact), else log of the clipped output probabilities. NOT jitted —
+        call inside a jitted decode loop."""
+        outs, _, _, capture = self._forward(params, state, batch, False,
+                                            jnp.int32(0))
+        if self._logits_tensor is not None \
+                and self._logits_tensor.guid in capture:
+            return capture[self._logits_tensor.guid]
+        return jnp.log(jnp.clip(outs[0], 1e-20))
+
+    def kv_prefill(self, params, state, batch):
+        """Full-sequence forward that also returns every causal
+        attention layer's K/V buffers (the decode cache seed) plus the
+        scores. NOT jitted."""
+        ctx = EmitCtx(training=False, rngs={}, state=state,
+                      config=self.config)
+        ctx.kv_mode = "prefill"
+        capture: Dict[int, Any] = {}
+        outs = self.program.emit(params, batch, ctx, self.strategy,
+                                 capture)
+        if not ctx.new_kv:
+            raise ValueError("graph has no multihead-attention layers to "
+                             "cache (KV decode unsupported)")
+        return outs, ctx.new_kv
+
+    def kv_decode_step(self, params, state, batch, cache, index):
+        """Single-token forward (inputs (B, 1)) against the KV cache at
+        query position ``index``. Returns (scores_row (B, V), new_cache).
+        NOT jitted — called inside the generate scan."""
+        ctx = EmitCtx(training=False, rngs={}, state=state,
+                      config=self.config)
+        ctx.kv_mode = "decode"
+        ctx.kv_cache = cache
+        ctx.kv_index = index
+        capture: Dict[int, Any] = {}
+        outs = self.program.emit(params, batch, ctx, self.strategy,
+                                 capture)
+        if self._logits_tensor is not None \
+                and self._logits_tensor.guid in capture:
+            scores = capture[self._logits_tensor.guid]
+        else:
+            scores = jnp.log(jnp.clip(outs[0], 1e-20))
+        # cache layers that did not run in decode keep their buffers
+        new_cache = dict(cache)
+        new_cache.update(ctx.new_kv)
+        return scores[:, 0, :], new_cache

@@ -552,13 +552,23 @@ class FFModel:
 
         # label tensor adopts the final op's batch sharding
         # (reference model.cc:3086-3124)
-        program = GraphProgram(exec_layers,
-                               self.graph_inputs + self.const_inputs,
-                               exec_outputs)
-        self.executor = Executor(program, self.config, self.dmesh,
-                                 self.strategy, self.optimizer,
-                                 self.loss_type, self.metrics,
-                                 seed=self.config.seed)
+        prebuilt = getattr(self, "_prebuilt_executor", None)
+        if prebuilt is not None and prebuilt[0] is self.strategy \
+                and prebuilt[1] is not None:
+            # the floor guard already compiled this exact program
+            # (same strategy object, same metrics) — adopt its executor
+            # so the jitted train step is not rebuilt; params/state are
+            # re-initialized below
+            self.executor = prebuilt[1]
+            self._prebuilt_executor = None
+        else:
+            program = GraphProgram(exec_layers,
+                                   self.graph_inputs + self.const_inputs,
+                                   exec_outputs)
+            self.executor = Executor(program, self.config, self.dmesh,
+                                     self.strategy, self.optimizer,
+                                     self.loss_type, self.metrics,
+                                     seed=self.config.seed)
         self.params, self.state = self.executor.init_params_and_state()
         self.opt_state = self.optimizer.init_state(self.params)
         if self.config.shard_optimizer_states and self.opt_state:
@@ -699,21 +709,27 @@ class FFModel:
     def generate(self, prompt_ids, prompt_len: int,
                  max_new_tokens: int, temperature: float = 0.0,
                  seed: int = 0, extra_inputs=None,
-                 eos_token_id: int | None = None):
+                 eos_token_id: int | None = None,
+                 kv_cache: Union[bool, str] = "auto"):
         """Autoregressive generation for causal LMs (GPT-2 / LLaMA /
         transformer-LM family; the reference has no generation path —
         its Triton backend serves fixed forwards only).
 
         ``prompt_ids``: (batch, seq_len) int32, the prompt in columns
-        [0, prompt_len) and anything (e.g. zeros) after — the model's
-        causal mask guarantees positions < t ignore columns >= t, so a
-        full re-forward per step is exact. One jitted ``lax.scan`` over
-        ``max_new_tokens`` steps; tokens are written in place up to
-        ``prompt_len + max_new_tokens`` (must be <= the built seq_len).
-        ``temperature`` 0 = greedy argmax, > 0 = softmax sampling.
+        [0, prompt_len) and anything (e.g. zeros) after. ``temperature``
+        0 = greedy argmax, > 0 = sampling from the pre-softmax logits
+        (numerically exact — no re-log of already-softmaxed probs).
         ``eos_token_id``: rows that emit it keep emitting it for the
         remaining steps (the scan length stays static — standard jit
-        practice). Returns the completed (batch, seq_len) ids."""
+        practice). Returns the completed (batch, seq_len) ids.
+
+        ``kv_cache``: "auto" (default) decodes incrementally against a
+        per-layer K/V cache — one prefill forward then one O(1)-length
+        forward per token — when the graph supports it (causal
+        multihead-attention layers, no pipeline region, inputs limited
+        to input_ids/position_ids), silently falling back to the exact
+        full-re-forward path otherwise. True forces the KV path (raises
+        when unsupported), False forces the re-forward oracle."""
         assert self.executor is not None, "call compile() first"
         ids0 = jnp.asarray(prompt_ids, jnp.int32)
         b, L = ids0.shape
@@ -721,7 +737,6 @@ class FFModel:
             "prompt_len must be >= 1 (the first token conditions decode)"
         assert prompt_len + max_new_tokens <= L, \
             (prompt_len, max_new_tokens, L)
-        fwd = self.executor.make_forward()
         names = {t.name for t in self.graph_inputs}
         fixed = {k: jnp.asarray(v)
                  for k, v in (extra_inputs or {}).items()}
@@ -729,27 +744,122 @@ class FFModel:
             fixed["position_ids"] = jnp.tile(
                 jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
 
+        want_kv = kv_cache if isinstance(kv_cache, bool) \
+            else self._kv_decode_eligible(names, extra_inputs)
+        if want_kv:
+            try:
+                return self._generate_kv(ids0, prompt_len, max_new_tokens,
+                                         temperature, seed, eos_token_id)
+            except Exception:
+                if kv_cache is True:
+                    raise
+                import logging
+                logging.getLogger("flexflow_tpu").warning(
+                    "KV-cache decode trace failed for this graph; "
+                    "falling back to full re-forward generation",
+                    exc_info=True)
+        return self._generate_reforward(ids0, prompt_len, max_new_tokens,
+                                        temperature, seed, eos_token_id,
+                                        fixed)
+
+    def _kv_decode_eligible(self, names, extra_inputs) -> bool:
+        """KV decode needs: no pipeline region, inputs limited to
+        input_ids(+position_ids), and every attention layer a causal
+        OP_MULTIHEAD_ATTENTION (primitive-built attention, e.g. LLaMA's
+        explicit-mask batch_matmul form, carries baked seq-length
+        constants that a length-1 trace cannot satisfy)."""
+        if self.executor.pipe is not None or extra_inputs:
+            return False
+        if not names <= {"input_ids", "position_ids"}:
+            return False
+        mha = [l for l in self.executor.program.layers
+               if l.op_type == OperatorType.OP_MULTIHEAD_ATTENTION]
+        return bool(mha) and all(l.params.get("causal", False)
+                                 for l in mha)
+
+    def _generate_kv(self, ids0, prompt_len, max_new_tokens, temperature,
+                     seed, eos_token_id):
+        """Incremental decode: one full-sequence prefill builds the
+        per-layer K/V cache, then each generated token is one seq-len-1
+        forward — per-token cost independent of how many tokens have
+        been generated (the re-forward path is O(L) per token)."""
+        ex = self.executor
+        b, L = ids0.shape
+        has_pos = "position_ids" in {t.name for t in self.graph_inputs}
+
+        def decode(params, state, ids0, key0, plen):
+            batch = {"input_ids": ids0}
+            if has_pos:
+                batch["position_ids"] = jnp.tile(
+                    jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
+            _, cache = ex.kv_prefill(params, state, batch)
+            done0 = jnp.zeros((b,), jnp.bool_)
+
+            def step(carry, i):
+                ids, cache, key, done = carry
+                cur = plen + i                # index being generated
+                tok = jax.lax.dynamic_slice_in_dim(ids, cur - 1, 1,
+                                                   axis=1)
+                sb = {"input_ids": tok}
+                if has_pos:
+                    sb["position_ids"] = jnp.full((b, 1), cur - 1,
+                                                  dtype=jnp.int32)
+                row, cache = ex.kv_decode_step(params, state, sb, cache,
+                                               cur - 1)
+                key, nxt, done = self._sample_next(row, key, temperature,
+                                                   eos_token_id, done)
+                ids = jax.lax.dynamic_update_slice_in_dim(
+                    ids, nxt[:, None], cur, axis=1)
+                return (ids, cache, key, done), nxt
+
+            (ids, _, _, _), _ = jax.lax.scan(
+                step, (ids0, cache, key0, done0),
+                jnp.arange(max_new_tokens))
+            return ids
+
+        cache_d = self.executor.__dict__.setdefault("_decode_cache", {})
+        ck = ("kv", b, L, max_new_tokens, float(temperature),
+              eos_token_id)
+        fn = cache_d.get(ck)
+        if fn is None:
+            fn = cache_d[ck] = jax.jit(decode)
+        return fn(self.params, self.state, ids0, jax.random.key(seed),
+                  jnp.int32(prompt_len))
+
+    def _sample_next(self, row, key, temperature, eos_token_id, done):
+        """Shared sampling step: ``row`` is (B, V) log-domain scores
+        (pre-softmax logits when the graph exposes them)."""
+        if temperature > 0.0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, row / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(row, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        if eos_token_id is not None:
+            eos = jnp.int32(eos_token_id)
+            nxt = jnp.where(done, eos, nxt)
+            done = jnp.logical_or(done, nxt == eos)
+        return key, nxt, done
+
+    def _generate_reforward(self, ids0, prompt_len, max_new_tokens,
+                            temperature, seed, eos_token_id, fixed):
+        """Exact oracle path: full forward per step; the causal mask
+        guarantees positions < t ignore columns >= t."""
+        ex = self.executor
+        b, L = ids0.shape
+
         def decode(params, state, ids0, key0, fixed, plen):
             done0 = jnp.zeros((b,), jnp.bool_)
 
             def step(carry, i):
                 ids, key, done = carry
-                out = fwd(params, state, {"input_ids": ids, **fixed})
-                probs = out[0] if isinstance(out, (list, tuple)) else out
+                scores = ex.scored_forward(params, state,
+                                           {"input_ids": ids, **fixed})
                 cur = plen + i                # index being generated
-                row = jax.lax.dynamic_slice_in_dim(probs, cur - 1, 1,
+                row = jax.lax.dynamic_slice_in_dim(scores, cur - 1, 1,
                                                    axis=1)[:, 0, :]
-                if temperature > 0.0:
-                    key, sub = jax.random.split(key)
-                    logp = jnp.log(jnp.clip(row, 1e-20)) / temperature
-                    nxt = jax.random.categorical(sub, logp, axis=-1)
-                else:
-                    nxt = jnp.argmax(row, axis=-1)
-                nxt = nxt.astype(jnp.int32)
-                if eos_token_id is not None:
-                    eos = jnp.int32(eos_token_id)
-                    nxt = jnp.where(done, eos, nxt)
-                    done = jnp.logical_or(done, nxt == eos)
+                key, nxt, done = self._sample_next(row, key, temperature,
+                                                   eos_token_id, done)
                 ids = jax.lax.dynamic_update_slice_in_dim(
                     ids, nxt[:, None], cur, axis=1)
                 return (ids, key, done), nxt
@@ -758,11 +868,12 @@ class FFModel:
                 step, (ids0, key0, done0), jnp.arange(max_new_tokens))
             return ids
 
-        # jit cached per (shape, steps, temperature, eos); prompt_len is
-        # a TRACED argument so serving traffic with varying prompt
-        # lengths reuses one compiled program per shape, not per length
+        # jit cached per (shape, steps, temperature, eos, fixed-input
+        # set); prompt_len is a TRACED argument so serving traffic with
+        # varying prompt lengths reuses one compiled program per shape
         cache = self.executor.__dict__.setdefault("_decode_cache", {})
-        ck = (b, L, max_new_tokens, float(temperature), eos_token_id)
+        ck = ("fwd", b, L, max_new_tokens, float(temperature),
+              eos_token_id, tuple(sorted(fixed)))
         fn = cache.get(ck)
         if fn is None:
             fn = cache[ck] = jax.jit(decode)

@@ -450,6 +450,15 @@ class MultiHeadAttentionOp(OpDef):
         rate = params.get("dropout", 0.0) if ctx.training else 0.0
 
         causal = params.get("causal", False)
+        kv_mode = getattr(ctx, "kv_mode", None)
+        if kv_mode == "prefill":
+            # record per-position K/V for incremental decode; padded
+            # positions hold garbage but every one is rewritten by the
+            # decode step that first unmasks it
+            ctx.new_kv[name] = {"k": kh, "v": vh}
+        elif kv_mode == "decode":
+            return self._emit_decode(params, weights, ctx, name, qh, kh,
+                                     vh, mdt, cdt)
         flash_mode = self._flash_mode(ctx)
         if self._flash_enabled(ctx, seq_len=max(qh.shape[1], kh.shape[1])) \
                 and not (causal and qh.shape[1] != kh.shape[1]):
@@ -499,6 +508,40 @@ class MultiHeadAttentionOp(OpDef):
                               probs / keep, 0.0)
         ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(mdt),
                           vh.astype(mdt),
+                          preferred_element_type=jnp.float32)
+        out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(mdt),
+                         weights["wo"].astype(mdt),
+                         preferred_element_type=jnp.float32)
+        if "bo" in weights:
+            out = out + weights["bo"].astype(jnp.float32)
+        return [out.astype(cdt)]
+
+    def _emit_decode(self, params, weights, ctx, name, qh, kh, vh, mdt,
+                     cdt):
+        """Single-token decode against the KV cache: write this
+        position's K/V into the cache, attend the length-1 query over
+        positions <= kv_index. Exactly matches the full re-forward's row
+        at kv_index (same mask, same softmax domain) — the re-forward
+        path is the numerics oracle in tests/test_generate_kv.py."""
+        assert params.get("causal", False), \
+            "KV-cache decode requires causal self-attention"
+        cache = ctx.kv_cache[name]
+        idx = ctx.kv_index
+        k_full = jax.lax.dynamic_update_slice_in_dim(cache["k"], kh, idx,
+                                                     axis=1)
+        v_full = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh, idx,
+                                                     axis=1)
+        ctx.new_kv[name] = {"k": k_full, "v": v_full}
+        scale = 1.0 / math.sqrt(qh.shape[-1])
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(mdt),
+                            k_full.astype(mdt),
+                            preferred_element_type=jnp.float32) * scale
+        lk = k_full.shape[1]
+        mask = jnp.arange(lk)[None, None, None, :] <= idx
+        logits = jnp.where(mask, logits, jnp.float32(-1e9))
+        probs = jax.nn.softmax(logits, axis=-1)
+        ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(mdt),
+                          v_full.astype(mdt),
                           preferred_element_type=jnp.float32)
         out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(mdt),
                          weights["wo"].astype(mdt),

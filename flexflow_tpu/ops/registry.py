@@ -35,6 +35,16 @@ class EmitCtx:
         self.config = config
         self.seq_length = seq_length
         self.aux_losses: List[Any] = []  # e.g. MoE load-balancing terms
+        # KV-cache decode plumbing (serving; the reference has no
+        # generation path at all). kv_mode: None = normal forward,
+        # "prefill" = full-sequence forward that also records each
+        # attention layer's per-position K/V into new_kv, "decode" =
+        # single-token forward reading kv_cache and writing the updated
+        # buffers to new_kv. kv_index = the (traced) query position.
+        self.kv_mode: Optional[str] = None
+        self.kv_cache: Optional[Dict[str, Any]] = None
+        self.kv_index: Any = None
+        self.new_kv: Dict[str, Any] = {}
 
     def rng_for(self, name: str):
         return self.rngs.get(name)

@@ -20,6 +20,11 @@ from ..ffconst import LossType, MetricsType
 # never average (see Executor.make_train_step)
 COUNT_KEYS = frozenset({"accuracy_correct"})
 
+# keys that are sqrt-of-a-mean: composing across micro-batches must
+# average the SQUARES and take one sqrt at the end (mean of per-micro
+# sqrts is not the full-batch RMSE)
+RMS_KEYS = frozenset({"rmse_loss"})
+
 
 @dataclasses.dataclass
 class PerfMetrics:

@@ -45,7 +45,7 @@ def optimize_strategy(ff):
         cost_model.measure_on_device = True
     t0 = time.perf_counter()
     if cfg.search_algo == "unity":
-        return _unity(ff, cost_model, t0)
+        return _apply_floor_guard(ff, _unity(ff, cost_model, t0))
     budget = cfg.search_budget if cfg.search_budget > 0 else 500
     best, best_cost, sim = mcmc_search(
         ff.layers, dmesh, cost_model, budget=budget,
@@ -64,7 +64,145 @@ def optimize_strategy(ff):
     if cfg.export_strategy_file:
         save_strategy(cfg.export_strategy_file, strategy, best,
                       {"best_cost": best_cost, "dp_cost": dp_cost})
-    return _maybe_pipeline(ff, cost_model, best_cost, (strategy, None))
+    return _apply_floor_guard(
+        ff, _maybe_pipeline(ff, cost_model, best_cost, (strategy, None)))
+
+
+def _synth_batch(ff):
+    """Random batch matching the graph inputs + label. Int tensors get
+    tiny non-negative ids (valid for any embedding), labels get class 0
+    (valid for any loss); values only need to execute, not converge."""
+    import numpy as np
+    from ..ffconst import DataType
+    rng = np.random.default_rng(ff.config.seed)
+    batch = {}
+    for t in ff.graph_inputs:
+        if t.dtype in (DataType.DT_INT32, DataType.DT_INT64):
+            batch[t.name] = rng.integers(0, 2, size=t.shape).astype(np.int32)
+        elif t.dtype == DataType.DT_BOOLEAN:
+            batch[t.name] = np.ones(t.shape, dtype=bool)
+        else:
+            batch[t.name] = rng.normal(size=t.shape).astype(np.float32)
+    lt = getattr(ff, "label_tensor", None)
+    if lt is not None:
+        if lt.dtype in (DataType.DT_INT32, DataType.DT_INT64):
+            batch["label"] = np.zeros(lt.shape, dtype=np.int32)
+        else:
+            batch["label"] = np.zeros(lt.shape, dtype=np.float32)
+    else:
+        # no explicit label tensor: derive from the output + loss type
+        # (same contract the loss fn applies at step time)
+        from ..ffconst import LossType
+        oshape = ff._output_tensor.shape
+        if ff.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+            batch["label"] = np.zeros(oshape[:-1] + (1,), dtype=np.int32)
+        else:
+            batch["label"] = np.zeros(oshape, dtype=np.float32)
+    return batch
+
+
+def _time_strategy(ff, strategy, info):
+    """Compile + time `floor_guard_steps` train steps of one strategy.
+    Returns (seconds/step, executor): the executor carries the compiled
+    jitted step, so FFModel.compile can adopt it instead of re-jitting
+    the winning program from scratch. The device->host fetch is the
+    sync point (block_until_ready does not synchronize on tunneled
+    backends)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..executor import Executor, GraphProgram
+    cfg = ff.config
+    steps = max(1, cfg.floor_guard_steps)
+    layers, outputs = ff.layers, [ff._output_tensor]
+    if info is not None:
+        layers, outputs = info.layers, info.output_tensors
+    dmesh = strategy.dmesh if strategy.dmesh is not None else ff.dmesh
+    program = GraphProgram(
+        layers, ff.graph_inputs + getattr(ff, "const_inputs", []), outputs)
+    ex = Executor(program, cfg, dmesh, strategy, ff.optimizer,
+                  ff.loss_type, getattr(ff, "metrics", []), seed=cfg.seed)
+    params, state = ex.init_params_and_state()
+    opt_state = ff.optimizer.init_state(params)
+    batch = _synth_batch(ff)
+    step = ex.make_train_step()
+    p, o, s, bm = step(params, opt_state, state, jnp.int32(0), batch)
+    float(np.asarray(bm["loss"]))  # compile + sync
+    t0 = time.perf_counter()
+    for i in range(steps):
+        p, o, s, bm = step(p, o, s, jnp.int32(i + 1), batch)
+    float(np.asarray(bm["loss"]))
+    return (time.perf_counter() - t0) / steps, ex
+
+
+def _apply_floor_guard(ff, result):
+    """Measured DP-floor on search adoption: time a few real steps of the
+    searched program AND plain data parallel; keep DP when the searched
+    program measures slower. The reference adopts searched strategies on
+    the strength of its per-op-calibrated simulator
+    (src/runtime/simulator.cc:537); here the floor is enforced by direct
+    measurement so a mispredicting cost model can never ship a strategy
+    that loses to the DP baseline. Records both numbers in
+    ``ff._floor_guard_record`` and in the strategy export."""
+    cfg = ff.config
+    mode = str(cfg.search_floor_guard or "auto").lower()
+    if mode in ("false", "off", "0", "no"):
+        return result
+    import jax
+    if mode == "auto" and jax.devices()[0].platform == "cpu":
+        return result  # CPU sim: double-compile too costly by default
+    if jax.process_count() > 1:
+        return result  # multi-controller feeding needs per-process arrays
+    strategy, info = result
+    dp = ShardingStrategy.data_parallel(ff.layers, ff.graph_inputs,
+                                        ff.dmesh)
+    try:
+        t_s, ex_s = _time_strategy(ff, strategy, info)
+        t_dp, ex_dp = _time_strategy(ff, dp, None)
+    except Exception as e:  # noqa: BLE001 — guard must never kill compile
+        if cfg.profiling:
+            print(f"floor guard skipped ({e!r})")
+        return result
+    adopted = "searched" if t_s <= t_dp else "dp"
+    record = {"searched_s_per_step": t_s, "dp_s_per_step": t_dp,
+              "adopted": adopted}
+    ff._floor_guard_record = record
+    # hand the winning side's compiled executor to FFModel.compile so
+    # the adopted program is not re-jitted a third time (params are
+    # re-initialized there — the guard's few synthetic steps must not
+    # leak into training)
+    ff._prebuilt_executor = (strategy, ex_s) if adopted == "searched" \
+        else (dp, ex_dp)
+    if adopted == "dp":
+        print(f"[flexflow_tpu] searched strategy measured "
+              f"{t_s * 1e3:.2f} ms/step vs data-parallel "
+              f"{t_dp * 1e3:.2f} ms/step — keeping data parallel "
+              f"(measured DP floor)")
+        if cfg.export_strategy_file:
+            # the export must describe the ADOPTED strategy: a later
+            # --import of this file bypasses search AND guard entirely,
+            # so leaving the rejected searched strategy in it would
+            # deploy exactly what the guard measured as losing
+            save_strategy(cfg.export_strategy_file, dp, None,
+                          {"floor_guard": record})
+        result = (dp, None)
+    else:
+        if cfg.export_strategy_file:
+            _annotate_export(cfg.export_strategy_file, record)
+        if cfg.profiling:
+            print(f"floor guard: searched {t_s * 1e3:.2f} ms/step <= DP "
+                  f"{t_dp * 1e3:.2f} ms/step — adopting searched")
+    return result
+
+
+def _annotate_export(path: str, record) -> None:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        doc["floor_guard"] = record
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+    except Exception:  # noqa: BLE001 — export annotation is best-effort
+        pass
 
 
 def _maybe_pipeline(ff, cost_model, searched_cost, searched_result):

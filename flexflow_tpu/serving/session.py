@@ -78,11 +78,14 @@ class InferenceSession:
         cap = self.buckets[-1]
         if n > cap:
             # per-chunk seed: identical prompts in different chunks must
-            # not draw identical sampling streams
+            # not draw identical sampling streams. Wide-stride fold so a
+            # separate request using seed+1 does not collide with chunk 1
+            # of this request (the streams only meet after ~2^31 seeds).
             return np.concatenate(
                 [self.generate(ids[i:i + cap], prompt_len,
                                max_new_tokens, temperature,
-                               seed + i // cap, eos_token_id)
+                               (seed + (i // cap) * 0x9E3779B1)
+                               & 0x7FFFFFFF, eos_token_id)
                  for i in range(0, n, cap)], axis=0)
         bucket = _next_bucket(n, self.buckets)
         if bucket != n:

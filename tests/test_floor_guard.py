@@ -1,0 +1,148 @@
+"""Measured DP-floor guard on search adoption (search/optimizer.py).
+
+The round-2 A/B showed searched strategies losing to DP on 4 of 9
+workloads because the CPU-sim cost model mispredicts collectives. The
+guard times a few real steps of both programs and keeps DP when the
+searched one measures slower — prediction proposes, measurement decides.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.search import optimizer as opt_mod
+
+
+def _searched_model(floor_guard="true", budget=4):
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.only_data_parallel = False
+    cfg.search_budget = budget
+    cfg.search_floor_guard = floor_guard
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 64), name="x")
+    t = ff.dense(x, 256, ActiMode.AC_MODE_RELU, name="fc0")
+    t = ff.dense(t, 256, ActiMode.AC_MODE_RELU, name="fc1")
+    out = ff.dense(t, 10, name="out")
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return ff
+
+
+def test_guard_keeps_dp_when_searched_measures_slower(monkeypatch):
+    """Force the measured times: searched 'loses' -> DP must be adopted
+    and the executable program must be the unrewritten graph."""
+    times = {"calls": 0}
+
+    def fake_time(ff, strategy, info):
+        times["calls"] += 1
+        # first call times the searched strategy, second times DP
+        return (1.0 if times["calls"] == 1 else 0.5), None
+
+    monkeypatch.setattr(opt_mod, "_time_strategy", fake_time)
+    ff = _searched_model(floor_guard="true")
+    assert times["calls"] == 2
+    rec = ff._floor_guard_record
+    assert rec["adopted"] == "dp"
+    assert rec["searched_s_per_step"] == 1.0
+    assert rec["dp_s_per_step"] == 0.5
+    # adopted strategy is plain DP: every op sharded only over batch axis
+    errs = ff.strategy.validate()
+    assert not errs
+    # the step still trains
+    rng = np.random.default_rng(0)
+    b = {"x": rng.normal(size=(8, 64)).astype(np.float32),
+         "label": rng.integers(0, 10, size=(8, 1)).astype(np.int32)}
+    step = ff.executor.make_train_step()
+    bm = ff._run_train_step(step, b)
+    assert np.isfinite(float(np.asarray(bm["loss"])))
+
+
+def test_guard_adopts_searched_when_it_wins(monkeypatch):
+    times = {"calls": 0}
+
+    def fake_time(ff, strategy, info):
+        times["calls"] += 1
+        return (0.5 if times["calls"] == 1 else 1.0), None
+
+    monkeypatch.setattr(opt_mod, "_time_strategy", fake_time)
+    ff = _searched_model(floor_guard="true")
+    assert ff._floor_guard_record["adopted"] == "searched"
+
+
+def test_guard_off_by_default_on_cpu():
+    """auto mode: CPU simulator runs skip the double-compile."""
+    ff = _searched_model(floor_guard="auto")
+    assert not hasattr(ff, "_floor_guard_record")
+
+
+def test_guard_real_timing_path():
+    """No monkeypatch: the guard actually compiles and times both
+    programs on the 8-virtual-device CPU mesh."""
+    ff = _searched_model(floor_guard="true", budget=2)
+    rec = ff._floor_guard_record
+    assert rec["searched_s_per_step"] > 0
+    assert rec["dp_s_per_step"] > 0
+    assert rec["adopted"] in ("searched", "dp")
+
+
+def test_guard_export_annotation(tmp_path, monkeypatch):
+    def fake_time(ff, strategy, info):
+        return 0.5, None
+
+    monkeypatch.setattr(opt_mod, "_time_strategy", fake_time)
+    path = str(tmp_path / "strategy.json")
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.only_data_parallel = False
+    cfg.search_budget = 2
+    cfg.search_floor_guard = "true"
+    cfg.export_strategy_file = path
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 64), name="x")
+    out = ff.dense(x, 10, name="out")
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    import json
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["floor_guard"]["adopted"] == "searched"
+
+
+def test_guard_export_rewritten_on_rejection(tmp_path, monkeypatch):
+    """A rejected searched strategy must NOT survive in the export file:
+    --import bypasses search and guard, so the file must describe the
+    ADOPTED (DP) strategy."""
+    calls = {"n": 0}
+
+    def fake_time(ff, strategy, info):
+        calls["n"] += 1
+        return (1.0 if calls["n"] == 1 else 0.5), None
+
+    monkeypatch.setattr(opt_mod, "_time_strategy", fake_time)
+    path = str(tmp_path / "strategy.json")
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.only_data_parallel = False
+    cfg.search_budget = 2
+    cfg.search_floor_guard = "true"
+    cfg.export_strategy_file = path
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 64), name="x")
+    out = ff.dense(x, 10, name="out")
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    import json
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["meta"]["floor_guard"]["adopted"] == "dp"
+    assert not doc.get("program")  # DP carries no rewritten program
+    # round-trip: importing the exported file yields a valid strategy
+    cfg2 = FFConfig()
+    cfg2.batch_size = 8
+    cfg2.import_strategy_file = path
+    ff2 = FFModel(cfg2)
+    x2 = ff2.create_tensor((8, 64), name="x")
+    out2 = ff2.dense(x2, 10, name="out")
+    ff2.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+                output_tensor=out2)
+    assert not ff2.strategy.validate()

@@ -92,12 +92,14 @@ def main():
     cand = best_pipeline(ff.layers, dmesh, cost_model)
     if cand is not None:
         print(f"pipeline candidate: S={cand.n_stages} M="
-              f"{cand.n_microbatches} v={cand.n_chunks} "
+              f"{cand.n_microbatches} v={cand.n_chunks} tp={cand.tp} "
               f"dp={cand.dp_size} cost {cand.cost * 1e3:.3f} ms",
               flush=True)
         if cand.cost < best["cost"]:
             kind = (f"pipeline_dp{cand.dp_size}xpp{cand.n_stages}"
                     f"_m{cand.n_microbatches}")
+            if cand.tp > 1:
+                kind += f"_tp{cand.tp}"
             if cand.n_chunks > 1:
                 kind += f"_interleaved{cand.n_chunks}"
             best = {"kind": kind, "cost": cand.cost}

@@ -76,6 +76,10 @@ class FFConfig:
     # interleaved (circular) schedule: chunks per stage (1 = plain GPipe;
     # v > 1 cuts the pipeline bubble to (S-1)/(M*v))
     pipeline_chunks: int = 1
+    # Megatron-style tensor parallelism INSIDE each pipeline stage
+    # (dp x pp x tp composition; the reference composes per-op machine
+    # views the same way, substitution.cc:1898)
+    pipeline_tp: int = 1
     # ZeRO-1: shard optimizer moments over the replicated mesh axes
     # (runtime/zero.py); the reference keeps full state per replica
     shard_optimizer_states: bool = False
@@ -246,6 +250,8 @@ class FFConfig:
                 cfg.pipeline_microbatches = int(take())
             elif a in ("--pipeline-chunks", "--interleave"):
                 cfg.pipeline_chunks = int(take())
+            elif a in ("--pp-tp", "--pipeline-tp"):
+                cfg.pipeline_tp = int(take())
             elif a in ("--zero", "--shard-optimizer-states"):
                 cfg.shard_optimizer_states = True
             elif a == "--remat":

@@ -127,6 +127,17 @@ def _find_remat_blocks(layers):
     return start, unit, reps, entries, exits
 
 
+# Megatron tp split of stacked stage weights: role -> weight name ->
+# dim index (within the weight's own shape) sharded over tp_axis.
+# None = replicated (biases applied once, after the psum).
+_TP_WEIGHT_DIMS = {
+    "attn": {"wq": 1, "wk": 1, "wv": 1, "bq": 0, "bk": 0, "bv": 0,
+             "wo": 0, "bo": None},
+    "col": {"kernel": 1, "bias": 0},
+    "row": {"kernel": 0, "bias": None},
+}
+
+
 class Executor:
     def __init__(self, program: GraphProgram, config, dmesh: DeviceMesh,
                  strategy: ShardingStrategy, optimizer: Optimizer,
@@ -249,6 +260,8 @@ class Executor:
             layer.weights = specs
             if not specs:
                 continue
+            role = pipe.tp_roles.get(layer.name) \
+                if pipe.tp_axis is not None else None
             lp = {}
             for wi, spec in enumerate(specs):
                 slices = []
@@ -257,17 +270,20 @@ class Executor:
                         jax.random.fold_in(rng, 7000 + lj), wi), c)
                     slices.append(initialize(spec, k, to_jnp(spec.dtype)))
                 stacked = jnp.stack(slices)
+                wdims = [None] * len(spec.shape)
+                if role is not None:
+                    d = _TP_WEIGHT_DIMS[role].get(spec.name)
+                    if d is not None:
+                        wdims[d] = pipe.tp_axis
                 if v > 1:
                     # [k, s] = chunk s + k*S: stack order is chunk-major,
                     # so the (v, S) reshape lands chunk c at [c//S, c%S]
                     stacked = stacked.reshape((v, S) + tuple(spec.shape))
-                    sh = NamedSharding(
-                        self.dmesh.mesh,
-                        P(None, pipe.pp_axis, *([None] * len(spec.shape))))
+                    sh = NamedSharding(self.dmesh.mesh,
+                                       P(None, pipe.pp_axis, *wdims))
                 else:
-                    sh = NamedSharding(
-                        self.dmesh.mesh,
-                        P(pipe.pp_axis, *([None] * len(spec.shape))))
+                    sh = NamedSharding(self.dmesh.mesh,
+                                       P(pipe.pp_axis, *wdims))
                 lp[spec.name] = jax.device_put(stacked, sh)
             out[pipe.param_name(layer)] = lp
         return out
@@ -278,6 +294,8 @@ class Executor:
         pipe = self.pipe
         template = pipe.template
 
+        tp_ax = pipe.tp_axis
+
         def stage_fn(p, x, t):
             rng_base = p.get("__rng__")
             env = {pipe.template_entry_guid: x}
@@ -285,12 +303,38 @@ class Executor:
                           config=self.config)
             for j, layer in enumerate(template):
                 if training and rng_base is not None and _needs_rng(layer):
-                    ctx.rngs[layer.name] = jax.random.fold_in(
+                    key = jax.random.fold_in(
                         jax.random.fold_in(rng_base, t), j)
+                    if tp_ax is not None and \
+                            pipe.tp_roles.get(layer.name) == "attn":
+                        # attention-prob dropout acts on tp-SHARDED
+                        # heads: each shard must draw an independent
+                        # mask. Role-less layers (residual dropout) see
+                        # tp-REPLICATED activations and must keep the
+                        # same key on every shard, or the replication
+                        # invariant between psum points breaks.
+                        key = jax.random.fold_in(
+                            key, jax.lax.axis_index(tp_ax))
+                    ctx.rngs[layer.name] = key
                 op = get_op_def(layer.op_type)
                 ins = [env[tt.guid] for tt in layer.inputs]
                 w = p.get(pipe.param_name(layer), {})
-                outs = op.emit(layer.params, ins, w, ctx, layer.name)
+                role = pipe.tp_roles.get(layer.name) \
+                    if tp_ax is not None else None
+                if role in ("attn", "row"):
+                    # Megatron reduction point: emit with the bias held
+                    # back (the local matmul yields a PARTIAL sum over
+                    # the tp-split contraction dim), one psum over tp,
+                    # then the bias applied exactly once
+                    w = dict(w)
+                    bias = w.pop("bo" if role == "attn" else "bias", None)
+                    outs = op.emit(layer.params, ins, w, ctx, layer.name)
+                    y = jax.lax.psum(outs[0], tp_ax)
+                    if bias is not None:
+                        y = (y + bias).astype(outs[0].dtype)
+                    outs = [y]
+                else:
+                    outs = op.emit(layer.params, ins, w, ctx, layer.name)
                 for o, tt in zip(outs, layer.outputs):
                     env[tt.guid] = o
             return env[pipe.template_exit_guid]
@@ -320,9 +364,24 @@ class Executor:
         engine = gpipe(self._make_stage_fn(training), pipe.pp_axis, M,
                        with_step_arg=True, n_chunks=v)
         pp_lead = (pipe.pp_axis,) if v == 1 else (None, pipe.pp_axis)
-        param_specs = jax.tree.map(
-            lambda a: P(*pp_lead, *([None] * (a.ndim - len(pp_lead)))),
-            stacked)
+
+        def weight_spec(lname, wname, arr):
+            dims = [None] * (arr.ndim - len(pp_lead))
+            role = pipe.tp_roles.get(lname) \
+                if pipe.tp_axis is not None else None
+            if role is not None:
+                d = _TP_WEIGHT_DIMS[role].get(wname)
+                if d is not None:
+                    dims[d] = pipe.tp_axis
+            return P(*pp_lead, *dims)
+
+        param_specs = {
+            pipe.param_name(l): {
+                wname: weight_spec(l.name, wname, arr)
+                for wname, arr in stacked[pipe.param_name(l)].items()}
+            for l in pipe.template if pipe.param_name(l) in stacked}
+        if "__rng__" in stacked:
+            param_specs["__rng__"] = P(*pp_lead)
         dp = pipe.dp_axes if pipe.dp_axes else None
         dp = dp[0] if dp is not None and len(dp) == 1 else dp
         xs_spec = P(None, dp, *([None] * (xs.ndim - 2)))

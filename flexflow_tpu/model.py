@@ -516,12 +516,15 @@ class FFModel:
             spec = MachineSpec.detect()
         mesh_shape = self.config.mesh_shape
         pp = self.config.pipeline_stages
+        pp_tp = max(self.config.pipeline_tp, 1)
         if strategy is None and pp > 1 and mesh_shape is None:
-            # dp × pp mesh: last axis carries the pipeline stages
+            # dp × pp (× tp) mesh: middle axis carries the pipeline
+            # stages, trailing axis the stage-internal tensor split
             nd = spec.num_devices
-            assert nd % pp == 0, \
-                f"--pp {pp} does not divide {nd} devices"
-            mesh_shape = (nd // pp, pp) if nd > pp else (pp,)
+            assert nd % (pp * pp_tp) == 0, \
+                f"--pp {pp} x --pp-tp {pp_tp} does not divide {nd} devices"
+            mesh_shape = tuple(
+                d for d in (nd // (pp * pp_tp), pp, pp_tp) if d > 1)
         self.dmesh = DeviceMesh(spec, mesh_shape=mesh_shape)
         if search_budget is not None:
             self.config.search_budget = search_budget
@@ -529,12 +532,28 @@ class FFModel:
         exec_layers, exec_outputs = self.layers, [self._output_tensor]
         if strategy is None and pp > 1:
             # pipeline through the product path (reference reserves
-            # OP_PIPELINE, ffconst.h:159, without implementing it)
+            # OP_PIPELINE, ffconst.h:159, without implementing it);
+            # axes resolved by position to keep dp/pp/tp unambiguous
+            # when sizes coincide
             from .parallel.presets import pipeline_strategy
+            kw = {}
+            if self.config.mesh_shape is None:
+                # we built the mesh as (dp, pp, tp) above — bind axes by
+                # position (size-matching is ambiguous when sizes tie);
+                # an explicit --mesh-shape keeps the size-match default
+                nd = self.dmesh.num_devices
+                sizes = (nd // (pp * pp_tp), pp, pp_tp)
+                roles = [r for r, d in zip(("dp", "pp", "tp"), sizes)
+                         if d > 1]
+                by_role = dict(zip(roles, self.dmesh.axis_names))
+                kw = dict(pp_axis=by_role["pp"],
+                          tp_axis=by_role.get("tp"),
+                          dp_axes=(by_role["dp"],) if "dp" in by_role
+                          else ())
             strategy = pipeline_strategy(
                 self.layers, self.graph_inputs, self.dmesh, n_stages=pp,
                 n_microbatches=self.config.pipeline_microbatches,
-                n_chunks=self.config.pipeline_chunks)
+                n_chunks=self.config.pipeline_chunks, tp=pp_tp, **kw)
         if strategy is not None:
             self.strategy = strategy
         else:

@@ -29,7 +29,8 @@ import numpy as np
 from ..core.layer import Layer
 from ..ffconst import OperatorType
 
-__all__ = ["PipelineRegion", "find_pipeline_region", "layer_signature"]
+__all__ = ["PipelineRegion", "assign_tp_roles", "find_pipeline_region",
+           "layer_signature"]
 
 
 def layer_signature(layer: Layer) -> Tuple:
@@ -65,6 +66,14 @@ class PipelineRegion:
     # mesh binding, filled in by parallel.presets.pipeline_strategy
     pp_axis: Optional[str] = None
     dp_axes: Tuple[str, ...] = ()
+    # tensor parallelism INSIDE each stage (Megatron-style, composed with
+    # dp x pp — the reference composes per-op machine views the same way,
+    # substitution.cc:1898): template layer name -> "attn" | "col" | "row".
+    # "attn": heads sharded over tp_axis, one psum after the out-proj;
+    # "col"/"row": paired Linears (col shards the output dim, row shards
+    # the input dim, one psum after row). None when tp is off.
+    tp_axis: Optional[str] = None
+    tp_roles: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     @property
     def template_exit_guid(self) -> int:
@@ -188,6 +197,50 @@ def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
         stage_layer_names=[
             [l.name for l in region[c * per_chunk:(c + 1) * per_chunk]]
             for c in range(n_parts)])
+
+
+def assign_tp_roles(template: Sequence[Layer], tp: int
+                    ) -> Dict[str, str]:
+    """Megatron-style tensor-parallel roles for a stage template:
+
+    - every causal/bidirectional OP_MULTIHEAD_ATTENTION whose head count
+      divides by ``tp`` -> "attn" (wq/wk/wv column-split over heads,
+      wo row-split, one psum after the output projection);
+    - every Linear pair d1 -> d2 where d2 consumes ONLY d1's output,
+      d1's output feeds ONLY d2, d2 has no activation, and the shared
+      hidden dim (d1's out_dim = d2's contraction dim) divides by
+      ``tp`` -> d1 "col", d2 "row" (one psum after d2).
+
+    Returns {} when the template has no tp-able structure (the caller
+    treats tp > 1 as an error then). Layers without a role run fully
+    replicated over the tp axis — correct for elementwise/norm layers
+    whose activations are replicated between the psum points.
+    """
+    roles: Dict[str, str] = {}
+    consumers: Dict[int, List[Layer]] = {}
+    for l in template:
+        for t in l.inputs:
+            consumers.setdefault(t.guid, []).append(l)
+    from ..ffconst import ActiMode
+    for l in template:
+        if l.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
+            if l.params["num_heads"] % tp == 0:
+                roles[l.name] = "attn"
+        elif l.op_type == OperatorType.OP_LINEAR \
+                and l.name not in roles:
+            out = l.outputs[0]
+            cons = consumers.get(out.guid, [])
+            if len(cons) == 1 \
+                    and cons[0].op_type == OperatorType.OP_LINEAR \
+                    and cons[0].name not in roles:
+                d2 = cons[0]
+                d2_act = d2.params.get("activation", ActiMode.AC_MODE_NONE)
+                if (d2.inputs[0].guid == out.guid
+                        and d2_act == ActiMode.AC_MODE_NONE
+                        and l.params["out_dim"] % tp == 0):
+                    roles[l.name] = "col"
+                    roles[d2.name] = "row"
+    return roles
 
 
 def find_repeated_run(layers: Sequence[Layer], n_parts: int = 1

@@ -120,19 +120,25 @@ def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
                       n_stages: int, n_microbatches: int = 0,
                       pp_axis: Optional[str] = None,
                       dp_axes: Optional[Sequence[str]] = None,
-                      n_chunks: int = 1
+                      n_chunks: int = 1, tp: int = 1,
+                      tp_axis: Optional[str] = None
                       ) -> ShardingStrategy:
-    """dp×pp strategy through the product path: the maximal repeated-block
-    region (found by ``find_pipeline_region``) becomes ``n_stages`` GPipe
-    stages over the ``pp`` mesh axis; everything outside the region is
-    batch-sharded over the dp axes. Raises ValueError when the graph has
-    no pipelinable region or no mesh axis of size ``n_stages``.
+    """dp×pp(×tp) strategy through the product path: the maximal
+    repeated-block region (found by ``find_pipeline_region``) becomes
+    ``n_stages`` GPipe stages over the ``pp`` mesh axis; everything
+    outside the region is batch-sharded over the dp axes. With
+    ``tp > 1`` stage-internal attention/FFN layers are Megatron-split
+    over ``tp_axis`` (one psum per attention block + one per FFN pair,
+    executed as explicit collectives inside the GPipe shard_map).
+    Raises ValueError when the graph has no pipelinable region, no mesh
+    axis of size ``n_stages``, or (tp > 1) no tp-able stage structure.
 
     The reference only reserves the enum for this (``ffconst.h:159``);
-    here it composes with dp and is schedulable by the search
-    (``search.pipeline_score``). TP inside a pipelined region is not yet
-    expressed (stage-internal collectives inside shard_map)."""
-    from .pipeline_lowering import find_pipeline_region
+    here it composes with dp and tp (the analog of per-op machine-view
+    composition, ``substitution.cc:1898``) and is schedulable by the
+    search (``search.pipeline_score``)."""
+    from .pipeline_lowering import assign_tp_roles, find_pipeline_region
+    used: list = []
     if pp_axis is None:
         pp_axis = next((a for a, s in dmesh.axis_sizes.items()
                         if s == n_stages), None)
@@ -140,8 +146,18 @@ def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
             raise ValueError(
                 f"no mesh axis of size {n_stages} for pipeline stages "
                 f"(mesh {dict(dmesh.axis_sizes)}); pass --mesh-shape")
+    used.append(pp_axis)
+    if tp > 1 and tp_axis is None:
+        tp_axis = next((a for a, s in dmesh.axis_sizes.items()
+                        if s == tp and a not in used), None)
+        if tp_axis is None:
+            raise ValueError(
+                f"no free mesh axis of size {tp} for stage-internal "
+                f"tensor parallelism (mesh {dict(dmesh.axis_sizes)})")
+    if tp_axis is not None:
+        used.append(tp_axis)
     if dp_axes is None:
-        dp_axes = tuple(a for a in dmesh.axis_names if a != pp_axis)
+        dp_axes = tuple(a for a in dmesh.axis_names if a not in used)
     dp = _norm(dp_axes)
     dp_size = _size(dmesh, dp)
     region = find_pipeline_region(layers, n_stages, n_microbatches,
@@ -153,6 +169,15 @@ def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
             + (f" x {n_chunks} chunks" if n_chunks > 1 else ""))
     region.pp_axis = pp_axis
     region.dp_axes = tuple(dp_axes)
+    if tp > 1:
+        roles = assign_tp_roles(region.template, tp)
+        if not roles:
+            raise ValueError(
+                "tp > 1 requested but the stage template has no "
+                "Megatron-splittable structure (attention heads or "
+                "paired Linears divisible by tp)")
+        region.tp_axis = tp_axis
+        region.tp_roles = roles
     st = ShardingStrategy(dmesh)
     st.pipeline = region
     for t in input_tensors:

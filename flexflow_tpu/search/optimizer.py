@@ -226,15 +226,22 @@ def _maybe_pipeline(ff, cost_model, searched_cost, searched_result):
     from ..parallel.machine import DeviceMesh
     from ..parallel.presets import pipeline_strategy
     n = ff.dmesh.num_devices
-    shape = (n // cand.n_stages, cand.n_stages) if n > cand.n_stages \
-        else (cand.n_stages,)
-    dmesh2 = DeviceMesh(ff.dmesh.spec, mesh_shape=shape)
+    tp = max(cand.tp, 1)
+    sizes = (n // (cand.n_stages * tp), cand.n_stages, tp)
+    roles = [r for r, d in zip(("dp", "pp", "tp"), sizes) if d > 1]
+    dmesh2 = DeviceMesh(ff.dmesh.spec,
+                        mesh_shape=tuple(d for d in sizes if d > 1))
+    by_role = dict(zip(roles, dmesh2.axis_names))
     st = pipeline_strategy(ff.layers, ff.graph_inputs, dmesh2,
                            n_stages=cand.n_stages,
                            n_microbatches=cand.n_microbatches,
-                           n_chunks=cand.n_chunks)
+                           n_chunks=cand.n_chunks, tp=tp,
+                           pp_axis=by_role["pp"],
+                           tp_axis=by_role.get("tp"),
+                           dp_axes=(by_role["dp"],) if "dp" in by_role
+                           else ())
     if cfg.profiling:
-        print(f"pipeline candidate S={cand.n_stages} wins: "
+        print(f"pipeline candidate S={cand.n_stages} tp={tp} wins: "
               f"{cand.cost * 1e3:.3f} ms < {searched_cost * 1e3:.3f} ms")
     return st, None
 

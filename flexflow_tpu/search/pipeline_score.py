@@ -37,19 +37,24 @@ class PipelineCandidate:
     cost: float                  # estimated step time, seconds
     region: PipelineRegion
     n_chunks: int = 1            # interleaved (circular) chunks per stage
+    tp: int = 1                  # Megatron tp inside each stage
 
 
 def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
                    n_stages: int, n_devices: int,
                    n_microbatches: int = 0,
                    n_chunks: int = 1,
-                   region: Optional[PipelineRegion] = None
+                   region: Optional[PipelineRegion] = None,
+                   tp: int = 1
                    ) -> Optional[PipelineCandidate]:
     """Estimated train-step time for an S-stage GPipe split of the
-    graph's repeated-block region on ``n_devices`` (dp = n/S). None when
-    the graph has no S-divisible region. ``n_chunks = v > 1`` scores the
-    interleaved (circular) schedule: T = (M*v + S - 1) chunk steps, so
-    the bubble fraction drops from (S-1)/M to (S-1)/(M*v).
+    graph's repeated-block region on ``n_devices`` (dp = n/(S*tp)). None
+    when the graph has no S-divisible region. ``n_chunks = v > 1``
+    scores the interleaved (circular) schedule: T = (M*v + S - 1) chunk
+    steps, so the bubble fraction drops from (S-1)/M to (S-1)/(M*v).
+    ``tp > 1`` scores Megatron tp inside each stage: role-layer compute
+    divides by tp, plus one all-reduce of the microbatch activation per
+    psum point (one per attention, one per FFN pair).
 
     ``region`` (discovery depends only on (S, v), not M) lets sweeps
     reuse one O(n^2) ``find_pipeline_region`` across microbatch counts.
@@ -64,13 +69,24 @@ def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
                                      n_microbatches=n_microbatches)
     if region is None:
         return None
+    roles = {}
+    if tp > 1:
+        if n_devices % (n_stages * tp):
+            return None
+        from ..parallel.pipeline_lowering import assign_tp_roles
+        roles = assign_tp_roles(region.template, tp)
+        if not roles:
+            return None
     S, M, v = n_stages, region.n_microbatches, region.n_chunks
-    dp = max(n_devices // S, 1)
+    dp = max(n_devices // (S * tp), 1)
     batch_deg = {0: dp * M}
     t_stage = 0.0                # one CHUNK's per-microbatch time
     for l in region.template:
         cm = cost_model.op_cost(l, batch_deg)
-        t_stage += cm.forward_time + cm.backward_time
+        t = cm.forward_time + cm.backward_time
+        if l.name in roles:
+            t /= tp              # heads/columns split over the tp axis
+        t_stage += t
     # handoff: the boundary activation (one microbatch, dp-sharded)
     by_guid = {t.guid: t for l in layers for t in l.outputs}
     entry_t = by_guid.get(region.entry_guid)
@@ -79,6 +95,12 @@ def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
         return None  # microbatches don't divide the global batch
     act_bytes = (int(np.prod(entry_t.shape)) * itemsize(entry_t.dtype)
                  / max(dp * M, 1)) if entry_t is not None else 0.0
+    if roles:
+        # one psum of the microbatch activation per reduction point
+        # (fwd) and one in the backward transpose
+        n_psums = sum(1 for r in roles.values() if r in ("attn", "row"))
+        t_stage += 2 * n_psums * cost_model.xfer_cost(
+            act_bytes, "all_reduce", tp)
     t_handoff = act_bytes / spec.ici_bandwidth + spec.ici_latency_us * 1e-6
     t_region = (M * v + S - 1) * (t_stage + t_handoff)
     # outside layers at plain dp
@@ -92,19 +114,23 @@ def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
         w_bytes_out += cm.weights_memory
     # gradient sync over dp. Stage weights all-reduce over their own dp
     # group (disjoint groups run concurrently), so the region contributes
-    # ONE stage's weight bytes, not S stages'.
+    # ONE stage's weight bytes, not S stages' (tp-split layers hold 1/tp
+    # of their weights per device).
     from ..ops import get_op_def
     w_bytes_stage = 0.0
     for l in region.template:
         specs = l.weights or get_op_def(l.op_type).weights(
             l.params, [t.shape for t in l.inputs],
             [t.dtype for t in l.inputs])
-        w_bytes_stage += sum(int(np.prod(ws.shape)) * itemsize(ws.dtype)
-                             for ws in specs)
+        wb = sum(int(np.prod(ws.shape)) * itemsize(ws.dtype)
+                 for ws in specs)
+        if l.name in roles:
+            wb /= tp
+        w_bytes_stage += wb
     w_bytes_stage *= v           # a stage holds v chunks' weights
     t_sync = cost_model.weight_sync_cost(w_bytes_stage + w_bytes_out, dp)
     return PipelineCandidate(S, M, dp, t_region + t_out + t_sync, region,
-                             n_chunks=v)
+                             n_chunks=v, tp=tp)
 
 
 def best_pipeline(layers, dmesh: DeviceMesh,
@@ -126,10 +152,14 @@ def best_pipeline(layers, dmesh: DeviceMesh,
             region = find_pipeline_region(layers, S, 0, v)
             if region is None:
                 continue
-            for M in ms:
-                cand = score_pipeline(layers, dmesh.spec, cost_model,
-                                      S, n, M, v, region=region)
-                if cand is not None and (best is None
-                                         or cand.cost < best.cost):
-                    best = cand
+            for tp in (1, 2, 4, 8):
+                if (n // S) % tp:
+                    continue
+                for M in ms:
+                    cand = score_pipeline(layers, dmesh.spec, cost_model,
+                                          S, n, M, v, region=region,
+                                          tp=tp)
+                    if cand is not None and (best is None
+                                             or cand.cost < best.cost):
+                        best = cand
     return best

@@ -48,4 +48,4 @@ def test_best_pipeline_picks_a_divisor():
     cand = best_pipeline(layers, dmesh, OpCostModel(spec))
     assert cand is not None
     assert 8 % cand.n_stages == 0 and cand.n_stages > 1
-    assert cand.dp_size * cand.n_stages == 8
+    assert cand.dp_size * cand.n_stages * cand.tp == 8

@@ -1,0 +1,139 @@
+"""Tensor parallelism inside pipeline stages (dp x pp x tp composition,
+the analog of the reference's per-op machine-view composition,
+src/runtime/substitution.cc:1898).
+
+Stage-internal attention/FFN layers are Megatron-split over a third mesh
+axis with explicit psum points inside the GPipe shard_map; correctness
+is witnessed against the tp=1 pipeline (identical parameter init chain).
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models import GPTConfig, build_gpt2
+from flexflow_tpu.parallel.pipeline_lowering import assign_tp_roles
+
+BATCH, SEQ = 16, 16
+
+
+def _gpt2(pp, tp, mb=4, dropout=0.0):
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.pipeline_stages = pp
+    cfg.pipeline_microbatches = mb
+    cfg.pipeline_tp = tp
+    g = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                  num_heads=4, max_position=SEQ, dropout=dropout)
+    ff = FFModel(cfg)
+    out = build_gpt2(ff, BATCH, SEQ, g)
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return ff, g
+
+
+def _batch(g, rng):
+    ids = rng.integers(0, g.vocab_size, size=(BATCH, SEQ)).astype(np.int32)
+    return {"input_ids": ids,
+            "position_ids": np.tile(np.arange(SEQ, dtype=np.int32),
+                                    (BATCH, 1)),
+            "label": ids}
+
+
+def test_roles_on_gpt2_template():
+    ff, _ = _gpt2(pp=2, tp=2)
+    pipe = ff.executor.pipe
+    assert pipe.tp_axis is not None
+    roles = sorted(pipe.tp_roles.values())
+    # 2 blocks per stage: 2 attn + 2 col/row FFN pairs
+    assert roles == ["attn", "attn", "col", "col", "row", "row"]
+    assert dict(ff.dmesh.axis_sizes) == {"x0": 2, "x1": 2, "x2": 2}
+
+
+def test_tp_matches_tp1_forward():
+    """Same init chain, eval forward must agree (no optimizer drift)."""
+    ff_tp, g = _gpt2(pp=2, tp=2)
+    ff_ref, _ = _gpt2(pp=2, tp=1)
+    rng = np.random.default_rng(0)
+    b = _batch(g, rng)
+    ev_tp = ff_tp.executor.make_eval_step()
+    ev_ref = ff_ref.executor.make_eval_step()
+    out_tp, _ = ev_tp(ff_tp.params, ff_tp.state, b)
+    out_ref, _ = ev_ref(ff_ref.params, ff_ref.state, b)
+    np.testing.assert_allclose(np.asarray(out_tp), np.asarray(out_ref),
+                               atol=1e-5)
+
+
+def test_tp_training_matches_and_decreases():
+    ff_tp, g = _gpt2(pp=2, tp=2)
+    ff_ref, _ = _gpt2(pp=2, tp=1)
+    rng = np.random.default_rng(0)
+    b = _batch(g, rng)
+    st_tp = ff_tp.executor.make_train_step()
+    st_ref = ff_ref.executor.make_train_step()
+    lt, lr = [], []
+    for _ in range(4):
+        lt.append(float(np.asarray(
+            ff_tp._run_train_step(st_tp, b)["loss"])))
+        lr.append(float(np.asarray(
+            ff_ref._run_train_step(st_ref, b)["loss"])))
+    # step 0: identical math up to reduction order
+    assert abs(lt[0] - lr[0]) < 1e-5, (lt[0], lr[0])
+    # later steps: fp32 update drift compounds, trajectories stay close
+    for a, c in zip(lt[1:], lr[1:]):
+        assert abs(a - c) < 3e-3, (lt, lr)
+    assert lt[-1] < lt[0]
+
+
+def test_tp_with_dropout_and_interleave_trains():
+    """tp x interleaved schedule x in-stage dropout: masks are drawn
+    per (step, layer, tp-shard) and training still converges."""
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.pipeline_stages = 2
+    cfg.pipeline_microbatches = 4
+    cfg.pipeline_chunks = 2
+    cfg.pipeline_tp = 2
+    g = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                  num_heads=4, max_position=SEQ, dropout=0.1)
+    ff = FFModel(cfg)
+    out = build_gpt2(ff, BATCH, SEQ, g)
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    assert ff.executor.pipe.n_chunks == 2
+    assert ff.executor.pipe.tp_axis is not None
+    rng = np.random.default_rng(0)
+    b = _batch(g, rng)
+    step = ff.executor.make_train_step()
+    losses = [float(np.asarray(ff._run_train_step(step, b)["loss"]))
+              for _ in range(5)]
+    assert all(np.isfinite(x) for x in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_tp_requires_splittable_template():
+    """A graph with no attention/paired-dense structure must fail loudly
+    when tp is requested."""
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.pipeline_stages = 2
+    cfg.pipeline_tp = 2
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 64), name="x")
+    from flexflow_tpu import ActiMode
+    t = ff.dense(x, 32, ActiMode.AC_MODE_RELU, name="stem")
+    # identical single-dense blocks: pipelinable but NOT tp-pairable
+    # (each dense's output feeds a relu-activated dense, not a pure one)
+    for i in range(4):
+        t = ff.dense(t, 32, ActiMode.AC_MODE_RELU, name=f"d{i}")
+    out = ff.softmax(ff.dense(t, 4))
+    with pytest.raises(ValueError, match="tp > 1"):
+        ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+                   [], output_tensor=out)
+
+
+def test_assign_tp_roles_rejects_indivisible_heads():
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((4, 8, 32), name="x")
+    a = ff.multihead_attention(x, x, x, 32, 3)  # 3 heads: not / by 2
+    roles = assign_tp_roles([a.owner_layer], 2)
+    assert roles == {}

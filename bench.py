@@ -346,7 +346,7 @@ def main():
     # BERT-large searched-vs-DP on the v5e-32 pod description — the
     # BASELINE.md target metric; runs even when the chip is unavailable
     if remaining() > 150:
-        t = budget(300)
+        t = budget(420)
         if t is not None:
             # fresh output path per run: a stale file from a previous run
             # must never masquerade as this run's measurement

@@ -66,8 +66,15 @@ def run_example(name: str, build: Callable[[FFModel, FFConfig], object],
         dt = time.perf_counter() - t0
         sps = c.batch_size * steps / dt
         mode = "data-parallel" if c.only_data_parallel else "searched"
-        print(f"[{name}] {mode}: {sps:.1f} samples/s "
+        print(f"[{name}] {mode}: {sps:.4g} samples/s "
               f"(loss {loss_v:.4f}, {steps} steps in {dt:.2f}s)")
+        pred = getattr(ff, "_search_predicted", None)
+        if pred and not c.only_data_parallel:
+            ratio = pred["dp_cost_s"] / max(pred["searched_cost_s"], 1e-12)
+            print(f"[{name}] predicted searched-vs-dp: {ratio:.4f}x")
+        guard = getattr(ff, "_floor_guard_record", None)
+        if guard and not c.only_data_parallel:
+            print(f"[{name}] floor-guard adopted: {guard['adopted']}")
         assert np.isfinite(loss_v)
         return sps
 

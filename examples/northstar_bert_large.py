@@ -1,5 +1,11 @@
 """North-star demonstration (BASELINE.md): Unity-searched BERT-large on a
-v5e-32 pod slice vs pure data parallelism.
+v5e-32 pod slice vs pure data parallelism — THROUGH THE PRODUCT PATH.
+
+The winner comes from ``FFModel.compile`` with the same flags a user
+would pass::
+
+  --budget 8 --enable-pipeline-search --machine-model-version 1 \
+  --machine-model-file machine_configs/v5e-32.json
 
 The target machine is described by ``machine_configs/v5e-32.json`` (4x8
 ICI torus, 8 hosts) — the analog of the reference's
@@ -13,7 +19,7 @@ reference searches for N-GPU strategies from a simulator-equipped
 single process (``graph.cc:2046``).
 
 Usage:
-  python examples/northstar_bert_large.py [--budget 16] [--batch 256]
+  python examples/northstar_bert_large.py [--budget 8] [--batch 64]
       [--seq 512] [--out bench_results/northstar_v5e32_sim.json]
 """
 import argparse
@@ -29,22 +35,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer  # noqa: E402
 from flexflow_tpu.models import BertConfig, build_bert  # noqa: E402
-from flexflow_tpu.parallel.machine import DeviceMesh  # noqa: E402
-from flexflow_tpu.parallel.topology import load_machine_file  # noqa: E402
-from flexflow_tpu.search.costmodel import OpCostModel  # noqa: E402
-from flexflow_tpu.search.tasksim import TaskGraphEvaluator  # noqa: E402
-from flexflow_tpu.search.unity import (data_parallel_graph,  # noqa: E402
-                                       unity_search)
-from flexflow_tpu.pcg.graph import Graph  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--budget", type=int, default=16)
+    ap.add_argument("--budget", type=int, default=8)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--machine", default=os.path.join(
@@ -53,15 +52,22 @@ def main():
         REPO, "bench_results", "northstar_v5e32_sim.json"))
     a = ap.parse_args()
 
-    spec = load_machine_file(a.machine)
-    assert len(jax.devices()) >= spec.num_devices, \
-        f"need {spec.num_devices} virtual devices"
-    dmesh = DeviceMesh(spec, mesh_shape=spec.ici_shape)
-    print(f"machine: {spec.generation} x{spec.num_devices} "
-          f"ici={spec.ici_shape} hosts={spec.num_hosts}", flush=True)
+    # the EXACT product flag spelling (FFConfig.parse_args) — this run
+    # is the same code path as any user invocation
+    cfg = FFConfig.parse_args([
+        "--batch-size", str(a.batch),
+        "--budget", str(a.budget),
+        "--enable-pipeline-search",
+        "--machine-model-version", "1",
+        "--machine-model-file", a.machine,
+    ])
+    from flexflow_tpu.parallel.topology import load_machine_file
+    want = load_machine_file(a.machine).num_devices
+    assert len(jax.devices()) >= want, \
+        (f"need {want} virtual devices for {a.machine}, have "
+         f"{len(jax.devices())} — raise "
+         f"--xla_force_host_platform_device_count")
 
-    cfg = FFConfig()
-    cfg.batch_size = a.batch
     ff = FFModel(cfg)
     bcfg = BertConfig()          # defaults are BERT-large
     bcfg.max_position = a.seq
@@ -70,51 +76,41 @@ def main():
     print(f"bert-large graph: {n_ops} layers, batch {a.batch}, "
           f"seq {a.seq}", flush=True)
 
-    cost_model = OpCostModel(spec)
-    ev = TaskGraphEvaluator(cost_model, dmesh)
-    inputs = ff.graph_inputs + getattr(ff, "const_inputs", [])
-
-    dp_g = data_parallel_graph(ff.layers, inputs, [out], dmesh)
-    dp_cost = ev.graph_cost(dp_g)
-    print(f"data-parallel simulated step: {dp_cost.total * 1e3:.3f} ms "
-          f"(compute {dp_cost.compute * 1e3:.3f} xfer "
-          f"{dp_cost.xfer * 1e3:.3f} sync {dp_cost.sync * 1e3:.3f})",
-          flush=True)
-
     t0 = time.perf_counter()
-    info, strategy, gc, graph = unity_search(
-        ff.layers, inputs, [out], dmesh, cost_model,
-        budget=a.budget, evaluator_cls=TaskGraphEvaluator)
-    best = {"kind": "sharding", "cost": gc.total}
-    # pipeline candidates compete on cost exactly as in the product path
-    # (optimizer._maybe_pipeline / --enable-pipeline-search)
-    from flexflow_tpu.search.pipeline_score import best_pipeline
-    cand = best_pipeline(ff.layers, dmesh, cost_model)
-    if cand is not None:
-        print(f"pipeline candidate: S={cand.n_stages} M="
-              f"{cand.n_microbatches} v={cand.n_chunks} tp={cand.tp} "
-              f"dp={cand.dp_size} cost {cand.cost * 1e3:.3f} ms",
-              flush=True)
-        if cand.cost < best["cost"]:
-            kind = (f"pipeline_dp{cand.dp_size}xpp{cand.n_stages}"
-                    f"_m{cand.n_microbatches}")
-            if cand.tp > 1:
-                kind += f"_tp{cand.tp}"
-            if cand.n_chunks > 1:
-                kind += f"_interleaved{cand.n_chunks}"
-            best = {"kind": kind, "cost": cand.cost}
-    search_s = time.perf_counter() - t0
-    speedup = dp_cost.total / max(best["cost"], 1e-12)
-    print(f"searched simulated step:      {best['cost'] * 1e3:.3f} ms "
-          f"({best['kind']})", flush=True)
-    print(f"search time: {search_s:.1f}s (budget {a.budget})", flush=True)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    compile_s = time.perf_counter() - t0
+    spec = ff.dmesh.spec
+    print(f"machine: {spec.generation} x{spec.num_devices} "
+          f"hosts={spec.num_hosts}; compile {compile_s:.1f}s", flush=True)
+
+    pred = getattr(ff, "_search_predicted", None)
+    assert pred is not None, "search did not record predicted costs"
+    dp_ms = pred["dp_cost_s"] * 1e3
+    cand = getattr(ff, "_pipeline_choice", None)
+    if ff.executor.pipe is not None and cand is not None:
+        kind = (f"pipeline_dp{cand.dp_size}xpp{cand.n_stages}"
+                f"_m{cand.n_microbatches}")
+        if cand.tp > 1:
+            kind += f"_tp{cand.tp}"
+        if cand.n_chunks > 1:
+            kind += f"_interleaved{cand.n_chunks}"
+        searched_ms = cand.cost * 1e3
+    else:
+        kind = "sharding"
+        searched_ms = pred["searched_cost_s"] * 1e3
+    speedup = dp_ms / max(searched_ms, 1e-9)
+    print(f"data-parallel simulated step: {dp_ms:.3f} ms", flush=True)
+    print(f"searched simulated step:      {searched_ms:.3f} ms "
+          f"({kind})", flush=True)
     print(f"SEARCHED vs DATA-PARALLEL: {speedup:.2f}x "
           f"(north star: >= 1.5x)", flush=True)
 
     doc = {
         "_comment": "Simulated (machine-model-v1 link-level task sim) "
                     "searched-vs-DP step time for BERT-large on the "
-                    "v5e-32 description — BASELINE.md north-star config. "
+                    "v5e-32 description, selected by FFModel.compile "
+                    "with --enable-pipeline-search (the product path). "
                     "Regenerate: python examples/northstar_bert_large.py",
         "machine": os.path.basename(a.machine),
         "model": "bert-large",
@@ -122,11 +118,12 @@ def main():
         "seq": a.seq,
         "budget": a.budget,
         "n_ops": n_ops,
-        "dp_ms": round(dp_cost.total * 1e3, 3),
-        "searched_ms": round(best["cost"] * 1e3, 3),
-        "winner": best["kind"],
+        "dp_ms": round(dp_ms, 3),
+        "searched_ms": round(searched_ms, 3),
+        "winner": kind,
         "speedup": round(speedup, 3),
-        "search_time_s": round(search_s, 1),
+        "via": "FFModel.compile",
+        "compile_time_s": round(compile_s, 1),
     }
     os.makedirs(os.path.dirname(a.out), exist_ok=True)
     with open(a.out, "w") as f:

@@ -36,6 +36,32 @@ MODELS = [
 _LINE = re.compile(r"\[(?P<name>[\w-]+)\] (?P<mode>data-parallel|searched):"
                    r" (?P<sps>[\d.]+) samples/s")
 _RATIO = re.compile(r"searched vs data-parallel: (?P<ratio>[\d.]+)x")
+_PRED = re.compile(r"predicted searched-vs-dp: (?P<ratio>[\d.]+)x")
+_GUARD = re.compile(r"floor-guard adopted: (?P<which>\w+)")
+
+
+def _spearman(xs, ys):
+    """Spearman rank correlation without scipy."""
+    def ranks(v):
+        order = sorted(range(len(v)), key=lambda i: v[i])
+        r = [0.0] * len(v)
+        k = 0
+        while k < len(order):
+            j = k
+            while j + 1 < len(order) and v[order[j + 1]] == v[order[k]]:
+                j += 1
+            avg = (k + j) / 2.0          # averaged rank for ties
+            for t in order[k:j + 1]:
+                r[t] = avg
+            k = j + 1
+        return r
+    rx, ry = ranks(xs), ranks(ys)
+    n = len(xs)
+    mx, my = sum(rx) / n, sum(ry) / n
+    num = sum((a - mx) * (b - my) for a, b in zip(rx, ry))
+    dx = sum((a - mx) ** 2 for a in rx) ** 0.5
+    dy = sum((b - my) ** 2 for b in ry) ** 0.5
+    return num / (dx * dy) if dx > 0 and dy > 0 else 0.0
 
 
 def main():
@@ -61,6 +87,12 @@ def main():
             m = _RATIO.search(out)
             if m:
                 entry["searched_vs_dp"] = float(m.group("ratio"))
+            m = _PRED.search(out)
+            if m:
+                entry["predicted_searched_vs_dp"] = float(m.group("ratio"))
+            m = _GUARD.search(out)
+            if m:
+                entry["floor_guard_adopted"] = m.group("which")
             if r.returncode != 0:
                 entry["error"] = (r.stderr.strip().splitlines()
                                   or ["?"])[-1][:200]
@@ -74,6 +106,20 @@ def main():
     # tunnel); the per-model subprocesses already ran on the right one
     doc = {"jax_platforms_env": os.environ.get("JAX_PLATFORMS", "default"),
            "results": results}
+    # predicted-vs-measured fidelity across workloads: Spearman rank
+    # correlation of the cost model's searched/dp prediction against the
+    # measured throughput ratio (the reference's trust in graph_optimize
+    # rests on exactly this fidelity, simulator.cc:537)
+    # guard-rejected rows measure DP-vs-DP, not the predicted strategy —
+    # they carry no fidelity signal and would poison the correlation
+    pairs = [(e["predicted_searched_vs_dp"], e["searched_vs_dp"])
+             for e in results.values()
+             if "predicted_searched_vs_dp" in e and "searched_vs_dp" in e
+             and e.get("floor_guard_adopted") != "dp"]
+    if len(pairs) >= 3:
+        doc["predicted_vs_measured_spearman"] = round(
+            _spearman([p for p, _ in pairs], [m for _, m in pairs]), 4)
+        doc["n_correlated"] = len(pairs)
     out_path = os.path.join(HERE, "osdi22ae_results.json")
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1)

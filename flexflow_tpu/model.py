@@ -517,6 +517,22 @@ class FFModel:
         mesh_shape = self.config.mesh_shape
         pp = self.config.pipeline_stages
         pp_tp = max(self.config.pipeline_tp, 1)
+        if pp_tp > 1 and pp <= 1:
+            raise ValueError(
+                f"--pp-tp {pp_tp} requires --pp > 1 (stage-internal "
+                f"tensor parallelism only exists inside a pipeline); "
+                f"for tp without pipelining use a transformer_strategy "
+                f"or the search")
+        if mesh_shape is None and pp <= 1 \
+                and self.config.machine_model_file \
+                and not self.config.import_strategy_file \
+                and getattr(spec, "ici_shape", None) \
+                and int(np.prod(spec.ici_shape)) == spec.num_devices:
+            # the described machine's ICI topology drives the mesh layout
+            # (reference machine_model.cc: the machine file IS the view).
+            # Strategy imports keep the default factorization — the
+            # saved mesh_axes must keep matching what compile builds.
+            mesh_shape = tuple(spec.ici_shape)
         if strategy is None and pp > 1 and mesh_shape is None:
             # dp × pp (× tp) mesh: middle axis carries the pipeline
             # stages, trailing axis the stage-internal tensor split

@@ -1,0 +1,45 @@
+#!/bin/bash
+# CI entry point — runnable locally and from .github/workflows/ci.yml.
+# (The reference runs 7 workflow tiers behind its README badges; here one
+# script encodes the same tiers so "which tests run when" is versioned.)
+#
+#   ./ci.sh fast      fast test tier (every push; ~8 min, 8-dev CPU mesh)
+#   ./ci.sh slow      slow tier: example integration tests + HF imports
+#   ./ci.sh dryrun    multi-chip compile/execute dryrun (8 virtual devices)
+#   ./ci.sh ab        osdi22ae searched-vs-DP A/B sweep (writes JSON)
+#   ./ci.sh bench     benchmark harness (one JSON line; TPU if available)
+#   ./ci.sh nightly   slow + dryrun + ab
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+case "${1:-fast}" in
+  fast)
+    python -m pytest tests/ -x -q
+    ;;
+  slow)
+    python -m pytest tests/ -q -m slow
+    ;;
+  dryrun)
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+    ;;
+  ab)
+    python examples/osdi22ae/run_all.py
+    ;;
+  bench)
+    python bench.py
+    ;;
+  nightly)
+    "$0" slow
+    "$0" dryrun
+    "$0" ab
+    ;;
+  *)
+    echo "usage: $0 {fast|slow|dryrun|ab|bench|nightly}" >&2
+    exit 2
+    ;;
+esac

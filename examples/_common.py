@@ -66,7 +66,8 @@ def run_example(name: str, build: Callable[[FFModel, FFConfig], object],
         dt = time.perf_counter() - t0
         sps = c.batch_size * steps / dt
         mode = "data-parallel" if c.only_data_parallel else "searched"
-        print(f"[{name}] {mode}: {sps:.4g} samples/s "
+        # fixed-point, never scientific: osdi22ae/run_all.py parses this
+        print(f"[{name}] {mode}: {sps:.3f} samples/s "
               f"(loss {loss_v:.4f}, {steps} steps in {dt:.2f}s)")
         pred = getattr(ff, "_search_predicted", None)
         if pred and not c.only_data_parallel:

@@ -523,7 +523,7 @@ class FFModel:
                 f"tensor parallelism only exists inside a pipeline); "
                 f"for tp without pipelining use a transformer_strategy "
                 f"or the search")
-        if mesh_shape is None and pp <= 1 \
+        if mesh_shape is None and pp <= 1 and strategy is None \
                 and self.config.machine_model_file \
                 and not self.config.import_strategy_file \
                 and getattr(spec, "ici_shape", None) \

@@ -296,12 +296,17 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
         xfers=xfers, evaluator_cls=evaluator_cls)
     try:
         # predicted searched-vs-DP ratio, recorded so A/B harnesses can
-        # correlate the cost model's prediction with measurement
-        from .unity import GraphCostEvaluator, data_parallel_graph
-        ev = (evaluator_cls or GraphCostEvaluator)(cost_model, dmesh)
-        dp_pred = ev.graph_cost(data_parallel_graph(
-            ff.layers, ff.graph_inputs + getattr(ff, "const_inputs", []),
-            [ff._output_tensor], dmesh)).total
+        # correlate the cost model's prediction with measurement; the
+        # DP-floor evaluation inside unity_search already produced the
+        # baseline cost — only the memory-search branch recomputes
+        dp_pred = getattr(info, "dp_predicted_total", None)
+        if dp_pred is None:
+            from .unity import GraphCostEvaluator, data_parallel_graph
+            ev = (evaluator_cls or GraphCostEvaluator)(cost_model, dmesh)
+            dp_pred = ev.graph_cost(data_parallel_graph(
+                ff.layers,
+                ff.graph_inputs + getattr(ff, "const_inputs", []),
+                [ff._output_tensor], dmesh)).total
         ff._search_predicted = {"searched_cost_s": gc.total,
                                 "dp_cost_s": dp_pred}
     except Exception:  # noqa: BLE001 — reporting only

@@ -785,6 +785,7 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
         xfers = generate_all_pcg_xfers(degrees)
     if evaluator_cls is None:
         evaluator_cls = GraphCostEvaluator
+    dp_predicted_total = None
     if mem_budget_bytes is not None:
         g, gc = graph_optimize_with_memory(
             graph, xfers, cost_model, dmesh, mem_budget_bytes, budget,
@@ -804,6 +805,7 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
         dp_g = data_parallel_graph(layers, input_tensors, output_tensors,
                                    dmesh)
         dp_gc = ev.graph_cost(dp_g)
+        dp_predicted_total = dp_gc.total
         if dp_gc.total < gc.total:
             g, gc = dp_g, dp_gc
         # hybrid composed-2D template floor (see hybrid_template_graphs)
@@ -813,5 +815,8 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
             if tgc.total < gc.total:
                 g, gc = tg, tgc
     info = g.to_program()
+    # predicted DP-baseline cost (already computed for the DP floor in
+    # the non-memory branch) — consumed by optimizer reporting
+    info.dp_predicted_total = dp_predicted_total
     strategy = extract_strategy(g, info, dmesh)
     return info, strategy, gc, g

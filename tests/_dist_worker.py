@@ -1,10 +1,13 @@
-"""Worker for tests/test_distributed.py: one controller process of a
-2-process CPU world (2 local devices each -> 4 global)."""
+"""Worker for tests/test_distributed.py and __graft_entry__'s
+distributed dryrun leg: one controller process of a 2-process CPU world
+(argv[3] local devices each, default 2 -> 4 global)."""
 import os
 import sys
 
+_LOCAL = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["XLA_FLAGS"] = \
+    f"--xla_force_host_platform_device_count={_LOCAL}"
 
 import jax  # noqa: E402
 
@@ -31,7 +34,7 @@ def main():
                ["accuracy"], output_tensor=out)
 
     assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 4, jax.devices()
+    assert len(jax.devices()) == 2 * _LOCAL, jax.devices()
     assert ff.dmesh.dcn_axis == "dcn", ff.dmesh.axis_sizes
     assert ff.dmesh.spec.num_slices == 2
 

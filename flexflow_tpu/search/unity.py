@@ -343,6 +343,15 @@ class UnitySearch:
         # 16x `budget` expansions / 15+4*budget seconds GLOBALLY
         self.pool = pool or SearchPool(budget * 16, 15.0 + 4.0 * budget)
         self._memo: Dict[Tuple, Tuple[Graph, float]] = {}
+        # structural (guid-independent) memo: identical transformer
+        # blocks are isomorphic subproblems — solve one, replay the
+        # rewrite onto the others (the reference memoizes by
+        # dp_state_hash over op guids, graph.cc:1863, so it re-solves
+        # every block; repeated-block models dominate the workload here)
+        self._smemo: Dict[Tuple, Tuple[List[PNode], List, Graph,
+                                       float]] = {}
+        self.smemo_hits = 0
+        self._run_cache: Dict[Tuple, Optional[Tuple]] = {}
 
     def _cut_layout_candidates(self, t: Tensor,
                                depth: int = 0) -> List[Layout]:
@@ -383,13 +392,43 @@ class UnitySearch:
         cap = 12 if depth < 2 else 6
         return cands[:cap]
 
-    def _split_positions(self, interior: List[PNode],
-                         depth: int) -> List[PNode]:
-        """Split positions to try. At shallow depth, several bottlenecks
-        compete (the reference's per-bottleneck recursion,
-        substitution.cc:2572); deeper, the midpoint alone — pins rarely
-        repeat across layouts, so memoization cannot keep an all-position
-        all-depth DP polynomial."""
+    def _split_positions(self, interior: List[PNode], depth: int,
+                         order: Optional[List[PNode]] = None
+                         ) -> List[PNode]:
+        """Split positions to try. Repeated-block boundaries (transformer
+        blocks, residual stacks) are preferred: cutting there aligns the
+        sub-chains on whole blocks, so offset-shifted chains become
+        isomorphic subproblems and the structural memo replays one
+        block-run's solution across the others. Otherwise: at shallow
+        depth several bottlenecks compete (the reference's
+        per-bottleneck recursion, substitution.cc:2572); deeper, the
+        midpoint alone."""
+        bounds: List[PNode] = []
+        if order is not None and len(order) >= 6:
+            from ..parallel.pipeline_lowering import find_repeated_run
+            layers = [n.layer for n in order]
+            # run detection is O(n^2)-ish; identical subgraphs recur
+            # across the DP (pre/post splits rebuild the same node sets)
+            rkey = tuple(l.guid for l in layers)
+            if rkey in self._run_cache:
+                run = self._run_cache[rkey]
+            else:
+                run = self._run_cache[rkey] = find_repeated_run(layers, 1)
+            if run is not None:
+                total, start, unit = run
+                reps = total // unit
+                by_layer = {n.layer.guid: n for n in order}
+                ok = {n.guid for n in interior}
+                for k in range(1, reps):
+                    n = by_layer.get(layers[start + k * unit - 1].guid)
+                    if n is not None and n.guid in ok:
+                        bounds.append(n)
+        if bounds:
+            if depth >= 2 or len(bounds) == 1:
+                return [bounds[len(bounds) // 2]]
+            q = len(bounds) // 4
+            picks = [bounds[len(bounds) // 2], bounds[q], bounds[-1 - q]]
+            return list(dict.fromkeys(picks))
         if depth >= 2 or len(interior) == 1:
             return [interior[len(interior) // 2]]
         if len(interior) <= 3:
@@ -398,6 +437,118 @@ class UnitySearch:
         picks = [interior[q], interior[len(interior) // 2],
                  interior[-1 - q]]
         return list(dict.fromkeys(picks))
+
+    # ------------------------------------------------------------------
+    # structural memoization (guid-independent; isomorphic-subproblem
+    # replay across repeated blocks)
+    # ------------------------------------------------------------------
+    def _canonical(self, graph: Graph, in_pins: Dict[int, Layout],
+                   out_pin) -> Tuple[Optional[Tuple],
+                                     Optional[List[PNode]]]:
+        """Fully-structural key of (subgraph, pins): node signatures in
+        canonical (topo) order, positional edges/externals/outputs. Two
+        isomorphic subproblems produce equal keys with position-aligned
+        node lists; equality of the full key (not a hash) rules out
+        collisions. Returns (None, None) when a pin references a tensor
+        outside the subgraph's externals (no safe structural identity)."""
+        from ..core.layer import _hashable
+        order = graph.topo_order()
+        pos = {n.guid: i for i, n in enumerate(order)}
+        sigs = tuple(
+            (n.layer.op_type, _hashable(n.layer.params),
+             tuple((t.shape, t.dtype) for t in n.layer.inputs),
+             tuple((t.shape, t.dtype) for t in n.layer.outputs),
+             n.ann)
+            for n in order)
+        edges = tuple(sorted(
+            (pos[e.src.guid], pos[e.dst.guid], e.src_idx, e.dst_idx)
+            for es in graph.in_edges.values() for e in es))
+        covered = set()
+        ext = []
+        for n in order:
+            for slot, t in graph.external_inputs.get(n.guid, ()):
+                covered.add(t.guid)
+                ext.append((pos[n.guid], slot, tuple(t.shape), t.dtype,
+                            in_pins.get(t.guid)))
+        # pins on tensors the subgraph never consumes are inert (the
+        # evaluator only consults pins for node-input tensors present in
+        # the graph) and are EXCLUDED from the key; a pin on an internal
+        # (non-external) consumed tensor cannot be keyed structurally
+        consumed = {t.guid for n in order for t in n.layer.inputs}
+        if any(g in consumed and g not in covered for g in in_pins):
+            return None, order
+        outs = tuple((pos[n.guid], i) for n, i in graph.outputs)
+        return (sigs, edges, tuple(sorted(ext)), outs, out_pin), order
+
+    def _replay(self, result: Graph, memo_order: List[PNode],
+                memo_ext: List, query: Graph,
+                query_order: List[PNode]) -> Optional[Graph]:
+        """Re-instantiate a memoized optimized subgraph onto an
+        isomorphic query subgraph: query layers substitute for memo
+        layers position-by-position; layers the rewrite introduced
+        (parallel ops, fused replacements) are cloned with their inputs
+        re-plumbed to query tensors — exactly what re-running the same
+        rewrite on the query block would create. Returns None when any
+        tensor fails to map (caller re-searches)."""
+        try:
+            tmap: Dict[int, Tensor] = {}
+            lmap: Dict[int, Layer] = {}
+            for mn, qn in zip(memo_order, query_order):
+                lmap[mn.layer.guid] = qn.layer
+                for mt, qt in zip(mn.layer.outputs, qn.layer.outputs):
+                    tmap[mt.guid] = qt
+            qpos = {n.guid: i for i, n in enumerate(query_order)}
+            qext = {}
+            for n in query_order:
+                for slot, t in query.external_inputs.get(n.guid, ()):
+                    qext[(qpos[n.guid], slot)] = t
+            for p, slot, t in memo_ext:
+                tmap[t.guid] = qext[(p, slot)]
+            g = Graph()
+            new_nodes: Dict[int, PNode] = {}
+            for n in result.topo_order():
+                ql = lmap.get(n.layer.guid)
+                if ql is None:
+                    ins = [tmap[t.guid] for t in n.layer.inputs]
+                    ql = Layer(n.layer.op_type, None, ins,
+                               dict(n.layer.params))
+                    for t in n.layer.outputs:
+                        ql.outputs.append(Tensor(t.shape, t.dtype,
+                                                 owner_layer=ql))
+                    for mt, qt in zip(n.layer.outputs, ql.outputs):
+                        tmap[mt.guid] = qt
+                    lmap[n.layer.guid] = ql
+                nn = PNode(ql, n.ann)
+                new_nodes[n.guid] = nn
+                g.add_node(nn)
+            for es in result.in_edges.values():
+                for e in es:
+                    g.add_edge(new_nodes[e.src.guid], new_nodes[e.dst.guid],
+                               e.src_idx, e.dst_idx)
+            for guid, slots in result.external_inputs.items():
+                if guid not in new_nodes:
+                    continue
+                g.external_inputs[new_nodes[guid].guid] = [
+                    (slot, tmap[t.guid]) for slot, t in slots]
+            g.input_tensors = [tmap[t.guid] for t in result.input_tensors]
+            g.outputs = [(new_nodes[n.guid], i) for n, i in result.outputs]
+            return g
+        except KeyError:
+            return None
+
+    @staticmethod
+    def _ext_list(graph: Graph, order: List[PNode]) -> List:
+        pos = {n.guid: i for i, n in enumerate(order)}
+        out = []
+        for n in order:
+            for slot, t in graph.external_inputs.get(n.guid, ()):
+                out.append((pos[n.guid], slot, t))
+        return out
+
+    def _store(self, skey, graph, order, res) -> None:
+        if skey is not None and skey not in self._smemo:
+            self._smemo[skey] = (order, self._ext_list(graph, order),
+                                 res[0], res[1])
 
     def optimize(self, graph: Graph,
                  in_pins: Optional[Dict[int, Layout]] = None,
@@ -410,7 +561,18 @@ class UnitySearch:
         hit = self._memo.get(key)
         if hit is not None:
             return hit
-        order = graph.topo_order()
+        skey, order = self._canonical(graph, in_pins, out_pin)
+        if skey is not None:
+            sh = self._smemo.get(skey)
+            if sh is not None:
+                memo_order, memo_ext, res_g, res_c = sh
+                replayed = self._replay(res_g, memo_order, memo_ext,
+                                        graph, order)
+                if replayed is not None:
+                    self.smemo_hits += 1
+                    res = (replayed, res_c)
+                    self._memo[key] = res
+                    return res
         interior = [n for n in graph.bottlenecks()
                     if graph.in_edges[n] and graph.out_edges[n]
                     and n.op_type not in PARALLEL_OPS
@@ -421,13 +583,14 @@ class UnitySearch:
                                 self.alpha, self.max_num_ops, in_pins,
                                 out_pin, pool=self.pool)
             self._memo[key] = res
+            self._store(skey, graph, order, res)
             return res
         # DP over split positions × cut layouts (reference recurses at
         # each bottleneck over machine-view sets, substitution.cc:2572;
         # memoization by (subgraph hash, pins) keeps this polynomial)
         best_merged: Optional[Graph] = None
         best_cost = float("inf")
-        for b in self._split_positions(interior, depth):
+        for b in self._split_positions(interior, depth, order):
             pre, post = graph.split_at(b)
             # crossing tensors, positionally aligned with pre.outputs —
             # substitutions may replace the producing node (fresh output
@@ -456,6 +619,7 @@ class UnitySearch:
         assert best_merged is not None
         res = (best_merged, best_cost)
         self._memo[key] = res
+        self._store(skey, graph, order, res)
         return res
 
 

@@ -1,22 +1,64 @@
 """Worker for tests/test_distributed.py and __graft_entry__'s
 distributed dryrun leg: one controller process of a 2-process CPU world
-(argv[3] local devices each, default 2 -> 4 global)."""
+(argv[3] local devices each, default 2 -> 4 global).
+
+``launch_world(n_local, timeout)`` is the shared orchestrator (free
+port, two controller subprocesses, timeout-kill, DIST_OK + replicated-
+loss assertions) used by both callers — keep protocol changes here."""
 import os
 import sys
 
-_LOCAL = int(sys.argv[3]) if len(sys.argv) > 3 else 2
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = \
-    f"--xla_force_host_platform_device_count={_LOCAL}"
 
-import jax  # noqa: E402
+def launch_world(n_local: int = 2, timeout: float = 300.0):
+    """Spawn the 2-controller world and return both stdouts. Raises on
+    any controller failure; asserts the replicated loss agrees."""
+    import socket
+    import subprocess
 
-jax.config.update("jax_platforms", "cpu")
+    worker = os.path.abspath(__file__)
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(worker)) \
+        + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)  # the worker sets its own
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(port), str(i), str(n_local)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0 and "DIST_OK" in out, \
+            f"controller {i} failed:\n{out}\n{err[-2000:]}"
+        outs.append(out)
+    losses = [[t for t in o.split() if t.startswith("loss1=")][0]
+              for o in outs]
+    assert losses[0] == losses[1], losses
+    return outs
 
-import numpy as np  # noqa: E402
+
+if __name__ == "__main__":
+    # worker-process env setup; must precede any jax import. Importing
+    # this module (for launch_world) must NOT touch jax or env.
+    _LOCAL = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={_LOCAL}"
 
 
 def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
     port, pid = sys.argv[1], int(sys.argv[2])
     os.environ["FF_COORDINATOR_ADDRESS"] = f"localhost:{port}"
     os.environ["FF_NUM_PROCESSES"] = "2"

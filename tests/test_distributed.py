@@ -6,48 +6,16 @@ Reference parity: multi-node training via control replication + GASNet
 here each subprocess is one controller in the jax.distributed world
 (``flexflow_tpu/parallel/distributed.py``).
 """
-import os
-import socket
-import subprocess
-import sys
-
 import numpy as np
 
-HERE = os.path.dirname(os.path.abspath(__file__))
-WORKER = os.path.join(HERE, "_dist_worker.py")
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from _dist_worker import launch_world
 
 
 def test_two_process_dp_training():
-    port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep \
-        + env.get("PYTHONPATH", "")
-    env.pop("JAX_PLATFORMS", None)  # worker sets its own
-    procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(port), str(i)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
-    outs = []
-    for i, p in enumerate(procs):
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, f"proc {i}:\n{out}\n{err}"
-        assert "DIST_OK" in out, out
-        outs.append(out)
-    # replicated loss scalars must agree across controllers
-    losses = [[tok for tok in o.split() if tok.startswith("loss1=")][0]
-              for o in outs]
-    assert losses[0] == losses[1], losses
+    outs = launch_world(n_local=2, timeout=300)
+    # replicated loss scalars must agree across controllers (launch_world
+    # asserts equality); values must be finite
     a = [float(tok.split("=")[1]) for o in outs for tok in o.split()
          if tok.startswith("loss1=")]
+    assert len(a) == 2
     assert np.isfinite(a).all()

@@ -115,6 +115,31 @@ def _train_flops_per_step(ff) -> float:
     return total
 
 
+def timed_mfu(ff, batch_dict, steps: int):
+    """Shared train-step measurement (bench stage_bert + the profiling
+    sweep in examples/tpu_profile_bert.py): warmup, timed loop with a
+    D2H sync, PER-CHIP samples/s and MFU. Returns
+    (sps_per_chip, mfu, flops_per_step, n_chips, seconds)."""
+    import jax
+    from flexflow_tpu.parallel.machine import MachineSpec
+    batch = next(iter(batch_dict.values())).shape[0]
+    step = ff.executor.make_train_step()
+    for _ in range(3):
+        bm = ff._run_train_step(step, batch_dict)
+    _sync_fetch(bm["loss"])  # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        bm = ff._run_train_step(step, batch_dict)
+    _sync_fetch(bm["loss"])
+    dt = time.perf_counter() - t0
+    n_chips = max(1, len(jax.devices()))
+    sps = batch * steps / dt / n_chips
+    spec = MachineSpec.detect()
+    flops_step = _train_flops_per_step(ff)
+    mfu = flops_step * (steps / dt) / (spec.peak_flops * n_chips)
+    return sps, mfu, flops_step, n_chips, dt
+
+
 def stage_bert(flash: str, searched: bool, budget: int, steps: int,
                batch: int, seq: int):
     _apply_platform_env()
@@ -147,20 +172,8 @@ def stage_bert(flash: str, searched: bool, budget: int, steps: int,
          "position_ids": np.tile(np.arange(seq, dtype=np.int32),
                                  (batch, 1)),
          "label": rng.integers(0, 2, size=(batch, 1)).astype(np.int32)}
-    step = ff.executor.make_train_step()
-    for _ in range(3):
-        bm = ff._run_train_step(step, b)
-    _sync_fetch(bm["loss"])  # compile + sync
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        bm = ff._run_train_step(step, b)
-    _sync_fetch(bm["loss"])
-    dt = time.perf_counter() - t0
-    n_chips = max(1, len(jax.devices()))
-    sps = batch * steps / dt / n_chips
+    sps, mfu, flops_step, n_chips, _dt = timed_mfu(ff, b, steps)
     spec = MachineSpec.detect()
-    flops_step = _train_flops_per_step(ff)
-    mfu = flops_step * (steps / dt) / (spec.peak_flops * n_chips)
     _emit({"sps": round(sps, 3), "mfu": round(mfu, 4),
            "flops_per_step": flops_step, "n_chips": n_chips,
            "search_time_s": round(search_time, 2),

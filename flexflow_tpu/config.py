@@ -93,6 +93,11 @@ class FFConfig:
     # searched sharding strategy and pick the winner
     enable_pipeline_search: bool = False
     use_bf16_compute: bool = True                  # matmuls in bf16, fp32 accum
+    # end-to-end bf16 ACTIVATIONS: inter-op tensors are stored bf16
+    # (halves HBM traffic on the memory-bound segments); weights stay
+    # fp32 masters, losses/norms still reduce in fp32 internally.
+    # Off by default — enable for MFU on bandwidth-bound models.
+    bf16_activations: bool = False
     # persistent XLA compilation cache dir; "" = off unless
     # JAX_COMPILATION_CACHE_DIR is set (see utils/compilation_cache.py)
     compilation_cache_dir: str = ""
@@ -252,6 +257,8 @@ class FFConfig:
                 cfg.pipeline_chunks = int(take())
             elif a in ("--pp-tp", "--pipeline-tp"):
                 cfg.pipeline_tp = int(take())
+            elif a == "--bf16-activations":
+                cfg.bf16_activations = True
             elif a in ("--zero", "--shard-optimizer-states"):
                 cfg.shard_optimizer_states = True
             elif a == "--remat":

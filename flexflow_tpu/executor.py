@@ -74,6 +74,8 @@ class GraphProgram:
                     params: Dict[str, Dict[str, Any]], ctx: EmitCtx,
                     strategy: Optional[ShardingStrategy] = None,
                     capture: Optional[Dict[int, Any]] = None) -> None:
+        bf16_act = bool(getattr(ctx.config, "bf16_activations", False)) \
+            if ctx.config is not None else False
         for layer in layers:
             op = get_op_def(layer.op_type)
             ins = [env[t.guid] for t in layer.inputs]
@@ -81,6 +83,12 @@ class GraphProgram:
             outs = op.emit(layer.params, ins, w, ctx, layer.name)
             assert len(outs) == len(layer.outputs), layer
             for i, (o, t) in enumerate(zip(outs, layer.outputs)):
+                if bf16_act and hasattr(o, "dtype") \
+                        and o.dtype == jnp.float32:
+                    # end-to-end bf16 activations: inter-op tensors live
+                    # in bf16 (weights stay fp32 masters; losses/norms
+                    # upcast internally)
+                    o = o.astype(jnp.bfloat16)
                 if strategy is not None:
                     sh = strategy.output_sharding(layer.name, i)
                     if sh is not None:
@@ -295,6 +303,7 @@ class Executor:
         template = pipe.template
 
         tp_ax = pipe.tp_axis
+        bf16_act = bool(getattr(self.config, "bf16_activations", False))
 
         def stage_fn(p, x, t):
             rng_base = p.get("__rng__")
@@ -336,6 +345,9 @@ class Executor:
                 else:
                     outs = op.emit(layer.params, ins, w, ctx, layer.name)
                 for o, tt in zip(outs, layer.outputs):
+                    if bf16_act and hasattr(o, "dtype") \
+                            and o.dtype == jnp.float32:
+                        o = o.astype(jnp.bfloat16)
                     env[tt.guid] = o
             return env[pipe.template_exit_guid]
 

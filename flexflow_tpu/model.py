@@ -745,7 +745,8 @@ class FFModel:
                  max_new_tokens: int, temperature: float = 0.0,
                  seed: int = 0, extra_inputs=None,
                  eos_token_id: int | None = None,
-                 kv_cache: Union[bool, str] = "auto"):
+                 kv_cache: Union[bool, str] = "auto",
+                 top_k: int = 0, top_p: float = 1.0):
         """Autoregressive generation for causal LMs (GPT-2 / LLaMA /
         transformer-LM family; the reference has no generation path —
         its Triton backend serves fixed forwards only).
@@ -784,7 +785,8 @@ class FFModel:
         if want_kv:
             try:
                 return self._generate_kv(ids0, prompt_len, max_new_tokens,
-                                         temperature, seed, eos_token_id)
+                                         temperature, seed, eos_token_id,
+                                         top_k, top_p)
             except Exception:
                 if kv_cache is True:
                     raise
@@ -795,7 +797,7 @@ class FFModel:
                     exc_info=True)
         return self._generate_reforward(ids0, prompt_len, max_new_tokens,
                                         temperature, seed, eos_token_id,
-                                        fixed)
+                                        fixed, top_k, top_p)
 
     def _kv_decode_eligible(self, names, extra_inputs) -> bool:
         """KV decode needs: no pipeline region, inputs limited to
@@ -813,7 +815,7 @@ class FFModel:
                                  for l in mha)
 
     def _generate_kv(self, ids0, prompt_len, max_new_tokens, temperature,
-                     seed, eos_token_id):
+                     seed, eos_token_id, top_k=0, top_p=1.0):
         """Incremental decode: one full-sequence prefill builds the
         per-layer K/V cache, then each generated token is one seq-len-1
         forward — per-token cost independent of how many tokens have
@@ -842,7 +844,8 @@ class FFModel:
                 row, cache = ex.kv_decode_step(params, state, sb, cache,
                                                cur - 1)
                 key, nxt, done = self._sample_next(row, key, temperature,
-                                                   eos_token_id, done)
+                                                   eos_token_id, done,
+                                                   top_k, top_p)
                 ids = jax.lax.dynamic_update_slice_in_dim(
                     ids, nxt[:, None], cur, axis=1)
                 return (ids, cache, key, done), nxt
@@ -852,21 +855,60 @@ class FFModel:
                 jnp.arange(max_new_tokens))
             return ids
 
-        cache_d = self.executor.__dict__.setdefault("_decode_cache", {})
         ck = ("kv", b, L, max_new_tokens, float(temperature),
-              eos_token_id)
-        fn = cache_d.get(ck)
-        if fn is None:
-            fn = cache_d[ck] = jax.jit(decode)
+              eos_token_id, int(top_k), float(top_p))
+        fn = self._decode_cache_get(ck, decode)
         return fn(self.params, self.state, ids0, jax.random.key(seed),
                   jnp.int32(prompt_len))
 
-    def _sample_next(self, row, key, temperature, eos_token_id, done):
+    # decode executables are cached per (shape, steps, sampling params);
+    # arbitrary client-supplied floats (temperature/top_p) would grow the
+    # cache without bound on a long-running server — LRU-capped
+    _DECODE_CACHE_CAP = 16
+
+    def _decode_cache_get(self, ck, builder):
+        import collections
+        cache = self.executor.__dict__.setdefault(
+            "_decode_cache", collections.OrderedDict())
+        fn = cache.get(ck)
+        if fn is None:
+            fn = cache[ck] = jax.jit(builder)
+        else:
+            cache.move_to_end(ck)
+        while len(cache) > self._DECODE_CACHE_CAP:
+            cache.popitem(last=False)
+        return fn
+
+    def _sample_next(self, row, key, temperature, eos_token_id, done,
+                     top_k: int = 0, top_p: float = 1.0):
         """Shared sampling step: ``row`` is (B, V) log-domain scores
-        (pre-softmax logits when the graph exposes them)."""
+        (pre-softmax logits when the graph exposes them). HF processor
+        order: temperature, then top-k, then top-p (nucleus)."""
         if temperature > 0.0:
             key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, row / temperature, axis=-1)
+            logits = row / temperature
+            use_k = top_k and 0 < top_k < logits.shape[-1]
+            if use_k or top_p < 1.0:
+                # ONE descending vocab sort serves both filters: the kth
+                # value is desc[:, k-1], and masking to -inf preserves
+                # the survivors' descending order for the nucleus scan
+                desc = jnp.sort(logits, axis=-1)[:, ::-1]
+                if use_k:
+                    kth = desc[:, top_k - 1][:, None]
+                    logits = jnp.where(logits < kth, -jnp.inf, logits)
+                    desc = jnp.where(
+                        jnp.arange(desc.shape[-1])[None, :] >= top_k,
+                        -jnp.inf, desc)
+                if top_p < 1.0:
+                    # nucleus: keep the smallest prefix of descending-
+                    # prob tokens whose cumulative probability reaches p
+                    probs = jax.nn.softmax(desc, axis=-1)
+                    cum = jnp.cumsum(probs, axis=-1)
+                    excluded = cum - probs > top_p  # prefix >= p before
+                    kept = jnp.where(excluded, jnp.inf, desc)
+                    thresh = jnp.min(kept, axis=-1, keepdims=True)
+                    logits = jnp.where(logits < thresh, -jnp.inf, logits)
+            nxt = jax.random.categorical(sub, logits, axis=-1)
         else:
             nxt = jnp.argmax(row, axis=-1)
         nxt = nxt.astype(jnp.int32)
@@ -877,7 +919,8 @@ class FFModel:
         return key, nxt, done
 
     def _generate_reforward(self, ids0, prompt_len, max_new_tokens,
-                            temperature, seed, eos_token_id, fixed):
+                            temperature, seed, eos_token_id, fixed,
+                            top_k=0, top_p=1.0):
         """Exact oracle path: full forward per step; the causal mask
         guarantees positions < t ignore columns >= t."""
         ex = self.executor
@@ -894,7 +937,8 @@ class FFModel:
                 row = jax.lax.dynamic_slice_in_dim(scores, cur - 1, 1,
                                                    axis=1)[:, 0, :]
                 key, nxt, done = self._sample_next(row, key, temperature,
-                                                   eos_token_id, done)
+                                                   eos_token_id, done,
+                                                   top_k, top_p)
                 ids = jax.lax.dynamic_update_slice_in_dim(
                     ids, nxt[:, None], cur, axis=1)
                 return (ids, key, done), nxt
@@ -903,15 +947,14 @@ class FFModel:
                 step, (ids0, key0, done0), jnp.arange(max_new_tokens))
             return ids
 
-        # jit cached per (shape, steps, temperature, eos, fixed-input
-        # set); prompt_len is a TRACED argument so serving traffic with
-        # varying prompt lengths reuses one compiled program per shape
-        cache = self.executor.__dict__.setdefault("_decode_cache", {})
+        # jit cached per (shape, steps, temperature, eos, sampling,
+        # fixed-input set); prompt_len is a TRACED argument so serving
+        # traffic with varying prompt lengths reuses one compiled
+        # program per shape
         ck = ("fwd", b, L, max_new_tokens, float(temperature),
-              eos_token_id, tuple(sorted(fixed)))
-        fn = cache.get(ck)
-        if fn is None:
-            fn = cache[ck] = jax.jit(decode)
+              eos_token_id, int(top_k), float(top_p),
+              tuple(sorted(fixed)))
+        fn = self._decode_cache_get(ck, decode)
         return fn(self.params, self.state, ids0, jax.random.key(seed),
                   fixed, jnp.int32(prompt_len))
 

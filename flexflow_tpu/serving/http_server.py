@@ -7,7 +7,7 @@ Endpoints (JSON bodies, shapes row-major):
     body: {"inputs": [{"name": ..., "shape": [...], "data": [flat]}]}
   - ``POST /v2/models/<name>/generate``  -> {"outputs": [{"name":
     "output_ids", ...}]} — causal-LM decode; body adds
-    {"parameters": {"prompt_len", "max_new_tokens", "temperature",
+    {"parameters": {"prompt_len", "max_new_tokens", "temperature", "top_k", "top_p",
     "seed", "eos_token_id"}}
 
 Reference analog: the Triton backend's HTTP surface
@@ -70,13 +70,22 @@ def _make_handler(repo, schedulers):
                             "error": "generate needs inputs.input_ids "
                                      f"and parameters {missing or ''}"})
                     eos = p.get("eos_token_id")
+                    top_k = int(p.get("top_k", 0))
+                    top_p = float(p.get("top_p", 1.0))
+                    temp = float(p.get("temperature", 0.0))
+                    if not (0.0 < top_p <= 1.0) or top_k < 0 \
+                            or temp < 0.0:
+                        return self._send(400, {
+                            "error": "need 0 < top_p <= 1, top_k >= 0, "
+                                     "temperature >= 0"})
                     out = sess.generate(
                         inputs["input_ids"],
                         prompt_len=int(p["prompt_len"]),
                         max_new_tokens=int(p["max_new_tokens"]),
-                        temperature=float(p.get("temperature", 0.0)),
+                        temperature=temp,
                         seed=int(p.get("seed", 0)),
-                        eos_token_id=None if eos is None else int(eos))
+                        eos_token_id=None if eos is None else int(eos),
+                        top_k=top_k, top_p=top_p)
                     return self._send(200, {"outputs": [{
                         "name": "output_ids", "shape": list(out.shape),
                         "data": np.asarray(out, np.int32)

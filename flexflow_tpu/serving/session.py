@@ -69,7 +69,8 @@ class InferenceSession:
     def generate(self, input_ids: np.ndarray, prompt_len: int,
                  max_new_tokens: int, temperature: float = 0.0,
                  seed: int = 0,
-                 eos_token_id: "int | None" = None) -> np.ndarray:
+                 eos_token_id: "int | None" = None,
+                 top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
         """Autoregressive decode for causal-LM sessions. Batch is padded
         to the bucket (decode programs cache per bucket inside
         ``FFModel.generate``); the padded rows' outputs are sliced off."""
@@ -85,7 +86,8 @@ class InferenceSession:
                 [self.generate(ids[i:i + cap], prompt_len,
                                max_new_tokens, temperature,
                                (seed + (i // cap) * 0x9E3779B1)
-                               & 0x7FFFFFFF, eos_token_id)
+                               & 0x7FFFFFFF, eos_token_id,
+                               top_k=top_k, top_p=top_p)
                  for i in range(0, n, cap)], axis=0)
         bucket = _next_bucket(n, self.buckets)
         if bucket != n:
@@ -94,7 +96,8 @@ class InferenceSession:
         with self._lock:
             out = self.ff.generate(ids, prompt_len, max_new_tokens,
                                    temperature=temperature, seed=seed,
-                                   eos_token_id=eos_token_id)
+                                   eos_token_id=eos_token_id,
+                                   top_k=top_k, top_p=top_p)
         return np.asarray(out)[:n]
 
 

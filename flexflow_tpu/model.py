@@ -203,12 +203,18 @@ class FFModel:
                             kdim: int = 0, vdim: int = 0, dropout: float = 0.0,
                             bias: bool = True, add_bias_kv: bool = False,
                             add_zero_attn: bool = False, causal: bool = False,
+                            rope: bool = False, rope_theta: float = 10000.0,
                             kernel_initializer=None,
                             name: Optional[str] = None) -> Tensor:
         params = {"embed_dim": embed_dim, "num_heads": num_heads,
                   "kdim": kdim, "vdim": vdim, "dropout": dropout,
                   "bias": bias, "add_bias_kv": add_bias_kv,
                   "add_zero_attn": add_zero_attn, "causal": causal}
+        if rope:
+            # in-op rotary embeddings (LLaMA family; enables the fused
+            # flash-attention and KV-decode paths for RoPE models)
+            params["rope"] = True
+            params["rope_theta"] = float(rope_theta)
         return self._add_layer(OperatorType.OP_MULTIHEAD_ATTENTION,
                                [query, key, value], params, name).outputs[0]
 

@@ -237,10 +237,19 @@ def _rope_tables(seq_len: int, head_dim: int, theta: float):
 
 
 def build_llama(ff: FFModel, batch_size: int, seq_len: int,
-                cfg: LlamaConfig | None = None, lm_head: bool = True):
+                cfg: LlamaConfig | None = None, lm_head: bool = True,
+                fused_attention: bool = False):
     """Causal LM: (b, s) token ids -> (b, s, vocab) logits (or final
     hidden states when ``lm_head=False``). HF weight layout compatible
-    (q/k/v/o + gate/up/down per layer, half-split rotate RoPE)."""
+    (q/k/v/o + gate/up/down per layer, half-split rotate RoPE).
+
+    ``fused_attention=True`` builds each attention block as ONE
+    OP_MULTIHEAD_ATTENTION with in-op RoPE instead of the primitive
+    dense/batch_matmul/softmax form — same math, but eligible for the
+    Pallas flash kernel and KV-cache incremental decode (the primitive
+    form carries seq-length-baked mask/rope constants a length-1 decode
+    trace cannot satisfy). Convert primitive-layout weights with
+    ``llama_fuse_params``."""
     import math
     import numpy as np
     cfg = cfg or LlamaConfig()
@@ -251,6 +260,39 @@ def build_llama(ff: FFModel, batch_size: int, seq_len: int,
     ids = ff.create_tensor((b, s), DataType.DT_INT32, name="input_ids")
     h = ff.embedding(ids, cfg.vocab_size, cfg.hidden_size,
                      AggrMode.AGGR_MODE_NONE, name="embed_tokens")
+
+    def mlp_block(h, i):
+        """SwiGLU MLP + residual — shared by both attention forms (the
+        layer names are llama_fuse_params' pass-through contract)."""
+        x2 = ff.rms_norm(h, eps=cfg.rms_eps, name=f"post_norm_{i}")
+        gate = ff.dense(x2, cfg.intermediate_size, use_bias=False,
+                        name=f"gate_proj_{i}")
+        up = ff.dense(x2, cfg.intermediate_size, use_bias=False,
+                      name=f"up_proj_{i}")
+        silu = ff.multiply(gate, ff.sigmoid(gate), name=f"silu_{i}")
+        down = ff.dense(ff.multiply(silu, up), cfg.hidden_size,
+                        use_bias=False, name=f"down_proj_{i}")
+        return ff.add(h, down, name=f"mlp_res_{i}")
+
+    def head(h):
+        h = ff.rms_norm(h, eps=cfg.rms_eps, name="final_norm")
+        if not lm_head:
+            return h
+        # final softmax so the executor fuses CE-on-logits (the stable
+        # loss path engages on OP_SOFTMAX outputs, executor.py; same
+        # convention as build_gpt2/build_bert)
+        return ff.softmax(ff.dense(h, cfg.vocab_size, use_bias=False,
+                                   name="lm_head"))
+
+    if fused_attention:
+        for i in range(cfg.num_layers):
+            x = ff.rms_norm(h, eps=cfg.rms_eps, name=f"input_norm_{i}")
+            attn_out = ff.multihead_attention(
+                x, x, x, cfg.hidden_size, nh, bias=False, causal=True,
+                rope=True, rope_theta=cfg.rope_theta, name=f"attn_{i}")
+            h = ff.add(h, attn_out, name=f"attn_res_{i}")
+            h = mlp_block(h, i)
+        return head(h)
 
     cos_np, sin_np = _rope_tables(s, hd, cfg.rope_theta)
     cos_t = ff.create_tensor(cos_np.shape, create_grad=False,
@@ -297,22 +339,40 @@ def build_llama(ff: FFModel, batch_size: int, seq_len: int,
         attn_out = ff.dense(merged, cfg.hidden_size, use_bias=False,
                             name=f"o_proj_{i}")
         h = ff.add(h, attn_out, name=f"attn_res_{i}")
+        h = mlp_block(h, i)
 
-        x2 = ff.rms_norm(h, eps=cfg.rms_eps, name=f"post_norm_{i}")
-        gate = ff.dense(x2, cfg.intermediate_size, use_bias=False,
-                        name=f"gate_proj_{i}")
-        up = ff.dense(x2, cfg.intermediate_size, use_bias=False,
-                      name=f"up_proj_{i}")
-        silu = ff.multiply(gate, ff.sigmoid(gate), name=f"silu_{i}")
-        down = ff.dense(ff.multiply(silu, up), cfg.hidden_size,
-                        use_bias=False, name=f"down_proj_{i}")
-        h = ff.add(h, down, name=f"mlp_res_{i}")
+    return head(h)
 
-    h = ff.rms_norm(h, eps=cfg.rms_eps, name="final_norm")
-    if not lm_head:
-        return h
-    # final softmax so the executor fuses CE-on-logits (the stable loss
-    # path engages on OP_SOFTMAX outputs, executor.py; same convention
-    # as build_gpt2/build_bert)
-    return ff.softmax(ff.dense(h, cfg.vocab_size, use_bias=False,
-                               name="lm_head"))
+
+def llama_fuse_params(params, cfg: LlamaConfig):
+    """Convert primitive-layout LLaMA params (``build_llama`` default:
+    ``q_proj_{i}``/``k_proj_{i}``/``v_proj_{i}``/``o_proj_{i}`` dense
+    kernels, the HF import layout) into the fused-attention layout
+    (``attn_{i}``: wq/wk/wv (e, h, d), wo (h, d, e)). Non-attention
+    entries (norms, FFN, embeddings, lm_head) share names and pass
+    through unchanged — so HF-imported weights can serve through the
+    flash/KV-decode path."""
+    import numpy as np
+    nh = cfg.num_heads
+    e = cfg.hidden_size
+    hd = e // nh
+    out = {}
+    fused = {}
+    for i in range(cfg.num_layers):
+        wq = np.asarray(params[f"q_proj_{i}"]["kernel"])
+        wk = np.asarray(params[f"k_proj_{i}"]["kernel"])
+        wv = np.asarray(params[f"v_proj_{i}"]["kernel"])
+        wo = np.asarray(params[f"o_proj_{i}"]["kernel"])
+        fused[f"attn_{i}"] = {
+            "wq": wq.reshape(e, nh, hd),
+            "wk": wk.reshape(e, nh, hd),
+            "wv": wv.reshape(e, nh, hd),
+            "wo": wo.reshape(nh, hd, e),
+        }
+    skip = {f"{p}_proj_{i}" for i in range(cfg.num_layers)
+            for p in ("q", "k", "v", "o")}
+    for name, leaf in params.items():
+        if name not in skip:
+            out[name] = leaf
+    out.update(fused)
+    return out

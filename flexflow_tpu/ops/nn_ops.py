@@ -371,6 +371,21 @@ class EmbeddingOp(OpDef):
 
 
 # ---------------------------------------------------------------------------
+def _apply_rope(x, pos, theta: float):
+    """Rotary position embedding, LLaMA half-split-rotate convention.
+    ``x``: (B, L, h, d) with d even; ``pos``: (L,) absolute indices."""
+    d = x.shape[-1]
+    inv = 1.0 / theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = pos.astype(jnp.float32)[:, None] * inv[None, :]   # (L, d/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)            # (L, d)
+    cos = jnp.cos(emb)[None, :, None, :]
+    sin = jnp.sin(emb)[None, :, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    xf = x.astype(jnp.float32)
+    return (xf * cos + rot.astype(jnp.float32) * sin).astype(x.dtype)
+
+
 @register
 class MultiHeadAttentionOp(OpDef):
     """Multi-head attention (reference ``src/ops/attention.cc`` wraps cuDNN
@@ -451,6 +466,22 @@ class MultiHeadAttentionOp(OpDef):
 
         causal = params.get("causal", False)
         kv_mode = getattr(ctx, "kv_mode", None)
+        if params.get("rope", False):
+            # rotary embeddings applied in-op (LLaMA convention,
+            # half-split rotate) — positions are absolute indices, so
+            # the single decode token rotates at kv_index and the cache
+            # stores already-rotated keys
+            assert causal, "rope is only supported for causal attention"
+            assert qh.shape[1] == kh.shape[1], \
+                "rope=True requires self-attention (Lq == Lk); " \
+                "cross-attention has no single absolute position stream"
+            theta = float(params.get("rope_theta", 10000.0))
+            if kv_mode == "decode":
+                pos = jnp.full((1,), ctx.kv_index, jnp.int32)
+            else:
+                pos = jnp.arange(qh.shape[1], dtype=jnp.int32)
+            qh = _apply_rope(qh, pos, theta)
+            kh = _apply_rope(kh, pos, theta)
         if kv_mode == "prefill":
             # record per-position K/V for incremental decode; padded
             # positions hold garbage but every one is rewritten by the

@@ -1,0 +1,75 @@
+"""Fused-attention LLaMA (build_llama(fused_attention=True)): one
+OP_MULTIHEAD_ATTENTION with in-op RoPE per block instead of the
+primitive dense/batch_matmul/softmax form. Same math (witnessed against
+the primitive build with transferred weights), but eligible for the
+Pallas flash kernel and KV-cache incremental decode."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models import LlamaConfig, build_llama
+from flexflow_tpu.models.nlp import llama_fuse_params
+
+BATCH, SEQ = 2, 16
+
+
+def _cfg():
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.only_data_parallel = True
+    cfg.use_bf16_compute = False      # exact comparison
+    return cfg
+
+
+def _llama(fused):
+    lc = LlamaConfig.tiny()
+    lc.max_position = SEQ
+    ff = FFModel(_cfg())
+    out = build_llama(ff, BATCH, SEQ, lc, fused_attention=fused)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return ff, lc
+
+
+def test_fused_matches_primitive_forward():
+    ff_p, lc = _llama(False)
+    ff_f, _ = _llama(True)
+    # transfer primitive weights into the fused layout
+    host = {k: {w: np.asarray(a) for w, a in d.items()}
+            for k, d in ff_p.params.items()}
+    fused = llama_fuse_params(host, lc)
+    assert set(fused) == set(ff_f.params), \
+        (sorted(fused), sorted(ff_f.params))
+    ff_f.params = {k: {w: np.asarray(v) for w, v in d.items()}
+                   for k, d in fused.items()}
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, lc.vocab_size, size=(BATCH, SEQ)).astype(np.int32)
+    out_p = np.asarray(ff_p.forward({"input_ids": ids}))
+    out_f = np.asarray(ff_f.forward({"input_ids": ids}))
+    np.testing.assert_allclose(out_f, out_p, atol=2e-5, rtol=1e-4)
+
+
+def test_fused_llama_kv_decode():
+    """The fused build is KV-decode eligible and matches its own
+    re-forward oracle."""
+    ff, lc = _llama(True)
+    assert ff._kv_decode_eligible(
+        {t.name for t in ff.graph_inputs}, None)
+    ids = np.zeros((BATCH, SEQ), np.int32)
+    ids[:, :3] = 5
+    kv = np.asarray(ff.generate(ids, 3, 8, kv_cache=True))
+    oracle = np.asarray(ff.generate(ids, 3, 8, kv_cache=False))
+    np.testing.assert_array_equal(kv[:, :11], oracle[:, :11])
+    keys = list(ff.executor._decode_cache)
+    assert any(k[0] == "kv" for k in keys), keys
+
+
+def test_fused_llama_trains():
+    ff, lc = _llama(True)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, lc.vocab_size, size=(BATCH, SEQ)).astype(np.int32)
+    b = {"input_ids": ids, "label": ids}
+    step = ff.executor.make_train_step()
+    losses = [float(np.asarray(ff._run_train_step(step, b)["loss"]))
+              for _ in range(4)]
+    assert all(np.isfinite(x) for x in losses), losses
+    assert losses[-1] < losses[0], losses

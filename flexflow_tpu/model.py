@@ -204,12 +204,18 @@ class FFModel:
                             bias: bool = True, add_bias_kv: bool = False,
                             add_zero_attn: bool = False, causal: bool = False,
                             rope: bool = False, rope_theta: float = 10000.0,
+                            num_kv_heads: int = 0,
                             kernel_initializer=None,
                             name: Optional[str] = None) -> Tensor:
         params = {"embed_dim": embed_dim, "num_heads": num_heads,
                   "kdim": kdim, "vdim": vdim, "dropout": dropout,
                   "bias": bias, "add_bias_kv": add_bias_kv,
                   "add_zero_attn": add_zero_attn, "causal": causal}
+        if num_kv_heads and num_kv_heads != num_heads:
+            # grouped-query attention (LLaMA-2/3 family): kv projections
+            # and the KV cache carry num_kv_heads head groups
+            assert num_heads % num_kv_heads == 0, (num_heads, num_kv_heads)
+            params["num_kv_heads"] = int(num_kv_heads)
         if rope:
             # in-op rotary embeddings (LLaMA family; enables the fused
             # flash-attention and KV-decode paths for RoPE models)

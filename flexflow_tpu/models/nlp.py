@@ -216,6 +216,10 @@ class LlamaConfig:
     intermediate_size: int = 11008
     num_layers: int = 32
     num_heads: int = 32
+    # grouped-query attention (LLaMA-2-70B/LLaMA-3 family); 0 = MHA.
+    # Only the fused_attention build consumes this (the primitive form
+    # predates GQA, like the reference).
+    num_kv_heads: int = 0
     max_position: int = 2048
     rope_theta: float = 10000.0
     rms_eps: float = 1e-6
@@ -289,7 +293,8 @@ def build_llama(ff: FFModel, batch_size: int, seq_len: int,
             x = ff.rms_norm(h, eps=cfg.rms_eps, name=f"input_norm_{i}")
             attn_out = ff.multihead_attention(
                 x, x, x, cfg.hidden_size, nh, bias=False, causal=True,
-                rope=True, rope_theta=cfg.rope_theta, name=f"attn_{i}")
+                rope=True, rope_theta=cfg.rope_theta,
+                num_kv_heads=cfg.num_kv_heads, name=f"attn_{i}")
             h = ff.add(h, attn_out, name=f"attn_res_{i}")
             h = mlp_block(h, i)
         return head(h)
@@ -353,6 +358,10 @@ def llama_fuse_params(params, cfg: LlamaConfig):
     through unchanged — so HF-imported weights can serve through the
     flash/KV-decode path."""
     import numpy as np
+    assert cfg.num_kv_heads in (0, cfg.num_heads), \
+        ("llama_fuse_params converts the MHA primitive layout; a GQA "
+         "target (num_kv_heads < num_heads) has no primitive source — "
+         "load GQA checkpoints into the fused layout directly")
     nh = cfg.num_heads
     e = cfg.hidden_size
     hd = e // nh

@@ -404,19 +404,22 @@ class MultiHeadAttentionOp(OpDef):
     def weights(self, params, in_shapes, in_dtypes):
         e = params["embed_dim"]
         h = params["num_heads"]
+        kvh = params.get("num_kv_heads", 0) or h   # GQA: kv-head groups
         kdim = params.get("kdim", 0) or e
         vdim = params.get("vdim", 0) or e
         # qProjSize == kProjSize == kdim (reference attention.cc:182)
         dt = in_dtypes[0]
         qe, ke, ve = in_shapes[0][-1], in_shapes[1][-1], in_shapes[2][-1]
         ws = [WeightSpec("wq", (qe, h, kdim // h), dt),
-              WeightSpec("wk", (ke, h, kdim // h), dt),
-              WeightSpec("wv", (ve, h, vdim // h), dt),
+              WeightSpec("wk", (ke, kvh, kdim // h), dt),
+              WeightSpec("wv", (ve, kvh, vdim // h), dt),
               WeightSpec("wo", (h, vdim // h, e), dt)]
         if params.get("bias", True):
             ws += [WeightSpec("bq", (h, kdim // h), dt, InitializerType.ZERO),
-                   WeightSpec("bk", (h, kdim // h), dt, InitializerType.ZERO),
-                   WeightSpec("bv", (h, vdim // h), dt, InitializerType.ZERO),
+                   WeightSpec("bk", (kvh, kdim // h), dt,
+                              InitializerType.ZERO),
+                   WeightSpec("bv", (kvh, vdim // h), dt,
+                              InitializerType.ZERO),
                    WeightSpec("bo", (e,), dt, InitializerType.ZERO)]
         return ws
 
@@ -485,11 +488,18 @@ class MultiHeadAttentionOp(OpDef):
         if kv_mode == "prefill":
             # record per-position K/V for incremental decode; padded
             # positions hold garbage but every one is rewritten by the
-            # decode step that first unmasks it
+            # decode step that first unmasks it. GQA caches the kv-head
+            # count (the cache-size win is the point of GQA)
             ctx.new_kv[name] = {"k": kh, "v": vh}
         elif kv_mode == "decode":
             return self._emit_decode(params, weights, ctx, name, qh, kh,
                                      vh, mdt, cdt)
+        # GQA: expand kv-head groups to the query head count for the
+        # attention contraction (cache/weights stay at kvh heads).
+        # qh.shape[2], not params["num_heads"]: under the tp attn role
+        # this code runs inside shard_map with LOCAL head counts
+        kh = self._expand_kv(kh, qh.shape[2])
+        vh = self._expand_kv(vh, qh.shape[2])
         flash_mode = self._flash_mode(ctx)
         if self._flash_enabled(ctx, seq_len=max(qh.shape[1], kh.shape[1])) \
                 and not (causal and qh.shape[1] != kh.shape[1]):
@@ -547,6 +557,15 @@ class MultiHeadAttentionOp(OpDef):
             out = out + weights["bo"].astype(jnp.float32)
         return [out.astype(cdt)]
 
+    @staticmethod
+    def _expand_kv(x, h):
+        """GQA: repeat kv-head groups up to ``h`` query heads
+        ((B, L, kvh, d) -> (B, L, h, d)); identity when kvh == h."""
+        kvh = x.shape[2]
+        if kvh == h:
+            return x
+        return jnp.repeat(x, h // kvh, axis=2)
+
     def _emit_decode(self, params, weights, ctx, name, qh, kh, vh, mdt,
                      cdt):
         """Single-token decode against the KV cache: write this
@@ -563,17 +582,26 @@ class MultiHeadAttentionOp(OpDef):
         v_full = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh, idx,
                                                      axis=1)
         ctx.new_kv[name] = {"k": k_full, "v": v_full}
-        scale = 1.0 / math.sqrt(qh.shape[-1])
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(mdt),
+        # GQA: contract the length-1 query against the cache AT kvh
+        # heads (grouped einsum) — materializing an expanded copy of
+        # the whole cache every step would undo GQA's decode-bandwidth
+        # win. g == 1 reduces to plain MHA.
+        b_, lq_, hq, d_ = qh.shape
+        kvh = k_full.shape[2]
+        g = hq // kvh
+        qg = qh.reshape(b_, lq_, kvh, g, d_)
+        scale = 1.0 / math.sqrt(d_)
+        logits = jnp.einsum("bqkgd,bmkd->bkgqm", qg.astype(mdt),
                             k_full.astype(mdt),
                             preferred_element_type=jnp.float32) * scale
         lk = k_full.shape[1]
-        mask = jnp.arange(lk)[None, None, None, :] <= idx
+        mask = jnp.arange(lk)[None, None, None, None, :] <= idx
         logits = jnp.where(mask, logits, jnp.float32(-1e9))
         probs = jax.nn.softmax(logits, axis=-1)
-        ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(mdt),
+        ctxv = jnp.einsum("bkgqm,bmkd->bqkgd", probs.astype(mdt),
                           v_full.astype(mdt),
                           preferred_element_type=jnp.float32)
+        ctxv = ctxv.reshape(b_, lq_, hq, d_)
         out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(mdt),
                          weights["wo"].astype(mdt),
                          preferred_element_type=jnp.float32)
@@ -585,7 +613,11 @@ class MultiHeadAttentionOp(OpDef):
         b, lq, _ = in_shapes[0]
         lk = in_shapes[1][1]
         e = params["embed_dim"]
-        proj = 2.0 * b * (lq + 2 * lk) * e * e + 2.0 * b * lq * e * e
+        h = params["num_heads"]
+        kv_frac = (params.get("num_kv_heads", 0) or h) / h
+        proj = (2.0 * b * lq * e * e                      # q proj
+                + 2.0 * b * 2 * lk * e * e * kv_frac     # k+v (GQA)
+                + 2.0 * b * lq * e * e)                  # out proj
         attn = 2.0 * b * lq * lk * e * 2
         return proj + attn
 

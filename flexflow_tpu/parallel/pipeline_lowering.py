@@ -224,7 +224,9 @@ def assign_tp_roles(template: Sequence[Layer], tp: int
     from ..ffconst import ActiMode
     for l in template:
         if l.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
-            if l.params["num_heads"] % tp == 0:
+            kvh = l.params.get("num_kv_heads", 0) \
+                or l.params["num_heads"]
+            if l.params["num_heads"] % tp == 0 and kvh % tp == 0:
                 roles[l.name] = "attn"
         elif l.op_type == OperatorType.OP_LINEAR \
                 and l.name not in roles:

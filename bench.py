@@ -174,9 +174,24 @@ def stage_bert(flash: str, searched: bool, budget: int, steps: int,
          "label": rng.integers(0, 2, size=(batch, 1)).astype(np.int32)}
     sps, mfu, flops_step, n_chips, _dt = timed_mfu(ff, b, steps)
     spec = MachineSpec.detect()
+    # resolved kernel choice: "auto" on CPU means the XLA path — the
+    # emitted record must say which kernel actually ran, not the knob.
+    # Mirrors emit()'s full gating: dropout>0 stays on XLA unless the
+    # in-kernel PRNG path is forced with --flash true (nn_ops.py)
+    from flexflow_tpu.ops.nn_ops import MultiHeadAttentionOp
+
+    class _Ctx:
+        config = cfg
+        training = True
+
+    enabled = MultiHeadAttentionOp._flash_enabled(_Ctx, seq_len=seq)
+    dropout_blocks = bcfg.dropout > 0.0 and flash != "true"
+    resolved = "pallas-flash" if (enabled and not dropout_blocks) \
+        else "xla"
     _emit({"sps": round(sps, 3), "mfu": round(mfu, 4),
            "flops_per_step": flops_step, "n_chips": n_chips,
            "search_time_s": round(search_time, 2),
+           "flash_resolved": resolved,
            "generation": spec.generation})
 
 
@@ -297,6 +312,8 @@ def main():
     out["dp_sps"] = dp["sps"]
     out["mfu"] = dp["mfu"]
     out["flash"] = flash_used
+    if "flash_resolved" in dp:
+        out["flash_resolved"] = dp["flash_resolved"]
 
     # -- stage 4: flash-off A/B data point ----------------------------
     if flash_used == "auto" and remaining() > 420:
@@ -327,6 +344,8 @@ def main():
                 out["dp_sps"] = dp2["sps"]
                 out["mfu"] = dp2["mfu"]
                 out["flash"] = flash_used
+                if "flash_resolved" in dp2:
+                    out["flash_resolved"] = dp2["flash_resolved"]
                 out["reprobe"] = "recovered"
                 # the CPU-fallback flash-off point must not sit next to
                 # TPU dp_sps as if same-platform (re-measured below)

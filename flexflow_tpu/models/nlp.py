@@ -349,6 +349,17 @@ def build_llama(ff: FFModel, batch_size: int, seq_len: int,
     return head(h)
 
 
+def _fuse_qkvo(q, k, v, o, e, nh, kvh):
+    """Shared (in, out)-kernel -> fused-attention reshapes: wq/wk/wv
+    (e, heads, hd), wo (nh, hd, e). The single reshape convention for
+    both llama_fuse_params and the HF state-dict loader."""
+    hd = e // nh
+    return {"wq": q.reshape(e, nh, hd),
+            "wk": k.reshape(e, kvh, hd),
+            "wv": v.reshape(e, kvh, hd),
+            "wo": o.reshape(nh, hd, e)}
+
+
 def llama_fuse_params(params, cfg: LlamaConfig):
     """Convert primitive-layout LLaMA params (``build_llama`` default:
     ``q_proj_{i}``/``k_proj_{i}``/``v_proj_{i}``/``o_proj_{i}`` dense
@@ -368,16 +379,11 @@ def llama_fuse_params(params, cfg: LlamaConfig):
     out = {}
     fused = {}
     for i in range(cfg.num_layers):
-        wq = np.asarray(params[f"q_proj_{i}"]["kernel"])
-        wk = np.asarray(params[f"k_proj_{i}"]["kernel"])
-        wv = np.asarray(params[f"v_proj_{i}"]["kernel"])
-        wo = np.asarray(params[f"o_proj_{i}"]["kernel"])
-        fused[f"attn_{i}"] = {
-            "wq": wq.reshape(e, nh, hd),
-            "wk": wk.reshape(e, nh, hd),
-            "wv": wv.reshape(e, nh, hd),
-            "wo": wo.reshape(nh, hd, e),
-        }
+        fused[f"attn_{i}"] = _fuse_qkvo(
+            np.asarray(params[f"q_proj_{i}"]["kernel"]),
+            np.asarray(params[f"k_proj_{i}"]["kernel"]),
+            np.asarray(params[f"v_proj_{i}"]["kernel"]),
+            np.asarray(params[f"o_proj_{i}"]["kernel"]), e, nh, nh)
     skip = {f"{p}_proj_{i}" for i in range(cfg.num_layers)
             for p in ("q", "k", "v", "o")}
     for name, leaf in params.items():
@@ -385,3 +391,65 @@ def llama_fuse_params(params, cfg: LlamaConfig):
             out[name] = leaf
     out.update(fused)
     return out
+
+
+def llama_load_hf_state_dict(state_dict, cfg: LlamaConfig,
+                             fused: bool = False):
+    """Map a HuggingFace ``LlamaForCausalLM`` state dict onto
+    ``build_llama``'s parameter layout (primitive by default; ``fused``
+    produces the fused-attention layout, required for GQA checkpoints
+    where num_kv_heads < num_heads). HF stores Linear weights as
+    (out, in); dense kernels here are (in, out). RoPE carries no
+    weights in either convention, so the mapping is purely structural.
+
+    Values may be torch tensors (CPU) or arrays. Returns the params
+    dict for ``FFModel.params`` (numpy leaves; device placement happens
+    on first use)."""
+    import numpy as np
+
+    def _np(v):
+        try:
+            return np.asarray(v)
+        except Exception:
+            # bf16 torch tensors have no numpy dtype — upcast (params
+            # here are fp32 masters anyway)
+            return v.detach().cpu().float().numpy()
+
+    nh = cfg.num_heads
+    e = cfg.hidden_size
+    hd = e // nh
+    kvh = cfg.num_kv_heads or nh
+    if kvh != nh and not fused:
+        raise ValueError("GQA checkpoints (num_kv_heads < num_heads) "
+                         "need fused=True (the primitive build is "
+                         "MHA-only)")
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    # tie_word_embeddings checkpoints (Llama-3.2-1B/3B class) omit
+    # lm_head.weight — the head shares the embedding matrix
+    lm_w = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+    params = {
+        "embed_tokens": {"kernel": sd["model.embed_tokens.weight"]},
+        "final_norm": {"scale": sd["model.norm.weight"]},
+        "lm_head": {"kernel": lm_w.T},
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        params[f"input_norm_{i}"] = {
+            "scale": sd[p + "input_layernorm.weight"]}
+        params[f"post_norm_{i}"] = {
+            "scale": sd[p + "post_attention_layernorm.weight"]}
+        for proj in ("gate", "up", "down"):
+            params[f"{proj}_proj_{i}"] = {
+                "kernel": sd[p + f"mlp.{proj}_proj.weight"].T}
+        q = sd[p + "self_attn.q_proj.weight"].T        # (e, nh*hd)
+        k = sd[p + "self_attn.k_proj.weight"].T        # (e, kvh*hd)
+        v = sd[p + "self_attn.v_proj.weight"].T
+        o = sd[p + "self_attn.o_proj.weight"].T        # (nh*hd, e)
+        if fused:
+            params[f"attn_{i}"] = _fuse_qkvo(q, k, v, o, e, nh, kvh)
+        else:
+            params[f"q_proj_{i}"] = {"kernel": q}
+            params[f"k_proj_{i}"] = {"kernel": k}
+            params[f"v_proj_{i}"] = {"kernel": v}
+            params[f"o_proj_{i}"] = {"kernel": o}
+    return params

@@ -184,10 +184,15 @@ def stage_bert(flash: str, searched: bool, budget: int, steps: int,
         config = cfg
         training = True
 
+    on_tpu = jax.default_backend() == "tpu"
     enabled = MultiHeadAttentionOp._flash_enabled(_Ctx, seq_len=seq)
-    dropout_blocks = bcfg.dropout > 0.0 and flash != "true"
-    resolved = "pallas-flash" if (enabled and not dropout_blocks) \
-        else "xla"
+    dropout_blocks = bcfg.dropout > 0.0 \
+        and (not on_tpu or flash != "true")
+    if enabled and not dropout_blocks:
+        # off-TPU the kernel runs in (slow) interpret mode — say so
+        resolved = "pallas-flash" if on_tpu else "pallas-interpret"
+    else:
+        resolved = "xla"
     _emit({"sps": round(sps, 3), "mfu": round(mfu, 4),
            "flops_per_step": flops_step, "n_chips": n_chips,
            "search_time_s": round(search_time, 2),

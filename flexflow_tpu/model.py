@@ -873,6 +873,99 @@ class FFModel:
         return fn(self.params, self.state, ids0, jax.random.key(seed),
                   jnp.int32(prompt_len))
 
+    def generate_beam(self, prompt_ids, prompt_len: int,
+                      max_new_tokens: int, num_beams: int = 4,
+                      eos_token_id: int | None = None):
+        """Beam-search decoding over the KV cache (deterministic; no
+        length penalty — scores are summed token log-probs). Requires a
+        KV-decode-eligible graph (see ``_kv_decode_eligible``); beams
+        live on the batch dim (b*K rows), the cache is gathered by beam
+        index each step. Returns the best (batch, seq_len) ids.
+
+        Beyond-reference: the reference has no generation path at all;
+        beam completes the greedy/temperature/top-k/top-p family."""
+        assert self.executor is not None, "call compile() first"
+        ids0 = jnp.asarray(prompt_ids, jnp.int32)
+        b, L = ids0.shape
+        K = int(num_beams)
+        assert K >= 1
+        assert prompt_len >= 1
+        assert prompt_len + max_new_tokens <= L
+        names = {t.name for t in self.graph_inputs}
+        if not self._kv_decode_eligible(names, None):
+            raise ValueError("generate_beam requires a KV-decode-"
+                             "eligible graph (causal fused attention)")
+        ex = self.executor
+        has_pos = "position_ids" in names
+        NEG = jnp.float32(-1e30)
+
+        def decode(params, state, ids0, plen):
+            batch = {"input_ids": ids0}
+            if has_pos:
+                batch["position_ids"] = jnp.tile(
+                    jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
+            _, cache = ex.kv_prefill(params, state, batch)
+            # beams on the batch dim: row r's beams are rows r*K..r*K+K-1
+            ids = jnp.repeat(ids0, K, axis=0)              # (b*K, L)
+            cache = jax.tree.map(lambda a: jnp.repeat(a, K, axis=0),
+                                 cache)
+            # all beams start identical: only beam 0 is live, so the
+            # first step picks the row's top-K distinct tokens
+            scores0 = jnp.tile(jnp.where(jnp.arange(K) == 0, 0.0, NEG),
+                               (b,))                       # (b*K,)
+            done0 = jnp.zeros((b * K,), jnp.bool_)
+
+            def step(carry, i):
+                ids, cache, scores, done = carry
+                cur = plen + i
+                tok = jax.lax.dynamic_slice_in_dim(ids, cur - 1, 1,
+                                                   axis=1)
+                sb = {"input_ids": tok}
+                if has_pos:
+                    sb["position_ids"] = jnp.full((b * K, 1), cur - 1,
+                                                  dtype=jnp.int32)
+                row, cache = ex.kv_decode_step(params, state, sb, cache,
+                                               cur - 1)       # (b*K, V)
+                V = row.shape[-1]
+                logp = jax.nn.log_softmax(row.astype(jnp.float32),
+                                          axis=-1)
+                if eos_token_id is not None:
+                    # a finished beam persists unchanged: only its eos
+                    # continuation is allowed, at zero added cost
+                    eos_only = jnp.where(
+                        jnp.arange(V)[None, :] == eos_token_id, 0.0, NEG)
+                    logp = jnp.where(done[:, None], eos_only, logp)
+                total = scores[:, None] + logp             # (b*K, V)
+                flat = total.reshape(b, K * V)
+                top_s, top_i = jax.lax.top_k(flat, K)      # (b, K)
+                beam = top_i // V                          # source beam
+                token = (top_i % V).astype(jnp.int32)
+                src = (jnp.arange(b)[:, None] * K + beam).reshape(-1)
+                ids = jnp.take(ids, src, axis=0)
+                cache = jax.tree.map(
+                    lambda a: jnp.take(a, src, axis=0), cache)
+                done = jnp.take(done, src, axis=0)
+                scores = top_s.reshape(-1)
+                token = token.reshape(-1)
+                if eos_token_id is not None:
+                    token = jnp.where(done, jnp.int32(eos_token_id),
+                                      token)
+                    done = jnp.logical_or(done,
+                                          token == eos_token_id)
+                ids = jax.lax.dynamic_update_slice_in_dim(
+                    ids, token[:, None], cur, axis=1)
+                return (ids, cache, scores, done), None
+
+            (ids, _, scores, _), _ = jax.lax.scan(
+                step, (ids, cache, scores0, done0),
+                jnp.arange(max_new_tokens))
+            best = jnp.argmax(scores.reshape(b, K), axis=-1)   # (b,)
+            return ids.reshape(b, K, L)[jnp.arange(b), best]
+
+        ck = ("beam", b, L, max_new_tokens, K, eos_token_id)
+        fn = self._decode_cache_get(ck, decode)
+        return fn(self.params, self.state, ids0, jnp.int32(prompt_len))
+
     # decode executables are cached per (shape, steps, sampling params);
     # arbitrary client-supplied floats (temperature/top_p) would grow the
     # cache without bound on a long-running server — LRU-capped

@@ -424,27 +424,41 @@ def llama_load_hf_state_dict(state_dict, cfg: LlamaConfig,
                          "need fused=True (the primitive build is "
                          "MHA-only)")
     sd = {k: _np(v) for k, v in state_dict.items()}
+    consumed = set()
+
+    def take(key):
+        consumed.add(key)
+        return sd[key]
+
     # tie_word_embeddings checkpoints (Llama-3.2-1B/3B class) omit
     # lm_head.weight — the head shares the embedding matrix
-    lm_w = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+    if "lm_head.weight" in sd:
+        lm_w = take("lm_head.weight")
+    else:
+        lm_w = sd["model.embed_tokens.weight"]
     params = {
-        "embed_tokens": {"kernel": sd["model.embed_tokens.weight"]},
-        "final_norm": {"scale": sd["model.norm.weight"]},
+        "embed_tokens": {"kernel": take("model.embed_tokens.weight")},
+        "final_norm": {"scale": take("model.norm.weight")},
         "lm_head": {"kernel": lm_w.T},
     }
+    assert params["embed_tokens"]["kernel"].shape[1] == e, \
+        (params["embed_tokens"]["kernel"].shape, e)
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
         params[f"input_norm_{i}"] = {
-            "scale": sd[p + "input_layernorm.weight"]}
+            "scale": take(p + "input_layernorm.weight")}
         params[f"post_norm_{i}"] = {
-            "scale": sd[p + "post_attention_layernorm.weight"]}
+            "scale": take(p + "post_attention_layernorm.weight")}
         for proj in ("gate", "up", "down"):
             params[f"{proj}_proj_{i}"] = {
-                "kernel": sd[p + f"mlp.{proj}_proj.weight"].T}
-        q = sd[p + "self_attn.q_proj.weight"].T        # (e, nh*hd)
-        k = sd[p + "self_attn.k_proj.weight"].T        # (e, kvh*hd)
-        v = sd[p + "self_attn.v_proj.weight"].T
-        o = sd[p + "self_attn.o_proj.weight"].T        # (nh*hd, e)
+                "kernel": take(p + f"mlp.{proj}_proj.weight").T}
+        q = take(p + "self_attn.q_proj.weight").T      # (e, nh*hd)
+        k = take(p + "self_attn.k_proj.weight").T      # (e, kvh*hd)
+        v = take(p + "self_attn.v_proj.weight").T
+        o = take(p + "self_attn.o_proj.weight").T      # (nh*hd, e)
+        assert q.shape == (e, nh * hd) and k.shape == (e, kvh * hd), \
+            ("checkpoint/config head mismatch", q.shape, k.shape,
+             (e, nh, kvh, hd))
         if fused:
             params[f"attn_{i}"] = _fuse_qkvo(q, k, v, o, e, nh, kvh)
         else:
@@ -452,4 +466,15 @@ def llama_load_hf_state_dict(state_dict, cfg: LlamaConfig,
             params[f"k_proj_{i}"] = {"kernel": k}
             params[f"v_proj_{i}"] = {"kernel": v}
             params[f"o_proj_{i}"] = {"kernel": o}
+    # every checkpoint tensor must have been mapped (buffers like the
+    # legacy rotary inv_freq are recomputed in-op and safely skipped);
+    # silently dropping weights (attention biases, extra layers) would
+    # produce wrong numerics with no signal
+    leftover = [k_ for k_ in sd
+                if k_ not in consumed and "rotary_emb" not in k_]
+    if leftover:
+        raise ValueError(
+            f"unmapped checkpoint tensors {sorted(leftover)[:8]}"
+            f"{'...' if len(leftover) > 8 else ''} — config/architecture "
+            f"mismatch (attention_bias / num_layers / tied embeddings?)")
     return params

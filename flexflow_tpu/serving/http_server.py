@@ -73,11 +73,12 @@ def _make_handler(repo, schedulers):
                     top_k = int(p.get("top_k", 0))
                     top_p = float(p.get("top_p", 1.0))
                     temp = float(p.get("temperature", 0.0))
+                    num_beams = int(p.get("num_beams", 1))
                     if not (0.0 < top_p <= 1.0) or top_k < 0 \
-                            or temp < 0.0:
+                            or temp < 0.0 or num_beams < 1:
                         return self._send(400, {
                             "error": "need 0 < top_p <= 1, top_k >= 0, "
-                                     "temperature >= 0"})
+                                     "temperature >= 0, num_beams >= 1"})
                     out = sess.generate(
                         inputs["input_ids"],
                         prompt_len=int(p["prompt_len"]),
@@ -85,7 +86,7 @@ def _make_handler(repo, schedulers):
                         temperature=temp,
                         seed=int(p.get("seed", 0)),
                         eos_token_id=None if eos is None else int(eos),
-                        top_k=top_k, top_p=top_p)
+                        top_k=top_k, top_p=top_p, num_beams=num_beams)
                     return self._send(200, {"outputs": [{
                         "name": "output_ids", "shape": list(out.shape),
                         "data": np.asarray(out, np.int32)

@@ -70,7 +70,8 @@ class InferenceSession:
                  max_new_tokens: int, temperature: float = 0.0,
                  seed: int = 0,
                  eos_token_id: "int | None" = None,
-                 top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
+                 top_k: int = 0, top_p: float = 1.0,
+                 num_beams: int = 1) -> np.ndarray:
         """Autoregressive decode for causal-LM sessions. Batch is padded
         to the bucket (decode programs cache per bucket inside
         ``FFModel.generate``); the padded rows' outputs are sliced off."""
@@ -87,17 +88,27 @@ class InferenceSession:
                                max_new_tokens, temperature,
                                (seed + (i // cap) * 0x9E3779B1)
                                & 0x7FFFFFFF, eos_token_id,
-                               top_k=top_k, top_p=top_p)
+                               top_k=top_k, top_p=top_p,
+                               num_beams=num_beams)
                  for i in range(0, n, cap)], axis=0)
         bucket = _next_bucket(n, self.buckets)
         if bucket != n:
             pad = np.zeros((bucket - n,) + ids.shape[1:], ids.dtype)
             ids = np.concatenate([ids, pad], axis=0)
         with self._lock:
-            out = self.ff.generate(ids, prompt_len, max_new_tokens,
-                                   temperature=temperature, seed=seed,
-                                   eos_token_id=eos_token_id,
-                                   top_k=top_k, top_p=top_p)
+            if num_beams > 1:
+                # beam search is deterministic: temperature/top-k/top-p
+                # do not apply
+                out = self.ff.generate_beam(ids, prompt_len,
+                                            max_new_tokens,
+                                            num_beams=num_beams,
+                                            eos_token_id=eos_token_id)
+            else:
+                out = self.ff.generate(ids, prompt_len, max_new_tokens,
+                                       temperature=temperature,
+                                       seed=seed,
+                                       eos_token_id=eos_token_id,
+                                       top_k=top_k, top_p=top_p)
         return np.asarray(out)[:n]
 
 

@@ -26,10 +26,10 @@ from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
 from flexflow_tpu.models import LlamaConfig, build_llama
 from flexflow_tpu.models.nlp import llama_load_hf_state_dict
 
-BATCH, SEQ = 2, 32
+BATCH = 2
 
 
-def load_hf(checkpoint: str):
+def load_hf(checkpoint: str, seq: int):
     from transformers import LlamaForCausalLM
     if checkpoint:
         hf = LlamaForCausalLM.from_pretrained(checkpoint)
@@ -41,7 +41,7 @@ def load_hf(checkpoint: str):
         c = HFLlamaConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=128, num_hidden_layers=2,
                           num_attention_heads=4, num_key_value_heads=2,
-                          max_position_embeddings=SEQ,
+                          max_position_embeddings=seq,
                           tie_word_embeddings=False)
         hf = LlamaForCausalLM(c)
     cfg = LlamaConfig(
@@ -50,7 +50,7 @@ def load_hf(checkpoint: str):
         num_layers=c.num_hidden_layers, num_heads=c.num_attention_heads,
         num_kv_heads=(0 if c.num_key_value_heads == c.num_attention_heads
                       else c.num_key_value_heads),
-        max_position=SEQ, rope_theta=getattr(c, "rope_theta", 10000.0),
+        max_position=seq, rope_theta=getattr(c, "rope_theta", 10000.0),
         rms_eps=c.rms_norm_eps)
     return hf, cfg
 
@@ -64,15 +64,20 @@ def main():
     ap.add_argument("--top-p", type=float, default=1.0)
     ap.add_argument("--beams", type=int, default=1)
     ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--oneshot", action="store_true",
+                    help="with --serve: self-check the endpoint then "
+                         "exit instead of serving until interrupted")
     ap.add_argument("--port", type=int, default=8000)
     a = ap.parse_args()
 
-    hf, lc = load_hf(a.checkpoint)
+    plen = 5
+    seq = max(32, plen + a.max_new)      # decode buffer must fit
+    hf, lc = load_hf(a.checkpoint, seq)
     ffcfg = FFConfig()
     ffcfg.batch_size = BATCH
     ffcfg.only_data_parallel = True
     ff = FFModel(ffcfg)
-    out = build_llama(ff, BATCH, SEQ, lc, fused_attention=True)
+    out = build_llama(ff, BATCH, seq, lc, fused_attention=True)
     ff.compile(SGDOptimizer(0.0), "sparse_categorical_crossentropy", [],
                output_tensor=out)
     ff.params = llama_load_hf_state_dict(hf.state_dict(), lc, fused=True)
@@ -81,8 +86,7 @@ def main():
           flush=True)
 
     rng = np.random.default_rng(0)
-    plen = 5
-    ids = np.zeros((BATCH, SEQ), np.int32)
+    ids = np.zeros((BATCH, seq), np.int32)
     ids[:, :plen] = rng.integers(0, lc.vocab_size, (BATCH, plen))
     if a.beams > 1:
         done = np.asarray(ff.generate_beam(ids, plen, a.max_new,
@@ -109,7 +113,8 @@ def main():
                     "datatype": "int32",
                     "data": ids.ravel().tolist()}],
         "parameters": {"prompt_len": plen, "max_new_tokens": a.max_new,
-                       "num_beams": a.beams,
+                       "num_beams": a.beams, "top_k": a.top_k,
+                       "top_p": a.top_p,
                        "temperature": a.temperature}}).encode()
     req = urllib.request.Request(
         f"http://127.0.0.1:{a.port}/v2/models/llama/generate", body,
@@ -120,9 +125,16 @@ def main():
         doc["outputs"][0]["shape"])
     assert (served[:, :plen + a.max_new]
             == done[:, :plen + a.max_new]).all(), "serve != local decode"
-    print("HTTP /generate matches local decode; serving on "
-          f"port {a.port} OK", flush=True)
-    srv.shutdown()
+    print(f"HTTP /generate matches local decode on port {a.port}",
+          flush=True)
+    if a.oneshot:
+        srv.shutdown()
+        return
+    print("serving until interrupted (Ctrl-C) ...", flush=True)
+    try:
+        thread.join()
+    except KeyboardInterrupt:
+        srv.shutdown()
 
 
 if __name__ == "__main__":

@@ -131,6 +131,44 @@ def test_tp_requires_splittable_template():
                    [], output_tensor=out)
 
 
+def test_search_realizes_tp_winner(monkeypatch):
+    """--enable-pipeline-search: when the scorer's winner carries tp>1,
+    _maybe_pipeline must build the (dp, pp, tp) mesh and a strategy
+    whose executor trains."""
+    from flexflow_tpu.search import pipeline_score as ps
+
+    def forced_tp(layers, dmesh, cost_model, microbatches=0):
+        cand = ps.score_pipeline(
+            layers, dmesh.spec, cost_model, 2, dmesh.num_devices,
+            n_microbatches=4, tp=2)
+        assert cand is not None and cand.tp == 2
+        cand.cost = 0.0          # force the win over the sharding search
+        return cand
+
+    # optimizer._maybe_pipeline imports best_pipeline function-locally,
+    # reading the module attribute at call time — patch the module
+    monkeypatch.setattr(ps, "best_pipeline", forced_tp)
+
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.only_data_parallel = False
+    cfg.search_budget = 2
+    cfg.enable_pipeline_search = True
+    g = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                  num_heads=4, max_position=SEQ, dropout=0.0)
+    ff = FFModel(cfg)
+    out = build_gpt2(ff, BATCH, SEQ, g)
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    pipe = ff.executor.pipe
+    assert pipe is not None and pipe.tp_axis is not None
+    assert dict(ff.dmesh.axis_sizes) == {"x0": 2, "x1": 2, "x2": 2}
+    rng = np.random.default_rng(0)
+    b = _batch(g, rng)
+    bm = ff._run_train_step(ff.executor.make_train_step(), b)
+    assert np.isfinite(float(np.asarray(bm["loss"])))
+
+
 def test_assign_tp_roles_rejects_indivisible_heads():
     ff = FFModel(FFConfig())
     x = ff.create_tensor((4, 8, 32), name="x")

@@ -205,6 +205,7 @@ class FFModel:
                             add_zero_attn: bool = False, causal: bool = False,
                             rope: bool = False, rope_theta: float = 10000.0,
                             num_kv_heads: int = 0,
+                            sliding_window: int = 0,
                             kernel_initializer=None,
                             name: Optional[str] = None) -> Tensor:
         params = {"embed_dim": embed_dim, "num_heads": num_heads,
@@ -216,6 +217,12 @@ class FFModel:
             # and the KV cache carry num_kv_heads head groups
             assert num_heads % num_kv_heads == 0, (num_heads, num_kv_heads)
             params["num_kv_heads"] = int(num_kv_heads)
+        if sliding_window:
+            # Mistral-family local attention: queries see the last
+            # `sliding_window` positions only (requires causal)
+            assert causal, "sliding_window requires causal attention"
+            assert sliding_window > 0, sliding_window
+            params["sliding_window"] = int(sliding_window)
         if rope:
             # in-op rotary embeddings (LLaMA family; enables the fused
             # flash-attention and KV-decode paths for RoPE models)

@@ -220,6 +220,9 @@ class LlamaConfig:
     # Only the fused_attention build consumes this (the primitive form
     # predates GQA, like the reference).
     num_kv_heads: int = 0
+    # Mistral-family sliding-window attention; 0 = full causal.
+    # fused_attention only.
+    sliding_window: int = 0
     max_position: int = 2048
     rope_theta: float = 10000.0
     rms_eps: float = 1e-6
@@ -294,11 +297,15 @@ def build_llama(ff: FFModel, batch_size: int, seq_len: int,
             attn_out = ff.multihead_attention(
                 x, x, x, cfg.hidden_size, nh, bias=False, causal=True,
                 rope=True, rope_theta=cfg.rope_theta,
-                num_kv_heads=cfg.num_kv_heads, name=f"attn_{i}")
+                num_kv_heads=cfg.num_kv_heads,
+                sliding_window=cfg.sliding_window, name=f"attn_{i}")
             h = ff.add(h, attn_out, name=f"attn_res_{i}")
             h = mlp_block(h, i)
         return head(h)
 
+    assert not cfg.sliding_window and cfg.num_kv_heads in (0, nh), \
+        ("sliding_window/GQA need fused_attention=True — the primitive "
+         "build predates both and would silently compute full MHA")
     cos_np, sin_np = _rope_tables(s, hd, cfg.rope_theta)
     cos_t = ff.create_tensor(cos_np.shape, create_grad=False,
                              name="rope_cos")

@@ -502,7 +502,10 @@ class MultiHeadAttentionOp(OpDef):
         vh = self._expand_kv(vh, qh.shape[2])
         flash_mode = self._flash_mode(ctx)
         if self._flash_enabled(ctx, seq_len=max(qh.shape[1], kh.shape[1])) \
-                and not (causal and qh.shape[1] != kh.shape[1]):
+                and not (causal and qh.shape[1] != kh.shape[1]) \
+                and not params.get("sliding_window", 0):
+            # (sliding-window masking stays on the XLA path — the Pallas
+            # kernel has no window support)
             # Pallas flash kernel ((b,h,s,d) layout); in-kernel prob dropout
             # only when compiled on TPU — interpret mode falls back to XLA.
             # (causal cross-attention with sq != sk stays on the XLA path.)
@@ -538,7 +541,14 @@ class MultiHeadAttentionOp(OpDef):
                             preferred_element_type=jnp.float32) * scale
         if params.get("causal", False):
             lq, lk = logits.shape[-2], logits.shape[-1]
-            mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), lk - lq)
+            qpos = jnp.arange(lq)[:, None] + (lk - lq)
+            kpos = jnp.arange(lk)[None, :]
+            mask = kpos <= qpos
+            window = params.get("sliding_window", 0)
+            if window:
+                # Mistral-family sliding window: each query attends the
+                # last `window` positions only
+                mask = jnp.logical_and(mask, kpos > qpos - window)
             logits = jnp.where(mask, logits, jnp.float32(-1e9))
         probs = jax.nn.softmax(logits, axis=-1)
         rate = params.get("dropout", 0.0)
@@ -595,7 +605,11 @@ class MultiHeadAttentionOp(OpDef):
                             k_full.astype(mdt),
                             preferred_element_type=jnp.float32) * scale
         lk = k_full.shape[1]
-        mask = jnp.arange(lk)[None, None, None, None, :] <= idx
+        kpos = jnp.arange(lk)[None, None, None, None, :]
+        mask = kpos <= idx
+        window = params.get("sliding_window", 0)
+        if window:
+            mask = jnp.logical_and(mask, kpos > idx - window)
         logits = jnp.where(mask, logits, jnp.float32(-1e9))
         probs = jax.nn.softmax(logits, axis=-1)
         ctxv = jnp.einsum("bkgqm,bmkd->bqkgd", probs.astype(mdt),

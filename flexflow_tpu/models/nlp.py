@@ -223,6 +223,10 @@ class LlamaConfig:
     # Mistral-family sliding-window attention; 0 = full causal.
     # fused_attention only.
     sliding_window: int = 0
+    # Qwen2-family q/k/v projection biases (o_proj stays bias-free in
+    # those checkpoints; the fused op's bo is simply zero).
+    # fused_attention only.
+    attention_bias: bool = False
     max_position: int = 2048
     rope_theta: float = 10000.0
     rms_eps: float = 1e-6
@@ -295,7 +299,8 @@ def build_llama(ff: FFModel, batch_size: int, seq_len: int,
         for i in range(cfg.num_layers):
             x = ff.rms_norm(h, eps=cfg.rms_eps, name=f"input_norm_{i}")
             attn_out = ff.multihead_attention(
-                x, x, x, cfg.hidden_size, nh, bias=False, causal=True,
+                x, x, x, cfg.hidden_size, nh,
+                bias=cfg.attention_bias, causal=True,
                 rope=True, rope_theta=cfg.rope_theta,
                 num_kv_heads=cfg.num_kv_heads,
                 sliding_window=cfg.sliding_window, name=f"attn_{i}")
@@ -303,9 +308,11 @@ def build_llama(ff: FFModel, batch_size: int, seq_len: int,
             h = mlp_block(h, i)
         return head(h)
 
-    assert not cfg.sliding_window and cfg.num_kv_heads in (0, nh), \
-        ("sliding_window/GQA need fused_attention=True — the primitive "
-         "build predates both and would silently compute full MHA")
+    assert not cfg.sliding_window and not cfg.attention_bias \
+        and cfg.num_kv_heads in (0, nh), \
+        ("sliding_window/GQA/attention_bias need fused_attention=True — "
+         "the primitive build predates them and would silently compute "
+         "plain full MHA")
     cos_np, sin_np = _rope_tables(s, hd, cfg.rope_theta)
     cos_t = ff.create_tensor(cos_np.shape, create_grad=False,
                              name="rope_cos")
@@ -426,10 +433,10 @@ def llama_load_hf_state_dict(state_dict, cfg: LlamaConfig,
     e = cfg.hidden_size
     hd = e // nh
     kvh = cfg.num_kv_heads or nh
-    if kvh != nh and not fused:
-        raise ValueError("GQA checkpoints (num_kv_heads < num_heads) "
-                         "need fused=True (the primitive build is "
-                         "MHA-only)")
+    if (kvh != nh or cfg.attention_bias) and not fused:
+        raise ValueError("GQA / attention-bias checkpoints need "
+                         "fused=True (the primitive build is plain "
+                         "bias-free MHA)")
     sd = {k: _np(v) for k, v in state_dict.items()}
     consumed = set()
 
@@ -467,7 +474,18 @@ def llama_load_hf_state_dict(state_dict, cfg: LlamaConfig,
             ("checkpoint/config head mismatch", q.shape, k.shape,
              (e, nh, kvh, hd))
         if fused:
-            params[f"attn_{i}"] = _fuse_qkvo(q, k, v, o, e, nh, kvh)
+            attn = _fuse_qkvo(q, k, v, o, e, nh, kvh)
+            if cfg.attention_bias:
+                # Qwen2 family: q/k/v carry biases, o_proj does not —
+                # the fused op's bo is present but zero
+                attn["bq"] = take(
+                    p + "self_attn.q_proj.bias").reshape(nh, hd)
+                attn["bk"] = take(
+                    p + "self_attn.k_proj.bias").reshape(kvh, hd)
+                attn["bv"] = take(
+                    p + "self_attn.v_proj.bias").reshape(kvh, hd)
+                attn["bo"] = np.zeros((e,), attn["wq"].dtype)
+            params[f"attn_{i}"] = attn
         else:
             params[f"q_proj_{i}"] = {"kernel": q}
             params[f"k_proj_{i}"] = {"kernel": k}

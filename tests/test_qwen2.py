@@ -1,0 +1,77 @@
+"""Qwen2-family support: LLaMA architecture + q/k/v projection biases
+(+ GQA, tied embeddings). HF Qwen2ForCausalLM imports through the same
+state-dict map; softmax parity + KV decode checked."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+pytest.importorskip("transformers")
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models import LlamaConfig, build_llama
+from flexflow_tpu.models.nlp import llama_load_hf_state_dict
+
+BATCH, SEQ = 2, 12
+
+
+def _hf_qwen2():
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+    torch.manual_seed(0)
+    cfg = Qwen2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=SEQ,
+        # tied embeddings: the real small Qwen2 checkpoints (0.5B/1.5B)
+        # ship without lm_head.weight — exercises the loader fallback
+        rms_norm_eps=1e-6, tie_word_embeddings=True,
+        # tiny seq never reaches Qwen2's default 32k window
+        sliding_window=None, use_sliding_window=False)
+    return Qwen2ForCausalLM(cfg).eval()
+
+
+def _ff_model():
+    lc = LlamaConfig.tiny()
+    lc.max_position = SEQ
+    lc.num_kv_heads = 2
+    lc.attention_bias = True
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.only_data_parallel = True
+    cfg.use_bf16_compute = False
+    ff = FFModel(cfg)
+    out = build_llama(ff, BATCH, SEQ, lc, fused_attention=True)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return ff, lc
+
+
+def test_hf_qwen2_parity_and_decode():
+    hf = _hf_qwen2()
+    ff, lc = _ff_model()
+    ff.params = llama_load_hf_state_dict(hf.state_dict(), lc, fused=True)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 96, size=(BATCH, SEQ)).astype(np.int32)
+    probs = np.asarray(ff.forward({"input_ids": ids}))
+    with torch.no_grad():
+        hf_probs = torch.softmax(
+            hf(torch.from_numpy(ids).long()).logits, dim=-1).numpy()
+    assert np.abs(probs - hf_probs).max() < 2e-4
+    # greedy decode matches HF generate (exercises biases through the
+    # KV-cache path)
+    prompt = np.zeros((1, SEQ), np.int32)
+    prompt[0, :4] = ids[0, :4]
+    ours = np.asarray(ff.generate(prompt, 4, 5))[0, :9]
+    with torch.no_grad():
+        theirs = hf.generate(torch.from_numpy(prompt[:, :4]).long(),
+                             max_new_tokens=5, do_sample=False).numpy()[0]
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_bias_checkpoint_rejected_without_fused():
+    hf = _hf_qwen2()
+    lc = LlamaConfig.tiny()
+    lc.max_position = SEQ
+    lc.num_kv_heads = 2
+    lc.attention_bias = True
+    with pytest.raises(ValueError, match="fused=True"):
+        llama_load_hf_state_dict(hf.state_dict(), lc, fused=False)

@@ -319,6 +319,17 @@ class FFModel:
     def max(self, x, y, name=None):
         return self._binary(OperatorType.OP_EW_MAX, x, y, name)
 
+    def equal(self, x, y, name=None):
+        """Elementwise equality (DT_BOOLEAN output, broadcasting) —
+        reference OP_EW_EQUAL (onnx Equal)."""
+        return self._binary(OperatorType.OP_EW_EQUAL, x, y, name)
+
+    def greater(self, x, y, name=None):
+        return self._binary(OperatorType.OP_EW_GREATER, x, y, name)
+
+    def less(self, x, y, name=None):
+        return self._binary(OperatorType.OP_EW_LESS, x, y, name)
+
     def min(self, x, y, name=None):
         return self._binary(OperatorType.OP_EW_MIN, x, y, name)
 

@@ -31,8 +31,16 @@ SLOW = [
     ("mnist_mlp.py", ["-b", "16", "--budget", "4"]),
 ]
 
+# examples with their own success marker instead of a samples/s line
+SLOW_MARKED = [
+    ("llama_serve_hf.py", ["--beams", "2", "--serve", "--oneshot"],
+     "matches local decode"),
+    ("decode_bench.py", ["--seq", "96", "--hidden", "64", "--layers", "2"],
+     "incremental ms/token"),
+]
 
-def _run(script, args):
+
+def _run(script, args, expect="samples/s"):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
@@ -44,7 +52,7 @@ def _run(script, args):
         [sys.executable, script] + args, cwd=EXAMPLES, env=env,
         capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, f"{script}: {r.stdout}\n{r.stderr}"
-    assert "samples/s" in r.stdout, r.stdout
+    assert expect in r.stdout, r.stdout
 
 
 @pytest.mark.parametrize("script,args", FAST,
@@ -58,3 +66,10 @@ def test_example_fast(script, args):
                          ids=[f"{s}-{i}" for i, (s, _) in enumerate(SLOW)])
 def test_example_slow(script, args):
     _run(script, args)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script,args,expect", SLOW_MARKED,
+                         ids=[s for s, _, _ in SLOW_MARKED])
+def test_example_slow_marked(script, args, expect):
+    _run(script, args, expect)

@@ -80,6 +80,12 @@ class FFConfig:
     # (dp x pp x tp composition; the reference composes per-op machine
     # views the same way, substitution.cc:1898)
     pipeline_tp: int = 1
+    # direct dp x tp (x sp) preset WITHOUT a pipeline or a search:
+    # --tp N applies transformer_strategy (Megatron column/row sharding
+    # over a size-N mesh axis); --sp additionally shards the sequence
+    # dim (ring/Ulysses-style context parallelism via GSPMD)
+    tensor_parallel: int = 1
+    sequence_parallel: bool = False
     # ZeRO-1: shard optimizer moments over the replicated mesh axes
     # (runtime/zero.py); the reference keeps full state per replica
     shard_optimizer_states: bool = False
@@ -257,6 +263,10 @@ class FFConfig:
                 cfg.pipeline_chunks = int(take())
             elif a in ("--pp-tp", "--pipeline-tp"):
                 cfg.pipeline_tp = int(take())
+            elif a in ("--tp", "--tensor-parallel"):
+                cfg.tensor_parallel = int(take())
+            elif a in ("--sp", "--sequence-parallel"):
+                cfg.sequence_parallel = True
             elif a == "--bf16-activations":
                 cfg.bf16_activations = True
             elif a in ("--zero", "--shard-optimizer-states"):

@@ -576,6 +576,48 @@ class FFModel:
             self.config.search_budget = search_budget
 
         exec_layers, exec_outputs = self.layers, [self._output_tensor]
+        tp_deg = max(self.config.tensor_parallel, 1)
+        if self.config.sequence_parallel and tp_deg <= 1:
+            raise ValueError(
+                "--sp requires --tp N (N > 1): the sequence dim is "
+                "sharded over the tensor-parallel axes")
+        if tp_deg > 1 and pp > 1:
+            raise ValueError(
+                "--tp does not compose with --pp directly; use --pp-tp "
+                "for Megatron tp inside pipeline stages")
+        if strategy is None and tp_deg > 1:
+            # --tp/--sp: the Megatron dp x tp (x sp) preset directly,
+            # no search (reference --enable-parameter-parallel analog
+            # made a first-class mode). An existing mesh (explicit
+            # --mesh-shape or the machine file's ICI shape) is kept and
+            # validated; otherwise a (dp, tp) mesh is built.
+            from .parallel.presets import transformer_strategy
+            nd = self.dmesh.num_devices
+            assert nd % tp_deg == 0, \
+                f"--tp {tp_deg} does not divide {nd} devices"
+            if mesh_shape is None:
+                self.dmesh = DeviceMesh(
+                    spec, mesh_shape=tuple(
+                        d for d in (nd // tp_deg, tp_deg) if d > 1))
+            axes = self.dmesh.axis_names
+            # trailing axes must realize EXACTLY the requested degree
+            tp_axes: list = []
+            prod = 1
+            for ax in reversed(axes):
+                if prod == tp_deg:
+                    break
+                tp_axes.insert(0, ax)
+                prod *= self.dmesh.axis_sizes[ax]
+            if prod != tp_deg:
+                raise ValueError(
+                    f"--tp {tp_deg} not realizable from the trailing "
+                    f"axes of mesh {dict(self.dmesh.axis_sizes)} "
+                    f"(they give {prod}); pass a compatible --mesh-shape")
+            dp_axes = tuple(a for a in axes if a not in tp_axes)
+            strategy = transformer_strategy(
+                self.layers, self.input_tensors, self.dmesh,
+                dp_axes=dp_axes, tp_axes=tuple(tp_axes),
+                sp=self.config.sequence_parallel)
         if strategy is None and pp > 1:
             # pipeline through the product path (reference reserves
             # OP_PIPELINE, ffconst.h:159, without implementing it);

@@ -613,13 +613,16 @@ class Executor:
             return capture[self._logits_tensor.guid]
         return jnp.log(jnp.clip(outs[0], 1e-20))
 
-    def kv_prefill(self, params, state, batch):
+    def kv_prefill(self, params, state, batch, prefill_len=None):
         """Full-sequence forward that also returns every causal
         attention layer's K/V buffers (the decode cache seed) plus the
-        scores. NOT jitted."""
+        scores. ``prefill_len`` (traced) marks how many leading
+        positions are real prompt — sliding-window layers use it to
+        seed their O(window) ring-buffer cache. NOT jitted."""
         ctx = EmitCtx(training=False, rngs={}, state=state,
                       config=self.config)
         ctx.kv_mode = "prefill"
+        ctx.kv_prefill_len = prefill_len
         capture: Dict[int, Any] = {}
         outs = self.program.emit(params, batch, ctx, self.strategy,
                                  capture)

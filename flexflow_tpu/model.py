@@ -901,7 +901,8 @@ class FFModel:
             if has_pos:
                 batch["position_ids"] = jnp.tile(
                     jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
-            _, cache = ex.kv_prefill(params, state, batch)
+            _, cache = ex.kv_prefill(params, state, batch,
+                                     prefill_len=plen)
             done0 = jnp.zeros((b,), jnp.bool_)
 
             def step(carry, i):
@@ -964,7 +965,8 @@ class FFModel:
             if has_pos:
                 batch["position_ids"] = jnp.tile(
                     jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
-            _, cache = ex.kv_prefill(params, state, batch)
+            _, cache = ex.kv_prefill(params, state, batch,
+                                     prefill_len=plen)
             # beams on the batch dim: row r's beams are rows r*K..r*K+K-1
             ids = jnp.repeat(ids0, K, axis=0)              # (b*K, L)
             cache = jax.tree.map(lambda a: jnp.repeat(a, K, axis=0),

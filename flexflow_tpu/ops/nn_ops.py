@@ -489,8 +489,29 @@ class MultiHeadAttentionOp(OpDef):
             # record per-position K/V for incremental decode; padded
             # positions hold garbage but every one is rewritten by the
             # decode step that first unmasks it. GQA caches the kv-head
-            # count (the cache-size win is the point of GQA)
-            ctx.new_kv[name] = {"k": kh, "v": vh}
+            # count (the cache-size win is the point of GQA).
+            W = params.get("sliding_window", 0)
+            plen = getattr(ctx, "kv_prefill_len", None)
+            if W and plen is not None and W < kh.shape[1]:
+                # sliding window: ring-buffer cache of W slots (position
+                # p lives at slot p % W) + a position track for masking —
+                # O(window) HBM instead of O(max_seq). Slot s seeds with
+                # the largest prompt position ≡ s (mod W); slots no
+                # prompt position reached carry pos -inf (masked).
+                L = kh.shape[1]
+                s_idx = jnp.arange(W)
+                pstar = plen - 1 - jnp.mod(plen - 1 - s_idx, W)
+                valid = pstar >= 0
+                gather = jnp.clip(pstar, 0, L - 1)
+                pos = jnp.where(valid, pstar, -(10 ** 9))
+                ctx.new_kv[name] = {
+                    "k": jnp.take(kh, gather, axis=1),
+                    "v": jnp.take(vh, gather, axis=1),
+                    "pos": jnp.broadcast_to(pos[None, :],
+                                            (kh.shape[0], W)),
+                }
+            else:
+                ctx.new_kv[name] = {"k": kh, "v": vh}
         elif kv_mode == "decode":
             return self._emit_decode(params, weights, ctx, name, qh, kh,
                                      vh, mdt, cdt)
@@ -587,11 +608,25 @@ class MultiHeadAttentionOp(OpDef):
             "KV-cache decode requires causal self-attention"
         cache = ctx.kv_cache[name]
         idx = ctx.kv_index
-        k_full = jax.lax.dynamic_update_slice_in_dim(cache["k"], kh, idx,
-                                                     axis=1)
-        v_full = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh, idx,
-                                                     axis=1)
+        ring = "pos" in cache
+        if ring:
+            # sliding-window ring buffer: write slot idx % W, track the
+            # stored position for the validity mask
+            W = cache["k"].shape[1]
+            slot = jnp.mod(idx, W)
+            b_ = kh.shape[0]
+            pos = jax.lax.dynamic_update_slice_in_dim(
+                cache["pos"], jnp.full((b_, 1), idx, cache["pos"].dtype),
+                slot, axis=1)
+        else:
+            slot = idx
+        k_full = jax.lax.dynamic_update_slice_in_dim(cache["k"], kh,
+                                                     slot, axis=1)
+        v_full = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh,
+                                                     slot, axis=1)
         ctx.new_kv[name] = {"k": k_full, "v": v_full}
+        if ring:
+            ctx.new_kv[name]["pos"] = pos
         # GQA: contract the length-1 query against the cache AT kvh
         # heads (grouped einsum) — materializing an expanded copy of
         # the whole cache every step would undo GQA's decode-bandwidth
@@ -604,12 +639,17 @@ class MultiHeadAttentionOp(OpDef):
         logits = jnp.einsum("bqkgd,bmkd->bkgqm", qg.astype(mdt),
                             k_full.astype(mdt),
                             preferred_element_type=jnp.float32) * scale
-        lk = k_full.shape[1]
-        kpos = jnp.arange(lk)[None, None, None, None, :]
-        mask = kpos <= idx
         window = params.get("sliding_window", 0)
-        if window:
-            mask = jnp.logical_and(mask, kpos > idx - window)
+        if ring:
+            # slot positions carry the mask (invalid slots hold -1e9)
+            p = pos[:, None, None, None, :]
+            mask = jnp.logical_and(p <= idx, p > idx - window)
+        else:
+            lk = k_full.shape[1]
+            kpos = jnp.arange(lk)[None, None, None, None, :]
+            mask = kpos <= idx
+            if window:
+                mask = jnp.logical_and(mask, kpos > idx - window)
         logits = jnp.where(mask, logits, jnp.float32(-1e9))
         probs = jax.nn.softmax(logits, axis=-1)
         ctxv = jnp.einsum("bkgqm,bmkd->bqkgd", probs.astype(mdt),

@@ -41,9 +41,13 @@ class EmitCtx:
         # attention layer's per-position K/V into new_kv, "decode" =
         # single-token forward reading kv_cache and writing the updated
         # buffers to new_kv. kv_index = the (traced) query position.
+        # kv_prefill_len = (traced) count of real prompt positions in
+        # the prefill batch — sliding-window layers seed their
+        # O(window) ring-buffer cache from it.
         self.kv_mode: Optional[str] = None
         self.kv_cache: Optional[Dict[str, Any]] = None
         self.kv_index: Any = None
+        self.kv_prefill_len: Any = None
         self.new_kv: Dict[str, Any] = {}
 
     def rng_for(self, name: str):

@@ -813,7 +813,7 @@ class FFModel:
         self._last_fwd = fwd(self.params, self.state, batch)
         return self._last_fwd
 
-    def generate(self, prompt_ids, prompt_len: int,
+    def generate(self, prompt_ids, prompt_len: "int | np.ndarray",
                  max_new_tokens: int, temperature: float = 0.0,
                  seed: int = 0, extra_inputs=None,
                  eos_token_id: int | None = None,
@@ -824,7 +824,9 @@ class FFModel:
         its Triton backend serves fixed forwards only).
 
         ``prompt_ids``: (batch, seq_len) int32, the prompt in columns
-        [0, prompt_len) and anything (e.g. zeros) after. ``temperature``
+        [0, prompt_len) and anything (e.g. zeros) after. ``prompt_len``
+        may be a (batch,) int array for RAGGED prompts — each row
+        decodes from its own length (the batched-serving case). ``temperature``
         0 = greedy argmax, > 0 = sampling from the pre-softmax logits
         (numerically exact — no re-log of already-softmaxed probs).
         ``eos_token_id``: rows that emit it keep emitting it for the
@@ -841,10 +843,19 @@ class FFModel:
         assert self.executor is not None, "call compile() first"
         ids0 = jnp.asarray(prompt_ids, jnp.int32)
         b, L = ids0.shape
-        assert prompt_len >= 1, \
-            "prompt_len must be >= 1 (the first token conditions decode)"
-        assert prompt_len + max_new_tokens <= L, \
-            (prompt_len, max_new_tokens, L)
+        if np.ndim(prompt_len) > 0:
+            # ragged prompts: one length per batch row
+            prompt_len = np.asarray(prompt_len, np.int32)
+            assert prompt_len.shape == (b,), (prompt_len.shape, b)
+            assert (prompt_len >= 1).all() and \
+                (prompt_len + max_new_tokens <= L).all(), \
+                (prompt_len, max_new_tokens, L)
+        else:
+            assert prompt_len >= 1, \
+                "prompt_len must be >= 1 (the first token conditions " \
+                "decode)"
+            assert prompt_len + max_new_tokens <= L, \
+                (prompt_len, max_new_tokens, L)
         names = {t.name for t in self.graph_inputs}
         fixed = {k: jnp.asarray(v)
                  for k, v in (extra_inputs or {}).items()}
@@ -895,32 +906,36 @@ class FFModel:
         ex = self.executor
         b, L = ids0.shape
         has_pos = "position_ids" in {t.name for t in self.graph_inputs}
+        ragged = np.ndim(prompt_len) > 0
 
         def decode(params, state, ids0, key0, plen):
             batch = {"input_ids": ids0}
             if has_pos:
                 batch["position_ids"] = jnp.tile(
                     jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
+            # ragged prompts keep the full cache (the ring-buffer seed
+            # needs one shared prompt length); masks stay per-row exact
             _, cache = ex.kv_prefill(params, state, batch,
-                                     prefill_len=plen)
+                                     prefill_len=None if ragged else plen)
             done0 = jnp.zeros((b,), jnp.bool_)
 
             def step(carry, i):
                 ids, cache, key, done = carry
-                cur = plen + i                # index being generated
-                tok = jax.lax.dynamic_slice_in_dim(ids, cur - 1, 1,
-                                                   axis=1)
+                cur = plen + i         # index being generated; (B,) when
+                tok = self._read_token_row(ids, cur, ragged)
+                if ragged:             # prompts are ragged
+                    pos_in = (cur - 1)[:, None].astype(jnp.int32)
+                else:
+                    pos_in = jnp.full((b, 1), cur - 1, dtype=jnp.int32)
                 sb = {"input_ids": tok}
                 if has_pos:
-                    sb["position_ids"] = jnp.full((b, 1), cur - 1,
-                                                  dtype=jnp.int32)
+                    sb["position_ids"] = pos_in
                 row, cache = ex.kv_decode_step(params, state, sb, cache,
                                                cur - 1)
                 key, nxt, done = self._sample_next(row, key, temperature,
                                                    eos_token_id, done,
                                                    top_k, top_p)
-                ids = jax.lax.dynamic_update_slice_in_dim(
-                    ids, nxt[:, None], cur, axis=1)
+                ids = self._write_token(ids, nxt, cur, ragged)
                 return (ids, cache, key, done), nxt
 
             (ids, _, _, _), _ = jax.lax.scan(
@@ -929,10 +944,10 @@ class FFModel:
             return ids
 
         ck = ("kv", b, L, max_new_tokens, float(temperature),
-              eos_token_id, int(top_k), float(top_p))
+              eos_token_id, int(top_k), float(top_p), ragged)
         fn = self._decode_cache_get(ck, decode)
         return fn(self.params, self.state, ids0, jax.random.key(seed),
-                  jnp.int32(prompt_len))
+                  jnp.asarray(prompt_len, jnp.int32))
 
     def generate_beam(self, prompt_ids, prompt_len: int,
                       max_new_tokens: int, num_beams: int = 4,
@@ -950,6 +965,10 @@ class FFModel:
         b, L = ids0.shape
         K = int(num_beams)
         assert K >= 1
+        if np.ndim(prompt_len) > 0:
+            raise ValueError("generate_beam needs one scalar prompt_len "
+                             "(per-row prompt lengths are unsupported "
+                             "for beam search)")
         assert prompt_len >= 1
         assert prompt_len + max_new_tokens <= L
         names = {t.name for t in self.graph_inputs}
@@ -1046,6 +1065,28 @@ class FFModel:
             cache.popitem(last=False)
         return fn
 
+    @staticmethod
+    def _read_token_row(arr, cur, ragged):
+        """Row at position cur-1 per batch row: (B, ...) gather that
+        works for scalar cur (shared position) and (B,) cur (ragged)."""
+        if ragged:
+            if arr.ndim == 2:      # ids (B, L)
+                return jnp.take_along_axis(arr, (cur - 1)[:, None],
+                                           axis=1)
+            gidx = jnp.broadcast_to((cur - 1)[:, None, None],
+                                    (arr.shape[0], 1, arr.shape[-1]))
+            return jnp.take_along_axis(arr, gidx, axis=1)
+        return jax.lax.dynamic_slice_in_dim(arr, cur - 1, 1, axis=1)
+
+    @staticmethod
+    def _write_token(ids, nxt, cur, ragged):
+        """Write nxt at column cur (per-row when ragged)."""
+        if ragged:
+            sel = jnp.arange(ids.shape[1])[None, :] == cur[:, None]
+            return jnp.where(sel, nxt[:, None], ids)
+        return jax.lax.dynamic_update_slice_in_dim(ids, nxt[:, None],
+                                                   cur, axis=1)
+
     def _sample_next(self, row, key, temperature, eos_token_id, done,
                      top_k: int = 0, top_p: float = 1.0):
         """Shared sampling step: ``row`` is (B, V) log-domain scores
@@ -1092,6 +1133,7 @@ class FFModel:
         guarantees positions < t ignore columns >= t."""
         ex = self.executor
         b, L = ids0.shape
+        ragged = np.ndim(prompt_len) > 0
 
         def decode(params, state, ids0, key0, fixed, plen):
             done0 = jnp.zeros((b,), jnp.bool_)
@@ -1101,13 +1143,11 @@ class FFModel:
                 scores = ex.scored_forward(params, state,
                                            {"input_ids": ids, **fixed})
                 cur = plen + i                # index being generated
-                row = jax.lax.dynamic_slice_in_dim(scores, cur - 1, 1,
-                                                   axis=1)[:, 0, :]
+                row = self._read_token_row(scores, cur, ragged)[:, 0, :]
                 key, nxt, done = self._sample_next(row, key, temperature,
                                                    eos_token_id, done,
                                                    top_k, top_p)
-                ids = jax.lax.dynamic_update_slice_in_dim(
-                    ids, nxt[:, None], cur, axis=1)
+                ids = self._write_token(ids, nxt, cur, ragged)
                 return (ids, key, done), nxt
 
             (ids, _, _), _ = jax.lax.scan(
@@ -1119,11 +1159,11 @@ class FFModel:
         # traffic with varying prompt lengths reuses one compiled
         # program per shape
         ck = ("fwd", b, L, max_new_tokens, float(temperature),
-              eos_token_id, int(top_k), float(top_p),
+              eos_token_id, int(top_k), float(top_p), ragged,
               tuple(sorted(fixed)))
         fn = self._decode_cache_get(ck, decode)
         return fn(self.params, self.state, ids0, jax.random.key(seed),
-                  fixed, jnp.int32(prompt_len))
+                  fixed, jnp.asarray(prompt_len, jnp.int32))
 
     def zero_gradients(self):
         pass  # grads are recomputed functionally each step

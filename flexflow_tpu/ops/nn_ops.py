@@ -373,13 +373,17 @@ class EmbeddingOp(OpDef):
 # ---------------------------------------------------------------------------
 def _apply_rope(x, pos, theta: float):
     """Rotary position embedding, LLaMA half-split-rotate convention.
-    ``x``: (B, L, h, d) with d even; ``pos``: (L,) absolute indices."""
+    ``x``: (B, L, h, d) with d even; ``pos``: (L,) absolute indices
+    shared by the batch, or (B, L) per-row (ragged-prompt decode)."""
     d = x.shape[-1]
     inv = 1.0 / theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    freqs = pos.astype(jnp.float32)[:, None] * inv[None, :]   # (L, d/2)
-    emb = jnp.concatenate([freqs, freqs], axis=-1)            # (L, d)
-    cos = jnp.cos(emb)[None, :, None, :]
-    sin = jnp.sin(emb)[None, :, None, :]
+    pf = pos.astype(jnp.float32)
+    if pf.ndim == 1:
+        pf = pf[None, :]                                # (1, L)
+    freqs = pf[:, :, None] * inv[None, None, :]         # (B|1, L, d/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)      # (B|1, L, d)
+    cos = jnp.cos(emb)[:, :, None, :]
+    sin = jnp.sin(emb)[:, :, None, :]
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     rot = jnp.concatenate([-x2, x1], axis=-1)
     xf = x.astype(jnp.float32)
@@ -480,7 +484,9 @@ class MultiHeadAttentionOp(OpDef):
                 "cross-attention has no single absolute position stream"
             theta = float(params.get("rope_theta", 10000.0))
             if kv_mode == "decode":
-                pos = jnp.full((1,), ctx.kv_index, jnp.int32)
+                kvi = jnp.asarray(ctx.kv_index)
+                # scalar index -> (1,); per-row (ragged prompts) -> (B,1)
+                pos = kvi[:, None] if kvi.ndim else kvi[None]
             else:
                 pos = jnp.arange(qh.shape[1], dtype=jnp.int32)
             qh = _apply_rope(qh, pos, theta)
@@ -607,8 +613,12 @@ class MultiHeadAttentionOp(OpDef):
         assert params.get("causal", False), \
             "KV-cache decode requires causal self-attention"
         cache = ctx.kv_cache[name]
-        idx = ctx.kv_index
+        idx = jnp.asarray(ctx.kv_index)
+        ragged = idx.ndim == 1            # per-row positions (B,)
         ring = "pos" in cache
+        assert not (ring and ragged), \
+            "ragged prompts use the full cache (generate passes " \
+            "prefill_len=None for vector prompt lengths)"
         if ring:
             # sliding-window ring buffer: write slot idx % W, track the
             # stored position for the validity mask
@@ -620,10 +630,19 @@ class MultiHeadAttentionOp(OpDef):
                 slot, axis=1)
         else:
             slot = idx
-        k_full = jax.lax.dynamic_update_slice_in_dim(cache["k"], kh,
-                                                     slot, axis=1)
-        v_full = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh,
-                                                     slot, axis=1)
+        if ragged:
+            # one-hot write at each row's own position
+            sel = (jnp.arange(cache["k"].shape[1])[None, :]
+                   == idx[:, None])[:, :, None, None]
+            k_full = jnp.where(sel, kh.astype(cache["k"].dtype),
+                               cache["k"])
+            v_full = jnp.where(sel, vh.astype(cache["v"].dtype),
+                               cache["v"])
+        else:
+            k_full = jax.lax.dynamic_update_slice_in_dim(cache["k"], kh,
+                                                         slot, axis=1)
+            v_full = jax.lax.dynamic_update_slice_in_dim(cache["v"], vh,
+                                                         slot, axis=1)
         ctx.new_kv[name] = {"k": k_full, "v": v_full}
         if ring:
             ctx.new_kv[name]["pos"] = pos
@@ -647,9 +666,11 @@ class MultiHeadAttentionOp(OpDef):
         else:
             lk = k_full.shape[1]
             kpos = jnp.arange(lk)[None, None, None, None, :]
-            mask = kpos <= idx
+            # scalar idx broadcasts; ragged (B,) idx masks per row
+            iq = idx[:, None, None, None, None] if ragged else idx
+            mask = kpos <= iq
             if window:
-                mask = jnp.logical_and(mask, kpos > idx - window)
+                mask = jnp.logical_and(mask, kpos > iq - window)
         logits = jnp.where(mask, logits, jnp.float32(-1e9))
         probs = jax.nn.softmax(logits, axis=-1)
         ctxv = jnp.einsum("bkgqm,bmkd->bqkgd", probs.astype(mdt),

@@ -79,9 +79,11 @@ def _make_handler(repo, schedulers):
                         return self._send(400, {
                             "error": "need 0 < top_p <= 1, top_k >= 0, "
                                      "temperature >= 0, num_beams >= 1"})
+                    pl = p["prompt_len"]
                     out = sess.generate(
                         inputs["input_ids"],
-                        prompt_len=int(p["prompt_len"]),
+                        prompt_len=(np.asarray(pl, np.int32)
+                                    if isinstance(pl, list) else int(pl)),
                         max_new_tokens=int(p["max_new_tokens"]),
                         temperature=temp,
                         seed=int(p.get("seed", 0)),

@@ -66,17 +66,27 @@ class InferenceSession:
             out = self._fwd(self.ff.params, self.ff.state, padded)
         return np.asarray(out)[:n]
 
-    def generate(self, input_ids: np.ndarray, prompt_len: int,
+    def generate(self, input_ids: np.ndarray,
+                 prompt_len: "int | np.ndarray",
                  max_new_tokens: int, temperature: float = 0.0,
                  seed: int = 0,
                  eos_token_id: "int | None" = None,
                  top_k: int = 0, top_p: float = 1.0,
                  num_beams: int = 1) -> np.ndarray:
-        """Autoregressive decode for causal-LM sessions. Batch is padded
-        to the bucket (decode programs cache per bucket inside
+        """Autoregressive decode for causal-LM sessions. ``prompt_len``
+        may be a per-row (batch,) array (ragged prompts). Batch is
+        padded to the bucket (decode programs cache per bucket inside
         ``FFModel.generate``); the padded rows' outputs are sliced off."""
         ids = np.ascontiguousarray(np.asarray(input_ids, np.int32))
         n = int(ids.shape[0])
+        ragged = np.ndim(prompt_len) > 0
+        if ragged:
+            if num_beams > 1:
+                raise ValueError("per-row prompt lengths are not "
+                                 "supported with beam search; send "
+                                 "uniform-length beams or one request "
+                                 "per row")
+            prompt_len = np.asarray(prompt_len, np.int32)
         cap = self.buckets[-1]
         if n > cap:
             # per-chunk seed: identical prompts in different chunks must
@@ -84,7 +94,9 @@ class InferenceSession:
             # separate request using seed+1 does not collide with chunk 1
             # of this request (the streams only meet after ~2^31 seeds).
             return np.concatenate(
-                [self.generate(ids[i:i + cap], prompt_len,
+                [self.generate(ids[i:i + cap],
+                               prompt_len[i:i + cap] if ragged
+                               else prompt_len,
                                max_new_tokens, temperature,
                                (seed + (i // cap) * 0x9E3779B1)
                                & 0x7FFFFFFF, eos_token_id,
@@ -95,6 +107,10 @@ class InferenceSession:
         if bucket != n:
             pad = np.zeros((bucket - n,) + ids.shape[1:], ids.dtype)
             ids = np.concatenate([ids, pad], axis=0)
+            if ragged:
+                # padded rows decode from a dummy 1-token prompt
+                prompt_len = np.concatenate(
+                    [prompt_len, np.ones(bucket - n, np.int32)])
         with self._lock:
             if num_beams > 1:
                 # beam search is deterministic: temperature/top-k/top-p

@@ -1,0 +1,104 @@
+"""Ragged prompt lengths: generate() accepts a per-row prompt_len
+array — the batched-serving case where requests have different prompt
+sizes. Oracle: each row must match a single-row generate with that
+row's own scalar prompt_len."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models import GPTConfig, LlamaConfig, build_gpt2, \
+    build_llama
+
+BATCH, SEQ = 3, 16
+
+
+def _gpt2():
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.only_data_parallel = True
+    g = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                  num_heads=4, max_position=SEQ, dropout=0.0)
+    ff = FFModel(cfg)
+    out = build_gpt2(ff, BATCH, SEQ, g)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return ff, g
+
+
+def _prompts(vocab, plens, rng):
+    ids = np.zeros((BATCH, SEQ), np.int32)
+    for r, p in enumerate(plens):
+        ids[r, :p] = rng.integers(1, vocab, p)
+    return ids
+
+
+def test_ragged_matches_per_row_scalar_kv():
+    ff, g = _gpt2()
+    rng = np.random.default_rng(0)
+    plens = np.array([2, 5, 3], np.int32)
+    ids = _prompts(g.vocab_size, plens, rng)
+    got = np.asarray(ff.generate(ids, plens, 6, kv_cache=True))
+    for r, p in enumerate(plens):
+        # batch=BATCH model: replicate row r so shapes match
+        row_ids = np.tile(ids[r:r + 1], (BATCH, 1))
+        want = np.asarray(ff.generate(row_ids, int(p), 6,
+                                      kv_cache=True))[0]
+        np.testing.assert_array_equal(got[r, :p + 6], want[:p + 6],
+                                      err_msg=f"row {r}")
+
+
+def test_ragged_kv_matches_ragged_reforward():
+    ff, g = _gpt2()
+    rng = np.random.default_rng(1)
+    plens = np.array([4, 1, 6], np.int32)
+    ids = _prompts(g.vocab_size, plens, rng)
+    kv = np.asarray(ff.generate(ids, plens, 5, kv_cache=True))
+    oracle = np.asarray(ff.generate(ids, plens, 5, kv_cache=False))
+    for r, p in enumerate(plens):
+        np.testing.assert_array_equal(kv[r, :p + 5], oracle[r, :p + 5])
+
+
+def test_ragged_sliding_window_model():
+    """Ragged prompts on a windowed model: ragged decode takes the
+    full-cache path with per-row window masks; each row must match the
+    scalar-path (ring-buffer) decode for its own length."""
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.only_data_parallel = True
+    lc = LlamaConfig.tiny()
+    lc.max_position = SEQ
+    lc.sliding_window = 4
+    ff = FFModel(cfg)
+    out = build_llama(ff, BATCH, SEQ, lc, fused_attention=True)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    rng = np.random.default_rng(3)
+    plens = np.array([2, 6, 4], np.int32)
+    ids = _prompts(lc.vocab_size, plens, rng)
+    got = np.asarray(ff.generate(ids, plens, 6, kv_cache=True))
+    for r, p in enumerate(plens):
+        row_ids = np.tile(ids[r:r + 1], (BATCH, 1))
+        want = np.asarray(ff.generate(row_ids, int(p), 6))[0]
+        np.testing.assert_array_equal(got[r, :p + 6], want[:p + 6],
+                                      err_msg=f"row {r}")
+
+
+def test_ragged_rope_model():
+    """Per-row positions flow through in-op RoPE (fused LLaMA)."""
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.only_data_parallel = True
+    lc = LlamaConfig.tiny()
+    lc.max_position = SEQ
+    ff = FFModel(cfg)
+    out = build_llama(ff, BATCH, SEQ, lc, fused_attention=True)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    rng = np.random.default_rng(2)
+    plens = np.array([3, 5, 2], np.int32)
+    ids = _prompts(lc.vocab_size, plens, rng)
+    got = np.asarray(ff.generate(ids, plens, 4, kv_cache=True))
+    for r, p in enumerate(plens):
+        row_ids = np.tile(ids[r:r + 1], (BATCH, 1))
+        want = np.asarray(ff.generate(row_ids, int(p), 4))[0]
+        np.testing.assert_array_equal(got[r, :p + 4], want[:p + 4],
+                                      err_msg=f"row {r}")

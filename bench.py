@@ -316,6 +316,11 @@ def main():
             return bail()
     out["dp_sps"] = dp["sps"]
     out["mfu"] = dp["mfu"]
+    if out["platform"] == "cpu":
+        # CPU-fallback MFU divides by the synthetic cpu-sim peak_flops
+        # (parallel/machine.py), not TPU peak — not comparable to a
+        # hardware MFU and labeled so it cannot be misread as one
+        out["mfu_note"] = "vs synthetic cpu-sim peak, not TPU MFU"
     out["flash"] = flash_used
     if "flash_resolved" in dp:
         out["flash_resolved"] = dp["flash_resolved"]
@@ -348,6 +353,7 @@ def main():
                 out["n_devices"] = reprobe["n"]
                 out["dp_sps"] = dp2["sps"]
                 out["mfu"] = dp2["mfu"]
+                out.pop("mfu_note", None)  # now a real TPU MFU
                 out["flash"] = flash_used
                 if "flash_resolved" in dp2:
                     out["flash_resolved"] = dp2["flash_resolved"]

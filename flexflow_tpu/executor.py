@@ -83,8 +83,10 @@ class GraphProgram:
             outs = op.emit(layer.params, ins, w, ctx, layer.name)
             assert len(outs) == len(layer.outputs), layer
             for i, (o, t) in enumerate(zip(outs, layer.outputs)):
-                if bf16_act and hasattr(o, "dtype") \
-                        and o.dtype == jnp.float32:
+                cast = (bf16_act and hasattr(o, "dtype")
+                        and o.dtype == jnp.float32)
+                pre_cast = o
+                if cast:
                     # end-to-end bf16 activations: inter-op tensors live
                     # in bf16 (weights stay fp32 masters; losses/norms
                     # upcast internally)
@@ -93,9 +95,17 @@ class GraphProgram:
                     sh = strategy.output_sharding(layer.name, i)
                     if sh is not None:
                         o = jax.lax.with_sharding_constraint(o, sh)
+                        if cast:
+                            pre_cast = jax.lax.with_sharding_constraint(
+                                pre_cast, sh)
                 env[t.guid] = o
                 if capture is not None:
-                    capture[t.guid] = o
+                    # capture keeps the pre-bf16-cast (but still
+                    # sharding-constrained) value: the CE-on-logits
+                    # fusion reads logits from here, and the loss must
+                    # consume full-precision logits even when
+                    # --bf16-activations quantizes the live graph
+                    capture[t.guid] = pre_cast if cast else o
 
     def emit(self, params: Dict[str, Dict[str, Any]], inputs: Dict[str, Any],
              ctx: EmitCtx, strategy: Optional[ShardingStrategy] = None,

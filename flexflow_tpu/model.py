@@ -863,8 +863,17 @@ class FFModel:
             fixed["position_ids"] = jnp.tile(
                 jnp.arange(L, dtype=jnp.int32)[None], (b, 1))
 
+        # failed KV attempts are remembered per (batch, seq) shape — the
+        # unit of trace/compile — so repeated auto-mode requests at a
+        # failing shape don't re-pay the attempt, while other shapes
+        # (e.g. shorter prompts that fit) still get the KV path
+        kv_failed_shapes = getattr(self.executor, "_kv_failed_shapes",
+                                   None)
+        if kv_failed_shapes is None:
+            kv_failed_shapes = self.executor._kv_failed_shapes = set()
         want_kv = kv_cache if isinstance(kv_cache, bool) \
-            else self._kv_decode_eligible(names, extra_inputs)
+            else (self._kv_decode_eligible(names, extra_inputs)
+                  and (b, L) not in kv_failed_shapes)
         if want_kv:
             try:
                 return self._generate_kv(ids0, prompt_len, max_new_tokens,
@@ -873,10 +882,13 @@ class FFModel:
             except Exception:
                 if kv_cache is True:
                     raise
+                kv_failed_shapes.add((b, L))
                 import logging
                 logging.getLogger("flexflow_tpu").warning(
-                    "KV-cache decode trace failed for this graph; "
-                    "falling back to full re-forward generation",
+                    "KV-cache decode failed for this graph at shape "
+                    "(%d, %d); falling back to full re-forward "
+                    "generation (cached: subsequent auto-mode calls at "
+                    "this shape skip the KV attempt)", b, L,
                     exc_info=True)
         return self._generate_reforward(ids0, prompt_len, max_new_tokens,
                                         temperature, seed, eos_token_id,

@@ -1,0 +1,180 @@
+"""Per-op concurrent device-subset placement ("op banks").
+
+Reference analog: ``MachineView`` (``include/flexflow/machine_view.h:14-62``,
+``src/runtime/machine_view.cc``) — each op may run on its own device slice
+(``start_device_id`` + dim/stride), so e.g. DLRM places its embedding
+tables on disjoint GPU subsets running *concurrently*
+(``examples/cpp/DLRM/strategies/dlrm_strategy_16embs_16gpus.pb``).
+
+TPU-native realization: inside one SPMD program, "op A on chips 0..3
+while op B runs on chips 4..7" is expressed by *stacking* a group of K
+independent, same-signature ops along a leading bank dim and sharding
+that dim over dedicated mesh axes. Each device subset then computes only
+its own members' work (a vmap whose mapped dim is bank-sharded), which
+is exactly concurrent subset placement — but it is a sharding, so XLA
+still schedules/fuses it and GSPMD inserts the one all-gather where the
+outputs rejoin the rest of the graph. The flat-device-order view of each
+member's subset is exposed as a reference-parity ``MachineView``.
+
+Wins vs whole-mesh placement (what the reference's DLRM strategies buy):
+  - weights are *distributed*, not replicated: per-device table memory
+    is divided by the bank degree;
+  - the dense embedding-gradient update (the HBM-bound step cost) is
+    divided by the bank degree — each subset updates only its tables;
+  - member lookups run concurrently on disjoint subsets.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ffconst import OperatorType
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineView:
+    """Reference-parity device-subset view (``machine_view.h:14-62``):
+    the devices ``start_device_id + i*stride`` for ``i < num_parts``,
+    in the mesh's flat device order. Subsets that are not an arithmetic
+    progression (possible when the bank axes are non-adjacent in the
+    mesh's axis order) carry their exact ids in ``explicit_ids``."""
+    start_device_id: int
+    num_parts: int
+    stride: int = 1
+    explicit_ids: Optional[Tuple[int, ...]] = None
+
+    @property
+    def device_ids(self) -> Tuple[int, ...]:
+        if self.explicit_ids is not None:
+            return self.explicit_ids
+        return tuple(self.start_device_id + i * self.stride
+                     for i in range(self.num_parts))
+
+
+@dataclasses.dataclass
+class BankSpec:
+    """K independent same-signature ops placed on disjoint device
+    subsets. ``members`` is ordered: the stacked bank dim is sharded in
+    contiguous blocks, so member k lives at bank coordinate
+    ``k // (K / bank_degree)``. ``axes`` are the mesh axes forming the
+    bank dim; their sizes multiply to ``bank_degree``, which must
+    divide K."""
+    members: List[str]                  # layer names, bank index = position
+    axes: Tuple[str, ...]               # mesh axes carrying the bank dim
+    batch_axes: Tuple[str, ...] = ()    # leftover axes for dp inside subsets
+    param_name: str = "__bank__"
+
+    def bank_degree(self, dmesh) -> int:
+        d = 1
+        for a in self.axes:
+            d *= dmesh.axis_sizes[a]
+        return d
+
+    def machine_views(self, dmesh) -> Dict[str, MachineView]:
+        """Per-member flat-device-order subset, for describe/export and
+        reference-strategy parity checks. The mesh is laid out
+        axis-major (``DeviceMesh`` reshapes ``jax.devices()``), so a
+        member's subset is the set of flat ids whose coordinates along
+        ``self.axes`` equal the member's bank coordinate."""
+        names = list(dmesh.axis_sizes.keys())
+        sizes = [dmesh.axis_sizes[a] for a in names]
+        B = self.bank_degree(dmesh)
+        assert len(self.members) % B == 0, \
+            (f"bank degree {B} must divide member count "
+             f"{len(self.members)}")
+        grid = np.arange(int(np.prod(sizes))).reshape(sizes)
+        # bank coordinate of every flat device id
+        coord = np.zeros_like(grid)
+        mult = 1
+        for a in reversed(self.axes):
+            idx = names.index(a)
+            ax_coord = np.indices(grid.shape)[idx]
+            coord = coord + ax_coord * mult
+            mult *= sizes[idx]
+        out: Dict[str, MachineView] = {}
+        per = len(self.members) // B
+        for k, m in enumerate(self.members):
+            ids = np.sort(grid[coord == (k // per)].ravel())
+            stride = int(ids[1] - ids[0]) if len(ids) > 1 else 1
+            if len(ids) > 2 and not np.all(np.diff(ids) == stride):
+                # not an arithmetic progression: keep the exact ids
+                out[m] = MachineView(int(ids[0]), len(ids), 1,
+                                     explicit_ids=tuple(int(i)
+                                                        for i in ids))
+            else:
+                out[m] = MachineView(int(ids[0]), len(ids), stride)
+        return out
+
+
+# Ops safe to bank in v1: pure, stateless, rng-free, single-input/
+# single-output, with all weights vmappable. The reference's headline
+# use-case (DLRM embedding tables) plus the linear family.
+_BANKABLE = {OperatorType.OP_EMBEDDING, OperatorType.OP_LINEAR}
+
+
+def _signature(layer):
+    """Two layers may share a bank iff their signatures match: same op,
+    same params, same input/output shapes+dtypes (so their emits are
+    vmappable over a stacked leading dim)."""
+    return (layer.op_type,
+            tuple(sorted((k, v) for k, v in layer.params.items()
+                         if not callable(v))),
+            tuple((tuple(t.shape), t.dtype) for t in layer.inputs),
+            tuple((tuple(t.shape), t.dtype) for t in layer.outputs))
+
+
+def find_bank_groups(layers: Sequence) -> List[List]:
+    """Groups of >= 2 mutually independent same-signature bankable
+    layers. Independence: no member's output (transitively) feeds
+    another member — guaranteed here by requiring every member's inputs
+    to be produced before the FIRST member (or be graph inputs), which
+    also lets the executor emit the whole group at the first member's
+    position."""
+    by_sig: Dict[tuple, List] = {}
+    produced_at: Dict[int, int] = {}    # tensor guid -> producer index
+    for i, l in enumerate(layers):
+        for t in l.outputs:
+            produced_at[t.guid] = i
+    pos = {l.name: i for i, l in enumerate(layers)}
+    for l in layers:
+        if l.op_type not in _BANKABLE:
+            continue
+        if len(l.outputs) != 1 or len(l.inputs) != 1:
+            continue
+        by_sig.setdefault(_signature(l), []).append(l)
+    groups = []
+    for sig, ls in by_sig.items():
+        if len(ls) < 2:
+            continue
+        first = min(pos[l.name] for l in ls)
+        ok = [l for l in ls
+              if all(produced_at.get(t.guid, -1) < first
+                     for t in l.inputs)]
+        if len(ok) >= 2:
+            groups.append(sorted(ok, key=lambda l: pos[l.name]))
+    return groups
+
+
+def choose_bank_axes(dmesh, k_members: int,
+                     reserved: Sequence[str] = ()
+                     ) -> Optional[Tuple[Tuple[str, ...], Tuple[str, ...]]]:
+    """Pick mesh axes for the bank dim: the largest realizable degree
+    that divides K (so members spread evenly), leaving the remaining
+    axes for batch parallelism inside each subset. Returns
+    ``(bank_axes, batch_axes)`` or None."""
+    reserved = tuple(reserved)
+    best = None
+    for d in sorted(dmesh.valid_degrees(), reverse=True):
+        if d <= 1 or k_members % d != 0:
+            continue
+        ax = dmesh.allocate_axes(d, reserved)
+        if ax is not None:
+            best = ax
+            break
+    if best is None:
+        return None
+    batch = tuple(a for a in dmesh.axis_sizes
+                  if a not in best and a not in reserved)
+    return tuple(best), batch

@@ -150,12 +150,18 @@ class OpCostModel:
         t(s) = 2(n-1)/n * s/bw + (n-1)*lat replaces the machine-model
         ICI constants in ``xfer_cost`` — essential on the CPU simulation
         platform, where the v5e constants mispredict collectives badly.
-        Disk-cached per (backend, n_devices)."""
+        Disk-cached per (backend, mesh shape, slice structure): a fit
+        from one mesh topology must not be reused for a differently
+        shaped or multi-slice mesh of the same device count, where
+        effective all-reduce bandwidth differs."""
         import jax
         n = dmesh.num_devices
         if n <= 1:
             return
-        key = f"coll_{jax.default_backend()}_{n}"
+        shape = "x".join(f"{a}{s}"
+                         for a, s in dmesh.axis_sizes.items())
+        slices = getattr(getattr(dmesh, "spec", None), "num_slices", 1)
+        key = f"coll_{jax.default_backend()}_{n}_{shape}_s{slices}"
         cached = self._disk_cache().get(key)
         if cached:
             self.coll_bw, self.coll_lat = cached

@@ -98,6 +98,17 @@ class FFConfig:
     # let the search score a pipeline candidate (bubble model) against the
     # searched sharding strategy and pick the winner
     enable_pipeline_search: bool = False
+    # ragged pipeline schedule (parallel/pipeline_lowering.py): "auto"
+    # falls back to unequal per-stage block counts with embedding/head
+    # absorbed into the edge stages when the uniform region finder
+    # fails; "force" always uses the ragged finder; "off" disables.
+    pipeline_ragged: str = "auto"
+    # per-op concurrent device-subset placement (parallel/banks.py): the
+    # search may place groups of independent same-signature ops (DLRM
+    # embedding banks) on disjoint device subsets when the cost model
+    # predicts a win (reference MachineView placement). "auto" proposes
+    # when profitable; "off" disables; "force" banks every eligible group.
+    banked_placement: str = "auto"
     use_bf16_compute: bool = True                  # matmuls in bf16, fp32 accum
     # end-to-end bf16 ACTIVATIONS: inter-op tensors are stored bf16
     # (halves HBM traffic on the memory-bound segments); weights stay
@@ -277,6 +288,10 @@ class FFConfig:
                 cfg.gradient_accumulation_steps = int(take())
             elif a == "--enable-pipeline-search":
                 cfg.enable_pipeline_search = True
+            elif a == "--banked-placement":
+                cfg.banked_placement = take()
+            elif a == "--pipeline-ragged":
+                cfg.pipeline_ragged = take()
             elif a == "--seed":
                 cfg.seed = int(take())
             # unknown flags: skip (reference forwards to Legion)

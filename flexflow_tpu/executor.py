@@ -76,7 +76,33 @@ class GraphProgram:
                     capture: Optional[Dict[int, Any]] = None) -> None:
         bf16_act = bool(getattr(ctx.config, "bf16_activations", False)) \
             if ctx.config is not None else False
+        # per-op device-subset placement (parallel/banks.py): member
+        # layers of a bank are emitted together as one vmap whose mapped
+        # dim is sharded over the bank axes — each device subset computes
+        # only its own members, concurrently (reference MachineView
+        # placement, machine_view.h:14-62)
+        bank_out: Dict[str, Any] = {}
+        banked_names = set()
+        if strategy is not None and getattr(strategy, "banks", None):
+            present = {l.name for l in layers}
+            for bk in strategy.banks:
+                if set(bk.members) <= present:
+                    banked_names |= set(bk.members)
         for layer in layers:
+            if layer.name in banked_names:
+                if layer.name not in bank_out:
+                    bk = next(b for b in strategy.banks
+                              if layer.name in b.members)
+                    self._emit_bank(bk, layers, env, params, ctx,
+                                    strategy, bank_out)
+                o = bank_out[layer.name]
+                if bf16_act and hasattr(o, "dtype") \
+                        and o.dtype == jnp.float32:
+                    o = o.astype(jnp.bfloat16)
+                env[layer.outputs[0].guid] = o
+                if capture is not None:
+                    capture[layer.outputs[0].guid] = bank_out[layer.name]
+                continue
             op = get_op_def(layer.op_type)
             ins = [env[t.guid] for t in layer.inputs]
             w = params.get(layer.name, {})
@@ -106,6 +132,48 @@ class GraphProgram:
                     # consume full-precision logits even when
                     # --bf16-activations quantizes the live graph
                     capture[t.guid] = pre_cast if cast else o
+
+    def _emit_bank(self, bk, layers, env, params, ctx,
+                   strategy: ShardingStrategy,
+                   bank_out: Dict[str, Any]) -> None:
+        """Emit one bank group: stack member inputs along a leading bank
+        dim, vmap the member op over it, shard the mapped dim over the
+        bank axes. Each device subset computes only its slice of the
+        vmap — its own members — so the group runs concurrently across
+        subsets; the downstream per-member reads (``out[k]``) are where
+        GSPMD inserts the one rejoin all-gather."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        by_name = {l.name: l for l in layers}
+        members = [by_name[n] for n in bk.members]
+        op = get_op_def(members[0].op_type)
+        mesh = strategy.dmesh.mesh
+        bank_spec = bk.axes[0] if len(bk.axes) == 1 else tuple(bk.axes)
+        # data parallelism inside each subset over the leftover axes
+        batch_spec = None
+        ish = members[0].inputs[0].shape
+        if bk.batch_axes and ish:
+            bdeg = 1
+            for a in bk.batch_axes:
+                bdeg *= strategy.dmesh.axis_sizes[a]
+            if ish[0] % bdeg == 0:
+                batch_spec = (bk.batch_axes[0] if len(bk.batch_axes) == 1
+                              else tuple(bk.batch_axes))
+        xs = jnp.stack([env[m.inputs[0].guid] for m in members])
+        in_sp = P(bank_spec, batch_spec, *([None] * (xs.ndim - 2)))
+        xs = jax.lax.with_sharding_constraint(
+            xs, NamedSharding(mesh, in_sp))
+        w = params.get(bk.param_name, {})
+
+        def one(x_k, w_k):
+            return op.emit(members[0].params, [x_k], w_k, ctx,
+                           members[0].name)[0]
+
+        out = jax.vmap(one)(xs, w)
+        out_sp = P(bank_spec, batch_spec, *([None] * (out.ndim - 2)))
+        out = jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, out_sp))
+        for k, m in enumerate(members):
+            bank_out[m.name] = out[k]
 
     def emit(self, params: Dict[str, Dict[str, Any]], inputs: Dict[str, Any],
              ctx: EmitCtx, strategy: Optional[ShardingStrategy] = None,
@@ -200,8 +268,14 @@ class Executor:
                     "single-crossing blocks without stateful/aux-loss "
                     "ops); running without rematerialization")
         if self.pipe is not None:
-            self._pre_layers = program.layers[:self.pipe.start]
-            self._post_layers = program.layers[self.pipe.end:]
+            if getattr(self.pipe, "prologue", None):
+                # absorbed into stage 0 (ragged schedule): the prologue
+                # IS layers[:start] by construction
+                self._pre_layers = []
+            else:
+                self._pre_layers = program.layers[:self.pipe.start]
+            n_epi = len(getattr(self.pipe, "epilogue", None) or [])
+            self._post_layers = program.layers[self.pipe.end + n_epi:]
         # CE-on-logits fusion: if the final op is Softmax, take its input as
         # logits (grad identical to the reference's (probs-labels)/B kernel).
         self._logits_tensor: Optional[Tensor] = None
@@ -225,7 +299,33 @@ class Executor:
         if self.pipe is not None:
             region_names = {l.name for l in self.program.layers[
                 self.pipe.start:self.pipe.end]}
-            params.update(self._init_pipeline_params(rng))
+            if getattr(self.pipe, "counts", None) is not None:
+                params.update(self._init_ragged_pipeline_params(rng))
+            else:
+                params.update(self._init_pipeline_params(rng))
+        # banked members (parallel/banks.py): weights are stacked along
+        # a leading bank dim sharded over the bank axes, so each device
+        # subset HOLDS only its members' weights (the reference's
+        # per-view weight placement). Member k is initialized with the
+        # exact keys the unbanked path would use — banked and unbanked
+        # runs are numerically identical.
+        banks = getattr(self.strategy, "banks", None) or []
+        if banks:
+            # prune banks whose members don't all exist in this program
+            # (e.g. a stale --import against a renamed model): emitting
+            # such a bank would KeyError deep inside compile. Pruning on
+            # the shared strategy keeps init and emit consistent.
+            names = {l.name for l in self.program.layers}
+            kept = [bk for bk in banks if set(bk.members) <= names]
+            if len(kept) != len(banks):
+                import logging
+                logging.getLogger("flexflow_tpu").warning(
+                    "dropping %d bank placement(s) whose members are "
+                    "not in this program", len(banks) - len(kept))
+                self.strategy.banks = kept
+            banks = kept
+        bank_member_arrs: Dict[str, Dict[str, Any]] = {}
+        bank_names = {n for bk in banks for n in bk.members}
         for li, layer in enumerate(self.program.layers):
             if layer.name in region_names:
                 continue  # initialized stacked, above
@@ -234,7 +334,14 @@ class Executor:
                 layer.params, [t.shape for t in layer.inputs],
                 [t.dtype for t in layer.inputs])
             layer.weights = specs
-            if specs:
+            if specs and layer.name in bank_names:
+                arrs = {}
+                for wi, spec in enumerate(specs):
+                    k = jax.random.fold_in(jax.random.fold_in(rng, li), wi)
+                    arrs[spec.name] = initialize(spec, k,
+                                                 to_jnp(spec.dtype))
+                bank_member_arrs[layer.name] = arrs
+            elif specs:
                 lp = {}
                 for wi, spec in enumerate(specs):
                     k = jax.random.fold_in(jax.random.fold_in(rng, li), wi)
@@ -247,6 +354,8 @@ class Executor:
                 ss = state_spec(layer.params, [t.shape for t in layer.inputs],
                                 [t.dtype for t in layer.inputs])
                 if ss:
+                    assert layer.name not in bank_names, \
+                        f"stateful op {layer.name} cannot be banked"
                     st = {}
                     for sname, (sshape, sdt) in ss.items():
                         if sname == "var":
@@ -255,6 +364,23 @@ class Executor:
                             st[sname] = jnp.zeros(sshape, to_jnp(sdt))
                     state[layer.name] = jax.device_put(
                         st, self.strategy.replicated())
+        for bk in banks:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            if any(m not in bank_member_arrs for m in bk.members):
+                # member without weight specs: nothing to stack (the
+                # emit path still banks the compute)
+                continue
+            bank_spec = bk.axes[0] if len(bk.axes) == 1 else tuple(bk.axes)
+            lp = {}
+            wnames = list(bank_member_arrs[bk.members[0]].keys())
+            for wname in wnames:
+                stacked = jnp.stack([bank_member_arrs[m][wname]
+                                     for m in bk.members])
+                sh = NamedSharding(
+                    self.dmesh.mesh,
+                    P(bank_spec, *([None] * (stacked.ndim - 1))))
+                lp[wname] = jax.device_put(stacked, sh)
+            params[bk.param_name] = lp
         return params, state
 
     # ------------------------------------------------------------------
@@ -305,6 +431,209 @@ class Executor:
                 lp[spec.name] = jax.device_put(stacked, sh)
             out[pipe.param_name(layer)] = lp
         return out
+
+    # ------------------------------------------------------------------
+    # ragged pipeline lowering (gpipe_ragged; pipeline_lowering.counts)
+    # ------------------------------------------------------------------
+    def _ragged_slot_of(self):
+        """block index b -> (stage, slot) under the contiguous ragged
+        assignment (stage s owns counts[s] consecutive blocks)."""
+        out = []
+        for s, c in enumerate(self.pipe.counts):
+            out.extend((s, k) for k in range(c))
+        return out
+
+    def _init_ragged_pipeline_params(self, rng):
+        """Block params stacked (S, cmax) + spec.shape, stage dim over
+        the pp axis, slot dim scanned by the engine; slots past a
+        stage's count are zero (masked pass-through in the engine)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        pipe = self.pipe
+        S = pipe.n_stages
+        cmax = max(pipe.counts)
+        slot_of = self._ragged_slot_of()
+        out: Dict[str, Dict[str, Any]] = {}
+        for lj, layer in enumerate(pipe.template):
+            op = get_op_def(layer.op_type)
+            specs = layer.weights or op.weights(
+                layer.params, [t.shape for t in layer.inputs],
+                [t.dtype for t in layer.inputs])
+            layer.weights = specs
+            if not specs:
+                continue
+            lp = {}
+            for wi, spec in enumerate(specs):
+                dt = to_jnp(spec.dtype)
+                rows = [[jnp.zeros(tuple(spec.shape), dt)
+                         for _ in range(cmax)] for _ in range(S)]
+                for b, (s, k) in enumerate(slot_of):
+                    key = jax.random.fold_in(jax.random.fold_in(
+                        jax.random.fold_in(rng, 7000 + lj), wi), b)
+                    rows[s][k] = initialize(spec, key, dt)
+                stacked = jnp.stack([jnp.stack(r) for r in rows])
+                sh = NamedSharding(
+                    self.dmesh.mesh,
+                    P(pipe.pp_axis, *([None] * (stacked.ndim - 1))))
+                lp[spec.name] = jax.device_put(stacked, sh)
+            out[pipe.param_name(layer)] = lp
+        return out
+
+    def _make_block_fn(self, training: bool):
+        """block_fn(p_k, x, t) emitting ONE template block; ``p_k`` is
+        the per-slot param subtree handed over by gpipe_ragged's scan."""
+        pipe = self.pipe
+        template = pipe.template
+        bf16_act = bool(getattr(self.config, "bf16_activations", False))
+
+        def block_fn(p, x, t):
+            rng_key = p.get("__rng__")
+            env = {pipe.template_entry_guid: x}
+            ctx = EmitCtx(training=training, rngs={}, state={},
+                          config=self.config)
+            for j, layer in enumerate(template):
+                if training and rng_key is not None and _needs_rng(layer):
+                    ctx.rngs[layer.name] = jax.random.fold_in(
+                        jax.random.fold_in(rng_key, t), j)
+                op = get_op_def(layer.op_type)
+                ins = [env[tt.guid] for tt in layer.inputs]
+                w = p.get(pipe.param_name(layer), {})
+                outs = op.emit(layer.params, ins, w, ctx, layer.name)
+                for o, tt in zip(outs, layer.outputs):
+                    if bf16_act and hasattr(o, "dtype") \
+                            and o.dtype == jnp.float32:
+                        o = o.astype(jnp.bfloat16)
+                    env[tt.guid] = o
+            return env[pipe.template_exit_guid]
+
+        return block_fn
+
+    def _make_edge_fn(self, layers, out_guid, training: bool):
+        """Interpret a prologue/epilogue layer list inside the pipelined
+        shard_map; ``env_seed`` maps tensor guids to incoming values."""
+        bf16_act = bool(getattr(self.config, "bf16_activations", False))
+
+        def fn(p, env_seed, t):
+            rng_key = p.get("__rng__")
+            env = dict(env_seed)
+            ctx = EmitCtx(training=training, rngs={}, state={},
+                          config=self.config)
+            for j, layer in enumerate(layers):
+                if training and rng_key is not None and _needs_rng(layer):
+                    ctx.rngs[layer.name] = jax.random.fold_in(
+                        jax.random.fold_in(rng_key, t), j)
+                op = get_op_def(layer.op_type)
+                ins = [env[tt.guid] for tt in layer.inputs]
+                w = p.get(layer.name, {})
+                outs = op.emit(layer.params, ins, w, ctx, layer.name)
+                for o, tt in zip(outs, layer.outputs):
+                    if bf16_act and hasattr(o, "dtype") \
+                            and o.dtype == jnp.float32:
+                        o = o.astype(jnp.bfloat16)
+                    env[tt.guid] = o
+            return env[out_guid]
+
+        return fn
+
+    def _tensor_by_guid(self, guid: int):
+        for l in self.program.layers:
+            for t in list(l.outputs) + list(l.inputs):
+                if t.guid == guid:
+                    return t
+        for t in self.program.input_tensors:
+            if t.guid == guid:
+                return t
+        raise KeyError(guid)
+
+    def _pipe_apply_ragged(self, params, env, batch, step,
+                           training: bool):
+        """Run a ragged pipeline region (unequal stage depths, optional
+        prologue/epilogue inside stage 0 / S-1)."""
+        from jax.sharding import PartitionSpec as P
+        from .parallel.pipeline import gpipe_ragged
+        pipe = self.pipe
+        S, M = pipe.n_stages, pipe.n_microbatches
+        cmax = max(pipe.counts)
+        stacked = {pipe.param_name(l): params[pipe.param_name(l)]
+                   for l in pipe.template
+                   if pipe.param_name(l) in params}
+        if training:
+            base = jax.random.fold_in(jax.random.key(self.seed + 2), step)
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(S * cmax)).reshape(S, cmax)
+            stacked = dict(stacked, __rng__=keys)
+
+        pro_params = {l.name: params[l.name] for l in pipe.prologue
+                      if l.name in params}
+        epi_params = {l.name: params[l.name] for l in pipe.epilogue
+                      if l.name in params}
+        if training:
+            pro_params = dict(pro_params, __rng__=jax.random.fold_in(
+                jax.random.key(self.seed + 3), step))
+            epi_params = dict(epi_params, __rng__=jax.random.fold_in(
+                jax.random.key(self.seed + 4), step))
+
+        entry_t = self._tensor_by_guid(pipe.entry_guid)
+        mb = entry_t.shape[0] // M
+        hidden_example = jnp.zeros((mb,) + tuple(entry_t.shape[1:]),
+                                   to_jnp(entry_t.dtype))
+        if pipe.epilogue:
+            out_t = self._tensor_by_guid(pipe.epilogue_exit_guid)
+            out_example = jnp.zeros((mb,) + tuple(out_t.shape[1:]),
+                                    to_jnp(out_t.dtype))
+        else:
+            out_example = hidden_example
+
+        prologue_fn = None
+        if pipe.prologue:
+            edge = self._make_edge_fn(pipe.prologue, pipe.entry_guid,
+                                      training)
+
+            def prologue_fn(p, raw_mb, t):  # noqa: F811
+                seed = {t_.guid: raw_mb[t_.name]
+                        for t_ in pipe.prologue_inputs}
+                return edge(p, seed, t)
+
+            raw_xs = {}
+            for t_ in pipe.prologue_inputs:
+                a = batch[t_.name]
+                raw_xs[t_.name] = a.reshape((M, a.shape[0] // M)
+                                            + a.shape[1:])
+        else:
+            x = env[pipe.entry_guid]
+            raw_xs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+        epilogue_fn = None
+        if pipe.epilogue:
+            eedge = self._make_edge_fn(pipe.epilogue,
+                                       pipe.epilogue_exit_guid, training)
+
+            def epilogue_fn(p, y, t):  # noqa: F811
+                return eedge(p, {pipe.exit_guid: y}, t)
+
+        engine = gpipe_ragged(self._make_block_fn(training), pipe.pp_axis,
+                              M, pipe.counts, prologue_fn=prologue_fn,
+                              epilogue_fn=epilogue_fn)
+
+        pp = pipe.pp_axis
+        param_specs = jax.tree.map(
+            lambda a: P(pp, *([None] * (a.ndim - 1))), stacked)
+        pro_specs = jax.tree.map(lambda a: P(), pro_params)
+        epi_specs = jax.tree.map(lambda a: P(), epi_params)
+        dp = pipe.dp_axes if pipe.dp_axes else None
+        dp = dp[0] if dp is not None and len(dp) == 1 else dp
+        raw_specs = jax.tree.map(
+            lambda a: P(None, dp, *([None] * (a.ndim - 2))), raw_xs)
+        hid_spec = P(dp, *([None] * (hidden_example.ndim - 1)))
+        out_spec = P(dp, *([None] * (out_example.ndim - 1)))
+        ys_spec = P(None, dp, *([None] * (out_example.ndim - 1)))
+        fn = jax.shard_map(
+            engine, mesh=self.dmesh.mesh,
+            in_specs=(param_specs, pro_specs, epi_specs, raw_specs,
+                      hid_spec, out_spec),
+            out_specs=ys_spec, check_vma=False)
+        ys = fn(stacked, pro_params, epi_params, raw_xs,
+                hidden_example, out_example)
+        return ys.reshape((-1,) + ys.shape[2:])
 
     def _make_stage_fn(self, training: bool):
         """stage_fn(params, x, t) interpreting the template chunk; params
@@ -440,10 +769,16 @@ class Executor:
             env = self.program.init_env(batch)
             self.program.emit_layers(self._pre_layers, env, params, ctx,
                                      self.strategy, capture)
-            y = self._pipe_apply(params, env[self.pipe.entry_guid], step,
-                                 training)
-            env[self.pipe.exit_guid] = y
-            capture[self.pipe.exit_guid] = y
+            if getattr(self.pipe, "counts", None) is not None:
+                y = self._pipe_apply_ragged(params, env, batch, step,
+                                            training)
+                g = self.pipe.region_out_guid
+            else:
+                y = self._pipe_apply(params, env[self.pipe.entry_guid],
+                                     step, training)
+                g = self.pipe.exit_guid
+            env[g] = y
+            capture[g] = y
             self.program.emit_layers(self._post_layers, env, params, ctx,
                                      self.strategy, capture)
             outs = [env[t.guid] for t in self.program.output_tensors]

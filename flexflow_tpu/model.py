@@ -641,7 +641,8 @@ class FFModel:
             strategy = pipeline_strategy(
                 self.layers, self.graph_inputs, self.dmesh, n_stages=pp,
                 n_microbatches=self.config.pipeline_microbatches,
-                n_chunks=self.config.pipeline_chunks, tp=pp_tp, **kw)
+                n_chunks=self.config.pipeline_chunks, tp=pp_tp,
+                ragged=self.config.pipeline_ragged, **kw)
         if strategy is not None:
             self.strategy = strategy
         else:

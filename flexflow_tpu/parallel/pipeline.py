@@ -200,3 +200,108 @@ def stack_stage_params(per_stage_params: Sequence[Any]):
     """[stage0_params, stage1_params, ...] -> stacked pytree with leading
     stage dim (the layout ``PipelinedBlocks`` shards over pp)."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def gpipe_ragged(block_fn: Callable[..., Any], axis_name: str,
+                 n_microbatches: int, counts: Sequence[int],
+                 prologue_fn: Optional[Callable[..., Any]] = None,
+                 epilogue_fn: Optional[Callable[..., Any]] = None):
+    """Ragged GPipe: per-stage block counts may differ, and stage 0 /
+    stage S-1 may run extra non-block programs (embedding prologue /
+    LM-head epilogue) — lifting the uniform-repeated-block restriction
+    of ``gpipe`` (the reference never implemented pipelining at all;
+    ``ffconst.h:159`` reserves OP_PIPELINE).
+
+    - block_fn(block_params, x, t) -> y, shape-preserving; one template
+      block. Stage s applies its ``counts[s]`` blocks per step; stacked
+      params are padded to ``cmax = max(counts)`` and masked slots pass
+      x through unchanged (SPMD: every scan step costs cmax blocks
+      anyway — the win of raggedness is absorbing blocks/prologue/
+      epilogue that would otherwise run REPLICATED outside the region).
+    - prologue_fn(pro_params, raw_mb, t) -> x: stage 0 turns the raw
+      per-microbatch input (e.g. token ids) into the entry activation.
+      None = raw_xs already are the entry activations.
+    - epilogue_fn(epi_params, y, t) -> out: stage S-1 maps the exit
+      activation to the final output (shape may differ from x, e.g.
+      vocab logits). None = identity.
+
+    Returned apply(stacked_local, pro_params, epi_params, raw_xs,
+    hidden_example, out_example):
+      - stacked_local: (1, cmax, ...) leaves — this stage's padded
+        block params;
+      - raw_xs: pytree of (M, mb, ...) microbatched raw inputs
+        (replicated across stages);
+      - hidden_example/out_example: shape/dtype exemplars (one
+        microbatch) for the ring state and the output buffer.
+    Returns (M, mb, ...) outputs of the final stage (replicated).
+    """
+    M = n_microbatches
+    counts = list(counts)
+    cmax = max(counts)
+
+    def apply(stacked_local, pro_params, epi_params, raw_xs,
+              hidden_example, out_example):
+        S = lax.psum(1, axis_name)
+        stage = lax.axis_index(axis_name)
+        my_count = jnp.asarray(counts, jnp.int32)[stage]
+        block_params = jax.tree.map(lambda x: x[0], stacked_local)
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        outputs0 = jnp.zeros((M,) + out_example.shape, out_example.dtype)
+        state0 = jnp.zeros(hidden_example.shape, hidden_example.dtype)
+
+        def body(carry, t):
+            state, outputs = carry
+            m_in = jnp.clip(t, 0, M - 1)
+            raw_mb = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, m_in, 0,
+                                                   keepdims=False),
+                raw_xs)
+
+            def enter_stage0(_):
+                if prologue_fn is None:
+                    return raw_mb
+                return prologue_fn(pro_params, raw_mb, t)
+
+            x_in = lax.cond(stage == 0, enter_stage0,
+                            lambda _: state, operand=None)
+
+            def blk(x, scan_in):
+                p_k, k = scan_in
+                y = block_fn(p_k, x, t)
+                return jnp.where(k < my_count, y, x), None
+
+            y, _ = lax.scan(blk, x_in,
+                            (block_params,
+                             jnp.arange(cmax, dtype=jnp.int32)))
+
+            # the last stage finishes microbatch m = t - (S-1)
+            m_out = t - (S - 1)
+            valid = jnp.logical_and(stage == S - 1,
+                                    jnp.logical_and(m_out >= 0,
+                                                    m_out < M))
+
+            def run_epilogue(_):
+                out = epilogue_fn(epi_params, y, t) \
+                    if epilogue_fn is not None else y
+                return out
+
+            out = lax.cond(valid, run_epilogue,
+                           lambda _: jnp.zeros(out_example.shape,
+                                               out_example.dtype),
+                           operand=None)
+            mo = jnp.clip(m_out, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(outputs, mo, 0, keepdims=False)
+            upd = jnp.where(valid, out, cur)
+            outputs = lax.dynamic_update_index_in_dim(outputs, upd, mo, 0)
+            state = lax.ppermute(y, axis_name, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = lax.scan(body, (state0, outputs0),
+                                   jnp.arange(M + S - 1))
+        outputs = lax.psum(
+            jnp.where(stage == S - 1, outputs,
+                      jnp.zeros_like(outputs)), axis_name)
+        return outputs
+
+    return apply

@@ -30,7 +30,7 @@ from ..core.layer import Layer
 from ..ffconst import OperatorType
 
 __all__ = ["PipelineRegion", "assign_tp_roles", "find_pipeline_region",
-           "layer_signature"]
+           "find_ragged_pipeline_region", "layer_signature"]
 
 
 def layer_signature(layer: Layer) -> Tuple:
@@ -74,6 +74,32 @@ class PipelineRegion:
     # the input dim, one psum after row). None when tp is off.
     tp_axis: Optional[str] = None
     tp_roles: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # ---- ragged schedule (gpipe_ragged) ----
+    # per-stage block counts (sum = number of region blocks); None =
+    # uniform schedule. With counts set, the template describes ONE
+    # BLOCK and stage s applies counts[s] of them per step (padded to
+    # max(counts) and masked).
+    counts: Optional[Tuple[int, ...]] = None
+    # layers absorbed INTO stage 0 / stage S-1 (embedding prologue /
+    # LM-head epilogue) — they execute inside the pipelined shard_map
+    # instead of running replicated outside the region
+    prologue: List[Layer] = dataclasses.field(default_factory=list)
+    epilogue: List[Layer] = dataclasses.field(default_factory=list)
+    # graph-input tensors the prologue consumes (microbatched raw feed)
+    prologue_inputs: List[Any] = dataclasses.field(default_factory=list)
+    # tensor guid the epilogue produces (the region's overall output;
+    # == exit_guid when there is no epilogue)
+    epilogue_exit_guid: Optional[int] = None
+
+    @property
+    def is_ragged(self) -> bool:
+        return self.counts is not None
+
+    @property
+    def region_out_guid(self) -> int:
+        """guid of the tensor the pipelined apply produces overall."""
+        return self.epilogue_exit_guid if self.epilogue \
+            else self.exit_guid
 
     @property
     def template_exit_guid(self) -> int:
@@ -197,6 +223,177 @@ def find_pipeline_region(layers: Sequence[Layer], n_stages: int,
         stage_layer_names=[
             [l.name for l in region[c * per_chunk:(c + 1) * per_chunk]]
             for c in range(n_parts)])
+
+
+def _absorbable_prologue(layers: Sequence[Layer], start: int, end: int,
+                         entry_guid: int, entry_batch: int):
+    """Can ``layers[:start]`` move inside stage 0? Yes iff every
+    pre-layer input is a graph input whose leading dim IS the batch dim
+    (``entry_batch`` — so microbatch slicing is meaningful) or
+    pre-produced, nothing pre-produced is consumed at/after ``end``
+    except via the region, the single region crossing is
+    ``entry_guid``, and nothing is stateful. Returns
+    ``(prologue_layers, raw_input_tensors)`` or ``(None, None)``."""
+    pre = list(layers[:start])
+    if not pre:
+        return None, None
+    produced = {t.guid for l in pre for t in l.outputs}
+    raw_inputs = {}
+    for l in pre:
+        if _has_state(l):
+            return None, None
+        for t in l.inputs:
+            if t.guid in produced:
+                continue
+            if t.owner_layer is not None:
+                return None, None       # fed by a non-pre layer
+            if not t.shape or t.get_tensor() is not None:
+                return None, None       # const / shapeless: not feedable
+            if t.shape[0] != entry_batch:
+                # non-batch-led input (shared mask, (T,) positions):
+                # microbatch slicing would silently hand each microbatch
+                # 1/M of it — not absorbable
+                return None, None
+            raw_inputs[t.guid] = t
+    # pre outputs consumed outside the region (post layers)?
+    for l in layers[end:]:
+        for t in l.inputs:
+            if t.guid in produced:
+                return None, None
+    # region must consume exactly the entry from pre
+    crossing = {t.guid for l in layers[start:end] for t in l.inputs
+                if t.guid in produced}
+    if crossing != {entry_guid}:
+        return None, None
+    return pre, list(raw_inputs.values())
+
+
+def _absorbable_epilogue(layers: Sequence[Layer], end: int,
+                         exit_guid: int, final_output_guid: int):
+    """Maximal prefix of ``layers[end:]`` forming a chain off the region
+    exit: each layer consumes only ``exit_guid`` or earlier epilogue
+    outputs, is stateless, and produces one output. The final softmax is
+    left OUTSIDE when it produces the graph output (so the executor's
+    CE-on-logits fusion still sees the pre-softmax logits). Returns
+    ``(epilogue_layers, epilogue_exit_guid)`` (possibly ``([], None)``)."""
+    post = list(layers[end:])
+    avail = {exit_guid}
+    chain: List[Layer] = []
+    out_guid = None
+    for l in post:
+        if _has_state(l) or len(l.outputs) != 1:
+            break
+        if not all(t.guid in avail for t in l.inputs):
+            break
+        g = l.outputs[0].guid
+        if l.op_type == OperatorType.OP_SOFTMAX \
+                and g == final_output_guid:
+            break               # keep the CE-fusion producer outside
+        chain.append(l)
+        avail.add(g)
+        out_guid = g
+    if not chain:
+        return [], None
+    # the chain must hand exactly ONE tensor to whatever follows
+    chain_guids = {l.outputs[0].guid for l in chain}
+    consumed_later = set()
+    for l in post[len(chain):]:
+        for t in l.inputs:
+            if t.guid in chain_guids:
+                consumed_later.add(t.guid)
+    if len(consumed_later) > 1:
+        return [], None
+    if consumed_later:
+        out_guid = next(iter(consumed_later))
+        # drop trailing chain layers past the handed-off tensor
+        keep: List[Layer] = []
+        for l in chain:
+            keep.append(l)
+            if l.outputs[0].guid == out_guid:
+                break
+        chain = keep
+    # nothing after the absorbed chain may read a tensor the epilogue
+    # swallowed: the executor exports ONLY out_guid from the region, so
+    # any later read of exit_guid or an interior chain output would
+    # KeyError at trace time — bail instead of absorbing
+    internal = ({exit_guid} | {l.outputs[0].guid for l in chain}) \
+        - {out_guid}
+    for l in post[len(chain):]:
+        for t in l.inputs:
+            if t.guid in internal:
+                return [], None
+    return chain, out_guid
+
+
+def find_ragged_pipeline_region(layers: Sequence[Layer], n_stages: int,
+                                n_microbatches: int = 0
+                                ) -> Optional[PipelineRegion]:
+    """Ragged variant of ``find_pipeline_region``: per-stage block
+    counts may differ (no ``reps % n_stages`` requirement) and the
+    layers before/after the repeated run are absorbed into stage 0 /
+    stage S-1 when structurally possible (embedding and LM head
+    pipelined end-to-end). Plain GPipe schedule only (no interleaving,
+    no in-stage tp in v1)."""
+    layers = list(layers)
+    run = find_repeated_run(layers, 1)
+    if run is None:
+        return None
+    total, start, unit = run
+    reps = total // unit
+    if reps < n_stages:
+        return None
+    end = start + total
+    region = layers[start:end]
+    boundaries = chunk_boundaries(layers, start, unit, reps)
+    if boundaries is None:
+        return None
+    entry = boundaries[0]
+    exit_guid = region[-1].outputs[0].guid
+    by_guid = {t.guid: t for l in layers for t in l.outputs}
+    for l in layers:
+        for t in l.inputs:
+            by_guid.setdefault(t.guid, t)
+    shapes = {tuple(by_guid[g].shape) for g in boundaries + [exit_guid]
+              if g in by_guid}
+    if len(shapes) != 1:
+        return None
+    template = region[:unit]
+    if any(_has_state(l) for l in template):
+        return None
+    for c in range(1, reps):
+        chunk = region[c * unit:(c + 1) * unit]
+        if not _chunks_isomorphic(template, chunk, boundaries[0],
+                                  boundaries[c]):
+            return None
+    # ragged counts: extras go to interior stages (stage 0 carries the
+    # prologue, stage S-1 the epilogue)
+    base, extra = divmod(reps, n_stages)
+    counts = [base] * n_stages
+    order = list(range(1, n_stages - 1)) + [0, n_stages - 1] \
+        if n_stages > 2 else list(range(n_stages))
+    for i in range(extra):
+        counts[order[i % len(order)]] += 1
+    final_out = layers[-1].outputs[0].guid if layers else -1
+    entry_batch = next(iter(shapes))[0] if shapes else 0
+    prologue, pro_inputs = _absorbable_prologue(layers, start, end, entry,
+                                                entry_batch)
+    epilogue, epi_out = _absorbable_epilogue(layers, end, exit_guid,
+                                             final_out)
+    if n_microbatches <= 0:
+        n_microbatches = 2 * n_stages
+    return PipelineRegion(
+        start=start, end=end, n_stages=n_stages,
+        n_microbatches=n_microbatches, n_chunks=1,
+        entry_guid=entry, exit_guid=exit_guid,
+        template=list(template), template_entry_guid=boundaries[0],
+        stage_layer_names=[
+            [l.name for l in region[c * unit:(c + 1) * unit]]
+            for c in range(reps)],
+        counts=tuple(counts),
+        prologue=list(prologue or []),
+        epilogue=list(epilogue or []),
+        prologue_inputs=list(pro_inputs or []),
+        epilogue_exit_guid=epi_out)
 
 
 def assign_tp_roles(template: Sequence[Layer], tp: int

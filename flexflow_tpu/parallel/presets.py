@@ -121,7 +121,8 @@ def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
                       pp_axis: Optional[str] = None,
                       dp_axes: Optional[Sequence[str]] = None,
                       n_chunks: int = 1, tp: int = 1,
-                      tp_axis: Optional[str] = None
+                      tp_axis: Optional[str] = None,
+                      ragged: str = "auto"
                       ) -> ShardingStrategy:
     """dp×pp(×tp) strategy through the product path: the maximal
     repeated-block region (found by ``find_pipeline_region``) becomes
@@ -160,13 +161,41 @@ def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
         dp_axes = tuple(a for a in dmesh.axis_names if a not in used)
     dp = _norm(dp_axes)
     dp_size = _size(dmesh, dp)
-    region = find_pipeline_region(layers, n_stages, n_microbatches,
-                                  n_chunks)
+    from .pipeline_lowering import find_ragged_pipeline_region
+    if ragged == "force" and (n_chunks > 1 or tp > 1):
+        raise ValueError(
+            "--pipeline-ragged force does not compose with "
+            "--pipeline-chunks > 1 or in-stage tp (v1); drop one")
+    uniform = None
+    if ragged != "force":
+        uniform = find_pipeline_region(layers, n_stages, n_microbatches,
+                                       n_chunks)
+    rag = None
+    if ragged in ("auto", "force") and n_chunks <= 1 and tp <= 1:
+        # ragged schedule: unequal per-stage block counts, embedding/
+        # head absorbed into stage 0 / S-1 (gpipe_ragged). Not composed
+        # with interleaving or in-stage tp in v1.
+        rag = find_ragged_pipeline_region(layers, n_stages,
+                                          n_microbatches)
+    if uniform is None:
+        region = rag
+    elif rag is None:
+        region = uniform
+    else:
+        # auto: prefer ragged only when it pipelines MORE BLOCKS (the
+        # uniform finder drops indivisible trailing blocks into
+        # replicated pre/post execution). On a tie the uniform schedule
+        # wins — it supports interleaving/tp and the established stacked
+        # layout; ``ragged="force"`` still gets edge absorption alone.
+        region = rag if (rag.end - rag.start) \
+            > (uniform.end - uniform.start) else uniform
     if region is None:
         raise ValueError(
             f"graph has no repeated-block region divisible into "
             f"{n_stages} identical stages"
-            + (f" x {n_chunks} chunks" if n_chunks > 1 else ""))
+            + (f" x {n_chunks} chunks" if n_chunks > 1 else "")
+            + ("" if ragged == "off" else
+               " (ragged fallback found none either)"))
     region.pp_axis = pp_axis
     region.dp_axes = tuple(dp_axes)
     if tp > 1:

@@ -56,6 +56,10 @@ class ShardingStrategy:
         # set by parallel.presets.pipeline_strategy: a PipelineRegion the
         # executor lowers onto the GPipe engine (None = no pipelining)
         self.pipeline = None
+        # per-op concurrent device-subset placements (parallel/banks.py
+        # BankSpec list) — the reference's MachineView concept
+        # (machine_view.h:14-62); member ops run on disjoint subsets
+        self.banks: List = []
 
     # ------------------------------------------------------------------
     def set_op(self, layer_name: str, outputs: Sequence[Optional[P]],
@@ -127,4 +131,9 @@ class ShardingStrategy:
         lines = [f"mesh axes: {dict(self.dmesh.axis_sizes)}"]
         for name, os in self.ops.items():
             lines.append(f"  {name}: out={os.outputs} w={os.weights}")
+        for bk in self.banks:
+            views = bk.machine_views(self.dmesh)
+            lines.append(f"  bank over axes {bk.axes}:")
+            for m in bk.members:
+                lines.append(f"    {m}: devices {views[m].device_ids}")
         return "\n".join(lines)

@@ -1,0 +1,138 @@
+"""Search pass proposing per-op device-subset placement (op banks).
+
+Reference analog: the DLRM strategies that assign each embedding table
+its own MachineView over a disjoint GPU subset
+(``examples/cpp/DLRM/strategies/dlrm_strategy_16embs_16gpus.pb``,
+``include/flexflow/machine_view.h:14-62``). There the search enumerates
+machine views per op; here banking is a structural proposal — find
+groups of independent same-signature heavy ops, predict the cost of
+placing them on disjoint subsets, adopt on a modeled win (and the
+measured DP-floor guard in ``search/optimizer.py`` still arbitrates the
+final adoption with real timed steps).
+
+Cost model of one group (K members, weight bytes W each, output bytes O
+each, mesh of n devices, bank degree Bk):
+
+whole-mesh (weights replicated, batch-sharded over n):
+  - dense weight-grad all-reduce across the n replicas: ring cost of
+    K*W bytes (the dominant term for embedding tables — the reference
+    avoids it the same way, by not replicating tables);
+  - optimizer update touches all K tables on EVERY device: 3*K*W bytes
+    of HBM traffic per device.
+
+banked (bank degree Bk, batch-sharded n/Bk inside each subset):
+  - grad all-reduce only inside each subset over its own members:
+    ring cost of (K/Bk)*W bytes over n/Bk replicas;
+  - per-device update traffic: 3*(K/Bk)*W;
+  - rejoin all-gather of member outputs over the bank axes:
+    K*O*(Bk-1)/Bk bytes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..parallel.banks import BankSpec, choose_bank_axes, find_bank_groups
+from ..parallel.machine import DeviceMesh
+from .costmodel import OpCostModel
+
+
+def _ring_allreduce_s(nbytes: float, n: int, cm: OpCostModel) -> float:
+    if n <= 1 or nbytes <= 0:
+        return 0.0
+    bw = getattr(cm, "coll_bw", None) or cm.spec.ici_bandwidth
+    lat = getattr(cm, "coll_lat", None) or cm.spec.ici_latency_us * 1e-6
+    return 2.0 * (n - 1) / n * nbytes / bw + (n - 1) * lat
+
+
+def _allgather_s(nbytes: float, n: int, cm: OpCostModel) -> float:
+    if n <= 1 or nbytes <= 0:
+        return 0.0
+    bw = getattr(cm, "coll_bw", None) or cm.spec.ici_bandwidth
+    lat = getattr(cm, "coll_lat", None) or cm.spec.ici_latency_us * 1e-6
+    return (n - 1) / n * nbytes / bw + (n - 1) * lat
+
+
+def _weight_bytes(layer) -> int:
+    from ..dtypes import itemsize
+    from ..ops import get_op_def
+    op = get_op_def(layer.op_type)
+    specs = layer.weights or op.weights(
+        layer.params, [t.shape for t in layer.inputs],
+        [t.dtype for t in layer.inputs])
+    total = 0
+    for s in specs:
+        n = 1
+        for d in s.shape:
+            n *= d
+        total += n * itemsize(s.dtype)
+    return total
+
+
+def _output_bytes(layer) -> int:
+    from ..dtypes import itemsize
+    t = layer.outputs[0]
+    n = 1
+    for d in t.shape:
+        n *= d
+    return n * itemsize(t.dtype)
+
+
+def bank_group_cost(k: int, w_bytes: float, o_bytes: float, n: int,
+                    bank_deg: int, cm: OpCostModel) -> float:
+    """Per-step cost attributable to a K-member group at the given bank
+    degree (1 = whole-mesh). Compute (the lookups/matmuls themselves) is
+    identical on both sides and omitted; only the terms that differ are
+    charged."""
+    hbm = cm.spec.hbm_bandwidth
+    local_k = k / bank_deg
+    replicas = max(1, n // bank_deg)
+    grad_ar = _ring_allreduce_s(local_k * w_bytes, replicas, cm)
+    update = 3.0 * local_k * w_bytes / hbm
+    rejoin = _allgather_s(k * o_bytes * (bank_deg - 1) / bank_deg,
+                          bank_deg, cm) if bank_deg > 1 else 0.0
+    return grad_ar + update + rejoin
+
+
+def propose_banks(layers: Sequence, dmesh: DeviceMesh,
+                  cost_model: OpCostModel,
+                  reserved_axes: Sequence[str] = (),
+                  mode: str = "auto",
+                  ) -> List[Tuple[BankSpec, float, float]]:
+    """Returns ``[(spec, cost_whole_mesh, cost_banked), ...]`` for every
+    group predicted to win (or all eligible groups under ``force``)."""
+    if mode == "off" or dmesh.num_devices <= 1:
+        return []
+    out: List[Tuple[BankSpec, float, float]] = []
+    n = dmesh.num_devices
+    for gi, group in enumerate(find_bank_groups(layers)):
+        k = len(group)
+        axes = choose_bank_axes(dmesh, k, reserved=reserved_axes)
+        if axes is None:
+            continue
+        bank_axes, batch_axes = axes
+        spec = BankSpec([l.name for l in group], bank_axes,
+                        batch_axes=batch_axes,
+                        param_name=f"__bank{gi}__{group[0].op_type.name}")
+        bdeg = spec.bank_degree(dmesh)
+        w_b = float(sum(_weight_bytes(l) for l in group)) / k
+        o_b = float(sum(_output_bytes(l) for l in group)) / k
+        c_whole = bank_group_cost(k, w_b, o_b, n, 1, cost_model)
+        c_bank = bank_group_cost(k, w_b, o_b, n, bdeg, cost_model)
+        if mode == "force" or c_bank < 0.95 * c_whole:
+            out.append((spec, c_whole, c_bank))
+    return out
+
+
+def attach_banks(strategy, layers, cost_model,
+                 mode: str = "auto",
+                 reserved_axes: Sequence[str] = ()) -> List[BankSpec]:
+    """Attach winning banks to a ShardingStrategy in place. Skipped when
+    the strategy carries a pipeline region (bank members would need to
+    sit outside it; not composed in v1)."""
+    if getattr(strategy, "pipeline", None) is not None:
+        return []
+    props = propose_banks(layers, strategy.dmesh, cost_model,
+                          reserved_axes=reserved_axes, mode=mode)
+    specs = [p[0] for p in props]
+    strategy.banks = list(getattr(strategy, "banks", [])) + specs
+    return specs

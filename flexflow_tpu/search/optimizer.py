@@ -54,7 +54,8 @@ def optimize_strategy(ff):
         cost_model.calibrate_collectives(dmesh)
     t0 = time.perf_counter()
     if cfg.search_algo == "unity":
-        return _apply_floor_guard(ff, _unity(ff, cost_model, t0))
+        return _apply_floor_guard(
+            ff, _maybe_banks(ff, cost_model, _unity(ff, cost_model, t0)))
     budget = cfg.search_budget if cfg.search_budget > 0 else 500
     best, best_cost, sim = mcmc_search(
         ff.layers, dmesh, cost_model, budget=budget,
@@ -74,7 +75,8 @@ def optimize_strategy(ff):
         save_strategy(cfg.export_strategy_file, strategy, best,
                       {"best_cost": best_cost, "dp_cost": dp_cost})
     return _apply_floor_guard(
-        ff, _maybe_pipeline(ff, cost_model, best_cost, (strategy, None)))
+        ff, _maybe_banks(ff, cost_model, _maybe_pipeline(
+            ff, cost_model, best_cost, (strategy, None))))
 
 
 def _synth_batch(ff):
@@ -212,6 +214,44 @@ def _annotate_export(path: str, record) -> None:
             json.dump(doc, f, indent=1)
     except Exception:  # noqa: BLE001 — export annotation is best-effort
         pass
+
+
+def _maybe_banks(ff, cost_model, result):
+    """--banked-placement: attach per-op device-subset placements
+    (search/banking.py) to the searched strategy when the cost model
+    predicts a win; the measured DP-floor guard downstream still
+    arbitrates with real timed steps. Reference: MachineView
+    per-op placement (machine_view.h:14-62, DLRM strategies)."""
+    cfg = ff.config
+    mode = str(getattr(cfg, "banked_placement", "auto")).lower()
+    if mode == "off":
+        return result
+    strategy, info = result
+    layers = info.layers if info is not None else ff.layers
+    try:
+        from .banking import attach_banks
+        specs = attach_banks(strategy, layers, cost_model, mode=mode)
+        if specs and cfg.profiling:
+            for s in specs:
+                print(f"banked placement: {len(s.members)} x "
+                      f"{s.members[0].split('_')[0]} over axes {s.axes}")
+        if specs and cfg.export_strategy_file:
+            # the search path exported before banks attached; rewrite
+            # the banks field so --import round-trips the placement
+            try:
+                from .serialization import banks_to_json
+                with open(cfg.export_strategy_file) as f:
+                    doc = json.load(f)
+                doc["banks"] = banks_to_json(strategy)
+                with open(cfg.export_strategy_file, "w") as f:
+                    json.dump(doc, f, indent=1)
+            except Exception:  # noqa: BLE001 — export is best-effort
+                pass
+    except Exception as e:  # noqa: BLE001 — proposal must not kill compile
+        import logging
+        logging.getLogger("flexflow_tpu").warning(
+            "banked-placement proposal failed: %r", e)
+    return result
 
 
 def _maybe_pipeline(ff, cost_model, searched_cost, searched_result):

@@ -80,13 +80,29 @@ def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
     S, M, v = n_stages, region.n_microbatches, region.n_chunks
     dp = max(n_devices // (S * tp), 1)
     batch_deg = {0: dp * M}
-    t_stage = 0.0                # one CHUNK's per-microbatch time
+    ragged = getattr(region, "counts", None) is not None
+    t_block = 0.0                # one template block's per-microbatch time
     for l in region.template:
         cm = cost_model.op_cost(l, batch_deg)
         t = cm.forward_time + cm.backward_time
         if l.name in roles:
             t /= tp              # heads/columns split over the tp axis
-        t_stage += t
+        t_block += t
+    if ragged:
+        # every scan step executes max(counts) blocks (short stages
+        # mask) + the heavier of prologue/epilogue on the edge stages
+        t_stage = max(region.counts) * t_block
+
+        def _edge_t(ls):
+            total = 0.0
+            for l in ls:
+                c = cost_model.op_cost(l, batch_deg)
+                total += c.forward_time + c.backward_time
+            return total
+
+        t_stage += max(_edge_t(region.prologue), _edge_t(region.epilogue))
+    else:
+        t_stage = t_block        # one CHUNK = the whole template
     # handoff: the boundary activation (one microbatch, dp-sharded)
     by_guid = {t.guid: t for l in layers for t in l.outputs}
     entry_t = by_guid.get(region.entry_guid)
@@ -103,15 +119,30 @@ def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
             act_bytes, "all_reduce", tp)
     t_handoff = act_bytes / spec.ici_bandwidth + spec.ici_latency_us * 1e-6
     t_region = (M * v + S - 1) * (t_stage + t_handoff)
-    # outside layers at plain dp
+    # outside layers at plain dp (absorbed prologue/epilogue layers are
+    # inside the region under the ragged schedule)
     region_idx = set(range(region.start, region.end))
+    absorbed = set()
+    if ragged:
+        absorbed = {l.name for l in region.prologue} \
+            | {l.name for l in region.epilogue}
     t_out, w_bytes_out = 0.0, 0.0
     for i, l in enumerate(layers):
-        if i in region_idx or l.op_type == OperatorType.OP_INPUT:
+        if i in region_idx or l.op_type == OperatorType.OP_INPUT \
+                or l.name in absorbed:
             continue
         cm = cost_model.op_cost(l, {0: dp * S})
         t_out += cm.forward_time + cm.backward_time
         w_bytes_out += cm.weights_memory
+    if ragged:
+        # replicated prologue/epilogue weights sync over the whole mesh
+        from ..ops import get_op_def as _g
+        for l in list(region.prologue) + list(region.epilogue):
+            specs = l.weights or _g(l.op_type).weights(
+                l.params, [t.shape for t in l.inputs],
+                [t.dtype for t in l.inputs])
+            w_bytes_out += sum(int(np.prod(ws.shape)) * itemsize(ws.dtype)
+                               for ws in specs)
     # gradient sync over dp. Stage weights all-reduce over their own dp
     # group (disjoint groups run concurrently), so the region contributes
     # ONE stage's weight bytes, not S stages' (tp-split layers hold 1/tp
@@ -127,7 +158,9 @@ def score_pipeline(layers, spec: MachineSpec, cost_model: OpCostModel,
         if l.name in roles:
             wb /= tp
         w_bytes_stage += wb
-    w_bytes_stage *= v           # a stage holds v chunks' weights
+    # a stage holds v chunks' weights (uniform) or up to max(counts)
+    # blocks' weights (ragged)
+    w_bytes_stage *= max(region.counts) if ragged else v
     t_sync = cost_model.weight_sync_cost(w_bytes_stage + w_bytes_out, dp)
     return PipelineCandidate(S, M, dp, t_region + t_out + t_sync, region,
                              n_chunks=v, tp=tp)
@@ -150,6 +183,22 @@ def best_pipeline(layers, dmesh: DeviceMesh,
         ms = (microbatches,) if microbatches else (0, S, 4 * S, 8 * S)
         for v in (1, 2, 3, 4):
             region = find_pipeline_region(layers, S, 0, v)
+            if region is None and v == 1:
+                # ragged fallback: unequal stage depths + absorbed
+                # embedding/head (no interleave/tp composition in v1);
+                # sweep M like the uniform candidates
+                from ..parallel.pipeline_lowering import \
+                    find_ragged_pipeline_region
+                region = find_ragged_pipeline_region(layers, S, 0)
+                if region is not None:
+                    for M in ms:
+                        cand = score_pipeline(layers, dmesh.spec,
+                                              cost_model, S, n, M, 1,
+                                              region=region, tp=1)
+                        if cand is not None and (best is None
+                                                 or cand.cost < best.cost):
+                            best = cand
+                continue
             if region is None:
                 continue
             for tp in (1, 2, 4, 8):

@@ -7,6 +7,7 @@ round-trips through ``--import`` (the analog of the reference's
 ``GraphOptimalViewSerialized``, ``graph.cc:2162``)."""
 from __future__ import annotations
 
+import dataclasses
 import enum
 import json
 from typing import Any, Dict, List, Optional, Tuple
@@ -49,8 +50,29 @@ def save_strategy(path: str, strategy: ShardingStrategy,
         "assignment": {k: list(v) for k, v in (assignment or {}).items()},
         "meta": meta or {},
     }
+    banks_doc = banks_to_json(strategy)
+    if banks_doc:
+        doc["banks"] = banks_doc
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
+
+
+def banks_to_json(strategy: ShardingStrategy) -> List[Dict]:
+    """Serialize strategy.banks (shared by save_strategy and the
+    post-search export rewrite in search/optimizer.py). Each member's
+    device subset is recorded as a reference-parity machine view
+    (machine_view.h: start/num/stride in flat device order)."""
+    banks = getattr(strategy, "banks", None)
+    if not banks:
+        return []
+    return [
+        {"members": list(b.members), "axes": list(b.axes),
+         "batch_axes": list(b.batch_axes),
+         "param_name": b.param_name,
+         "machine_views": {
+             m: dataclasses.asdict(v)
+             for m, v in b.machine_views(strategy.dmesh).items()}}
+        for b in banks]
 
 
 # ---------------------------------------------------------------------------
@@ -162,4 +184,10 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
             [_spec_from_json(s) for s in os.get("outputs", [])],
             {w: _spec_from_json(s) for w, s in os.get("weights", {}).items()
              if s is not None})
+    if doc.get("banks"):
+        from ..parallel.banks import BankSpec
+        st.banks = [BankSpec(list(b["members"]), tuple(b["axes"]),
+                             batch_axes=tuple(b.get("batch_axes", ())),
+                             param_name=b.get("param_name", "__bank__"))
+                    for b in doc["banks"]]
     return st

@@ -950,6 +950,7 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
     if evaluator_cls is None:
         evaluator_cls = GraphCostEvaluator
     dp_predicted_total = None
+    final_ranker = "additive"
     if mem_budget_bytes is not None:
         g, gc = graph_optimize_with_memory(
             graph, xfers, cost_model, dmesh, mem_budget_bytes, budget,
@@ -970,15 +971,32 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
                                    dmesh)
         dp_gc = ev.graph_cost(dp_g)
         dp_predicted_total = dp_gc.total
-        if dp_gc.total < gc.total:
-            g, gc = dp_g, dp_gc
+        finalists = [(g, gc), (dp_g, dp_gc)]
         # hybrid composed-2D template floor (see hybrid_template_graphs)
         for _name, tg in hybrid_template_graphs(layers, input_tensors,
                                                 output_tensors, dmesh):
-            tgc = ev.graph_cost(tg)
-            if tgc.total < gc.total:
-                g, gc = tg, tgc
+            finalists.append((tg, ev.graph_cost(tg)))
+        # Final candidate ranking goes through the native event-driven
+        # task simulator so overlap/contention shapes the adoption, not
+        # just additive op costs (reference: the search trusts its
+        # event-driven simulator end-to-end, simulator.cc:822-1200).
+        # The additive evaluator remains the pruner inside the DP; only
+        # the few finalists are re-simulated.
+        if evaluator_cls is GraphCostEvaluator and len(finalists) > 1:
+            try:
+                from .tasksim import TaskGraphEvaluator
+                tev = TaskGraphEvaluator(cost_model, dmesh)
+                ranked = [(cg, tev.graph_cost(cg)) for cg, _ in finalists]
+                g, gc = min(ranked, key=lambda p: p[1].total)
+                dp_predicted_total = next(
+                    tgc.total for cg, tgc in ranked if cg is dp_g)
+                final_ranker = "tasksim"
+            except Exception:  # noqa: BLE001 — fall back to additive
+                g, gc = min(finalists, key=lambda p: p[1].total)
+        else:
+            g, gc = min(finalists, key=lambda p: p[1].total)
     info = g.to_program()
+    info.final_ranker = final_ranker
     # predicted DP-baseline cost (already computed for the DP floor in
     # the non-memory branch) — consumed by optimizer reporting
     info.dp_predicted_total = dp_predicted_total

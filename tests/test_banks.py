@@ -1,0 +1,176 @@
+"""Per-op concurrent device-subset placement (parallel/banks.py).
+
+Reference analog: MachineView per-op placement
+(``include/flexflow/machine_view.h:14-62``) and the DLRM strategies
+that place embedding tables on disjoint GPU subsets
+(``examples/cpp/DLRM/strategies/dlrm_strategy_16embs_16gpus.pb``)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models import DLRMConfig, build_dlrm
+from flexflow_tpu.parallel.banks import (BankSpec, choose_bank_axes,
+                                         find_bank_groups)
+from flexflow_tpu.parallel.machine import DeviceMesh, MachineSpec
+
+
+def _mesh8():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device mesh")
+    return DeviceMesh(MachineSpec(num_devices=8, generation="cpu-sim"))
+
+
+def _dlrm_batch(ff, batch, rng, n_classes=2):
+    out = {}
+    for t in ff.graph_inputs:
+        if "sparse" in t.name:
+            out[t.name] = rng.integers(0, 1000,
+                                       size=t.shape).astype(np.int32)
+        else:
+            out[t.name] = rng.normal(size=t.shape).astype(np.float32)
+    out["label"] = rng.integers(0, n_classes,
+                                size=(batch, 1)).astype(np.int32)
+    return out
+
+
+def _build(banked: bool, dcfg: DLRMConfig, batch=32):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.only_data_parallel = True   # strategy baseline; banks attached below
+    ff = FFModel(cfg)
+    out = build_dlrm(ff, batch, dcfg)
+    if banked:
+        # first compile resolves mesh/graph inputs; the second hands in
+        # the DP strategy with the bank attached (compile(strategy=...))
+        from flexflow_tpu.parallel.strategy import ShardingStrategy
+        import jax
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device mesh")
+        ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy",
+                   [], output_tensor=out)
+        dmesh = ff.dmesh
+        st = ShardingStrategy.data_parallel(ff.layers, ff.graph_inputs,
+                                            dmesh)
+        groups = find_bank_groups(ff.layers)
+        assert groups, "DLRM embedding tables must form a bank group"
+        members = [l.name for l in groups[0]]
+        axes = choose_bank_axes(dmesh, len(members))
+        assert axes is not None
+        bank_axes, batch_axes = axes
+        bk = BankSpec(members, bank_axes, batch_axes=batch_axes,
+                      param_name="__bank0__EMB")
+        # members leave the DP op map (the bank path owns their
+        # placement)
+        st.banks = [bk]
+        ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy",
+                   [], output_tensor=out, strategy=st)
+        return ff, bk
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return ff, None
+
+
+def test_find_groups_and_views():
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    ff = FFModel(cfg)
+    dcfg = DLRMConfig(embedding_size=(1000,) * 4)
+    build_dlrm(ff, 32, dcfg)
+    groups = find_bank_groups(ff.layers)
+    assert len(groups) == 1
+    assert len(groups[0]) == 4
+    assert all(l.name.startswith("emb_") for l in groups[0])
+
+    dmesh = _mesh8()
+    axes = choose_bank_axes(dmesh, 4)
+    assert axes is not None
+    bank_axes, batch_axes = axes
+    bk = BankSpec([l.name for l in groups[0]], bank_axes,
+                  batch_axes=batch_axes)
+    assert bk.bank_degree(dmesh) == 4
+    views = bk.machine_views(dmesh)
+    all_ids = [views[m].device_ids for m in bk.members]
+    # four disjoint 2-device subsets covering all 8 devices
+    flat = [i for ids in all_ids for i in ids]
+    assert sorted(flat) == list(range(8))
+    assert all(len(ids) == 2 for ids in all_ids)
+
+
+def test_banked_matches_unbanked_numerics():
+    """Banked and whole-mesh DLRM produce the same losses (same init
+    keys; only the placement differs)."""
+    dcfg = DLRMConfig(embedding_size=(1000,) * 4)
+    rng1, rng2 = np.random.default_rng(0), np.random.default_rng(0)
+    ff_a, _ = _build(False, dcfg)
+    ff_b, bk = _build(True, dcfg)
+    assert ff_b.strategy.banks
+    step_a = ff_a.executor.make_train_step()
+    step_b = ff_b.executor.make_train_step()
+    for i in range(3):
+        ba = _dlrm_batch(ff_a, 32, rng1)
+        bb = _dlrm_batch(ff_b, 32, rng2)
+        la = float(np.asarray(ff_a._run_train_step(step_a, ba)["loss"]))
+        lb = float(np.asarray(ff_b._run_train_step(step_b, bb)["loss"]))
+        assert np.isfinite(la) and np.isfinite(lb)
+        assert abs(la - lb) < 1e-4, (i, la, lb)
+
+
+def test_banked_weight_distribution():
+    """Each device holds only its subset's tables: per-device bytes of
+    the stacked bank weight = total / bank_degree."""
+    dcfg = DLRMConfig(embedding_size=(1000,) * 4)
+    ff, bk = _build(True, dcfg)
+    w = ff.params[bk.param_name]["kernel"]
+    assert w.shape == (4, 1000, 64)
+    shard_elems = {s.data.size for s in w.addressable_shards}
+    assert shard_elems == {w.size // 4}, shard_elems
+    # and the subsets are what machine_views reports: shard device ids
+    # for member k match the view
+    views = bk.machine_views(ff.dmesh)
+    by_dev = {s.device.id: s.index for s in w.addressable_shards}
+    for k, m in enumerate(bk.members):
+        for d in views[m].device_ids:
+            sl = by_dev[d][0]
+            assert sl.start <= k < sl.stop, (m, d, sl)
+
+
+def test_propose_banks_dlrm():
+    """The search proposes banking for DLRM-sized tables and the cost
+    model predicts the win (dense-grad all-reduce and update shrink by
+    the bank degree)."""
+    from flexflow_tpu.search.banking import propose_banks
+    from flexflow_tpu.search.costmodel import OpCostModel
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    ff = FFModel(cfg)
+    dcfg = DLRMConfig(embedding_size=(100000,) * 4)
+    build_dlrm(ff, 32, dcfg)
+    dmesh = _mesh8()
+    cm = OpCostModel(dmesh.spec)
+    props = propose_banks(ff.layers, dmesh, cm)
+    assert props, "banking should win for 100k-row tables"
+    spec, c_whole, c_bank = props[0]
+    assert c_bank < c_whole
+    assert spec.bank_degree(dmesh) == 4
+
+
+def test_compile_auto_banks_search_path():
+    """End-to-end: a searched DLRM compile attaches banks via
+    --banked-placement auto and still trains."""
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.only_data_parallel = False
+    cfg.search_budget = 4
+    cfg.search_floor_guard = "off"   # keep the test fast
+    ff = FFModel(cfg)
+    dcfg = DLRMConfig(embedding_size=(50000,) * 4)
+    out = build_dlrm(ff, 32, dcfg)
+    ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    assert getattr(ff.strategy, "banks", []), \
+        "auto banked placement should fire for DLRM"
+    rng = np.random.default_rng(0)
+    batch = _dlrm_batch(ff, 32, rng)
+    bm = ff._run_train_step(ff.executor.make_train_step(), batch)
+    assert np.isfinite(float(np.asarray(bm["loss"])))

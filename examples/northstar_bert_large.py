@@ -28,9 +28,16 @@ import os
 import sys
 import time
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=32").strip()
+import re as _re
+
+_flags = os.environ.get("XLA_FLAGS", "")
+_m = _re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
+if _m is None or int(_m.group(1)) < 32:
+    # keep a LARGER pre-set count (e.g. 64 for the 2-slice machine)
+    want = "--xla_force_host_platform_device_count=32"
+    _flags = _flags.replace(_m.group(0), want) if _m \
+        else (_flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = _flags
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

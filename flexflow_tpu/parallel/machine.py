@@ -61,6 +61,11 @@ class MachineSpec:
     # (``--machine-model-file``, parallel/topology.py:load_machine_file)
     ici_bandwidth_override: Optional[float] = None
     peak_flops_override: Optional[float] = None
+    # explicit fabric (parallel/topology.py GraphTopology): big-switch,
+    # degraded-link, or custom connection matrices — the reference's
+    # NetworkedMachineModel (simulator.h:381-515). None = derive from
+    # ici_shape (+ multi-slice DCN when num_slices > 1).
+    topology_override: Optional[object] = None
 
     @property
     def peak_flops(self) -> float:
@@ -84,9 +89,21 @@ class MachineSpec:
 
     @property
     def topology(self):
-        """Physical ICI torus when ``ici_shape`` is known, else None."""
+        """The physical fabric: an explicit ``topology_override`` when
+        set, a multi-slice ICI+DCN graph when ``num_slices > 1`` with a
+        known ``ici_shape``, a plain ICI torus when single-slice, else
+        None."""
+        if self.topology_override is not None:
+            return self.topology_override
         if self.ici_shape is None:
             return None
+        if self.num_slices > 1:
+            from .topology import GraphTopology
+            return GraphTopology.multi_slice_torus(
+                tuple(self.ici_shape), self.num_slices,
+                ici_bw=self.ici_bandwidth, dcn_bw=self.dcn_bandwidth,
+                hosts_per_slice=max(
+                    1, self.num_hosts // max(1, self.num_slices)))
         from .topology import TorusTopology
         return TorusTopology(tuple(self.ici_shape))
 

@@ -265,6 +265,13 @@ def _absorbable_prologue(layers: Sequence[Layer], start: int, end: int,
                 if t.guid in produced}
     if crossing != {entry_guid}:
         return None, None
+    # every pre output must be consumed by pre or the region: an
+    # unconsumed pre tensor may be a graph OUTPUT (hidden-state export),
+    # and absorbing its producer would strand it at trace time
+    consumed = {t.guid for l in layers for t in l.inputs}
+    for g in produced:
+        if g not in consumed:
+            return None, None
     return pre, list(raw_inputs.values())
 
 
@@ -322,6 +329,14 @@ def _absorbable_epilogue(layers: Sequence[Layer], end: int,
         for t in l.inputs:
             if t.guid in internal:
                 return [], None
+    # and every swallowed tensor must be consumed INSIDE the chain: an
+    # unconsumed interior tensor may be a graph output (e.g. a
+    # hidden-states export in a multi-output program) that tracing
+    # would then fail to find in env
+    chain_consumed = {t.guid for l in chain for t in l.inputs}
+    for g in internal:
+        if g not in chain_consumed:
+            return [], None
     return chain, out_guid
 
 

@@ -190,12 +190,15 @@ def pipeline_strategy(layers, input_tensors, dmesh: DeviceMesh,
         region = rag if (rag.end - rag.start) \
             > (uniform.end - uniform.start) else uniform
     if region is None:
+        ragged_tried = ragged in ("auto", "force") \
+            and n_chunks <= 1 and tp <= 1
         raise ValueError(
             f"graph has no repeated-block region divisible into "
             f"{n_stages} identical stages"
             + (f" x {n_chunks} chunks" if n_chunks > 1 else "")
-            + ("" if ragged == "off" else
-               " (ragged fallback found none either)"))
+            + (" (ragged fallback found none either)" if ragged_tried
+               else " (ragged fallback not applicable with "
+                    "interleaving/tp)" if ragged != "off" else ""))
     region.pp_axis = pp_axis
     region.dp_axes = tuple(dp_axes)
     if tp > 1:

@@ -104,6 +104,154 @@ class TorusTopology:
         return idx
 
 
+class GraphTopology:
+    """Arbitrary weighted interconnect: a directed connection matrix over
+    devices, with weighted shortest-path routing.
+
+    Analog of the reference's ``NetworkedMachineModel`` + connection-
+    matrix generators + ``WeightedShortestPathRoutingStrategy``
+    (``src/runtime/network.cc:1-586``, ``include/flexflow/
+    simulator.h:381-515``). Where the torus model is exact for one
+    healthy slice, this expresses what it cannot: big-switch fabrics,
+    degraded links, heterogeneous multi-slice pods (ICI inside each
+    slice, DCN between them).
+
+    ``conn[(i, j)]`` is the link bandwidth in bytes/s (absent = no
+    link). The task simulator charges each link on a route a duration
+    scaled by ``link_factor`` — the ratio of the fastest link's
+    bandwidth to this link's — so a DCN hop or a degraded link
+    serializes traffic proportionally longer. The ``Link`` key is
+    ``(src, 0, dst)``: same 3-tuple arity as the torus's
+    ``(device, dim, dir)`` ports, so ``link_index``/``ring_links``
+    consumers work unchanged.
+    """
+
+    def __init__(self, num_devices: int,
+                 conn: Dict[Tuple[int, int], float]):
+        self.num_devices = num_devices
+        self.conn = dict(conn)
+        self.max_bw = max(conn.values()) if conn else 1.0
+        self._route_cache: Dict[Tuple[int, int], List[Link]] = {}
+        # Dijkstra weight: transfer time per byte (1/bw)
+        self._adj: Dict[int, List[Tuple[int, float]]] = {}
+        for (i, j), bw in conn.items():
+            self._adj.setdefault(i, []).append((j, 1.0 / max(bw, 1.0)))
+
+    # ---- constructors (reference network.cc topology generators) ----
+    @classmethod
+    def from_torus(cls, shape: Sequence[int],
+                   bw: float = 1.0) -> "GraphTopology":
+        t = TorusTopology(tuple(shape))
+        conn: Dict[Tuple[int, int], float] = {}
+        for d in range(t.num_devices):
+            c = t.coord(d)
+            for k, s in enumerate(shape):
+                for step in ((1, -1) if s >= 3 else (1,) if c[k] + 1 < s
+                             else ()):
+                    nc = list(c)
+                    nc[k] = (nc[k] + step) % s
+                    conn[(d, t.device(nc))] = bw
+                    conn[(t.device(nc), d)] = bw
+        return cls(t.num_devices, conn)
+
+    @classmethod
+    def big_switch(cls, n: int, bw: float = 1.0) -> "GraphTopology":
+        """Full crossbar: every pair directly connected (the reference's
+        ``FlatDegConstraintNetworkTopologyGenerator`` limit case)."""
+        conn = {(i, j): bw for i in range(n) for j in range(n) if i != j}
+        return cls(n, conn)
+
+    @classmethod
+    def degraded(cls, base: "GraphTopology",
+                 slow_links: Sequence[Tuple[int, int]],
+                 factor: float) -> "GraphTopology":
+        """Copy of ``base`` with the listed (src, dst) links running at
+        ``bw / factor`` (fault/brownout modeling)."""
+        conn = dict(base.conn)
+        for (i, j) in slow_links:
+            if (i, j) in conn:
+                conn[(i, j)] = conn[(i, j)] / factor
+        return cls(base.num_devices, conn)
+
+    @classmethod
+    def multi_slice_torus(cls, shape: Sequence[int], n_slices: int,
+                          ici_bw: float, dcn_bw: float,
+                          hosts_per_slice: int = 1) -> "GraphTopology":
+        """``n_slices`` tori joined by DCN: each slice exposes
+        ``hosts_per_slice`` gateway devices (block-contiguous hosts'
+        first chips) with all-to-all DCN links between slices — the
+        fabric of a real multi-slice pod."""
+        one = cls.from_torus(shape, ici_bw)
+        per = one.num_devices
+        conn: Dict[Tuple[int, int], float] = {}
+        for s in range(n_slices):
+            off = s * per
+            for (i, j), bw in one.conn.items():
+                conn[(off + i, off + j)] = bw
+        chips_per_host = max(1, per // max(1, hosts_per_slice))
+        gateways = [list(range(s * per, (s + 1) * per, chips_per_host))
+                    for s in range(n_slices)]
+        for a in range(n_slices):
+            for b in range(n_slices):
+                if a == b:
+                    continue
+                for ga, gb in zip(gateways[a], gateways[b]):
+                    conn[(ga, gb)] = dcn_bw
+        return cls(per * n_slices, conn)
+
+    # ---- routing (WeightedShortestPathRoutingStrategy analog) ----
+    def route(self, src: int, dst: int) -> List[Link]:
+        if src == dst:
+            return []
+        hit = self._route_cache.get((src, dst))
+        if hit is not None:
+            return hit
+        import heapq
+        dist = {src: 0.0}
+        prev: Dict[int, int] = {}
+        pq = [(0.0, src)]
+        while pq:
+            d, u = heapq.heappop(pq)
+            if u == dst:
+                break
+            if d > dist.get(u, float("inf")):
+                continue
+            for v, w in self._adj.get(u, ()):
+                nd = d + w
+                if nd < dist.get(v, float("inf")):
+                    dist[v] = nd
+                    prev[v] = u
+                    heapq.heappush(pq, (nd, v))
+        if dst not in prev:
+            raise ValueError(f"no route {src} -> {dst} in topology")
+        path = [dst]
+        while path[-1] != src:
+            path.append(prev[path[-1]])
+        path.reverse()
+        links = [(path[i], 0, path[i + 1]) for i in range(len(path) - 1)]
+        self._route_cache[(src, dst)] = links
+        return links
+
+    def hop_distance(self, a: int, b: int) -> int:
+        return len(self.route(a, b))
+
+    def ring_links(self, devices: Sequence[int]) -> List[List[Link]]:
+        n = len(devices)
+        return [self.route(devices[i], devices[(i + 1) % n])
+                for i in range(n)]
+
+    def link_index(self) -> Dict[Link, int]:
+        return {(i, 0, j): k
+                for k, (i, j) in enumerate(sorted(self.conn.keys()))}
+
+    def link_factor(self, link: Link) -> float:
+        """Duration multiplier for traffic on this link relative to the
+        fastest link in the fabric (DCN/degraded links serialize
+        longer)."""
+        bw = self.conn.get((link[0], link[2]))
+        return self.max_bw / bw if bw else 1.0
+
+
 # ----------------------------------------------------------------------
 # machine description files (--machine-model-file)
 # ----------------------------------------------------------------------
@@ -161,6 +309,9 @@ def load_machine_file(path: str):
                 float(cfg["ici_bandwidth_gbps"]) * 1e9
         if "peak_tflops" in cfg:
             spec.peak_flops_override = float(cfg["peak_tflops"]) * 1e12
+        if "topology" in cfg:
+            spec.topology_override = topology_from_json(cfg["topology"],
+                                                        spec)
         return spec
 
     # reference INI: nodes x sockets x gpus-per-socket accelerators;
@@ -182,6 +333,42 @@ def load_machine_file(path: str):
     if "nvlink_bandwidth" in cfg:
         spec.ici_bandwidth_override = float(cfg["nvlink_bandwidth"]) * 1e9
     return spec
+
+
+def topology_from_json(doc: Dict, spec) -> GraphTopology:
+    """Build a ``GraphTopology`` from a machine-file ``topology`` block.
+
+    Kinds (reference topology generators, ``network.cc``):
+      - ``{"kind": "torus", "shape": [4, 8]}``
+      - ``{"kind": "big_switch", "n": 32}``
+      - ``{"kind": "multi_slice_torus", "shape": [4, 8], "n_slices": 2,
+         "hosts_per_slice": 8}``
+      - ``{"kind": "degraded", "base": {...}, "slow_links": [[0, 1]],
+         "factor": 4}``
+      - ``{"kind": "matrix", "n": 4,
+         "links": [[src, dst, bandwidth_gbps], ...]}``
+    """
+    kind = doc.get("kind", "torus")
+    ici = spec.ici_bandwidth
+    if kind == "torus":
+        return GraphTopology.from_torus(doc["shape"], ici)
+    if kind == "big_switch":
+        return GraphTopology.big_switch(int(doc["n"]), ici)
+    if kind == "multi_slice_torus":
+        return GraphTopology.multi_slice_torus(
+            doc["shape"], int(doc["n_slices"]), ici_bw=ici,
+            dcn_bw=spec.dcn_bandwidth,
+            hosts_per_slice=int(doc.get("hosts_per_slice", 1)))
+    if kind == "degraded":
+        base = topology_from_json(doc["base"], spec)
+        return GraphTopology.degraded(
+            base, [tuple(l) for l in doc["slow_links"]],
+            float(doc["factor"]))
+    if kind == "matrix":
+        conn = {(int(s), int(d)): float(bw) * 1e9
+                for s, d, bw in doc["links"]}
+        return GraphTopology(int(doc["n"]), conn)
+    raise ValueError(f"unknown topology kind {kind!r}")
 
 
 def _prod(xs) -> int:

@@ -36,22 +36,6 @@ from ..parallel.machine import DeviceMesh
 from .costmodel import OpCostModel
 
 
-def _ring_allreduce_s(nbytes: float, n: int, cm: OpCostModel) -> float:
-    if n <= 1 or nbytes <= 0:
-        return 0.0
-    bw = getattr(cm, "coll_bw", None) or cm.spec.ici_bandwidth
-    lat = getattr(cm, "coll_lat", None) or cm.spec.ici_latency_us * 1e-6
-    return 2.0 * (n - 1) / n * nbytes / bw + (n - 1) * lat
-
-
-def _allgather_s(nbytes: float, n: int, cm: OpCostModel) -> float:
-    if n <= 1 or nbytes <= 0:
-        return 0.0
-    bw = getattr(cm, "coll_bw", None) or cm.spec.ici_bandwidth
-    lat = getattr(cm, "coll_lat", None) or cm.spec.ici_latency_us * 1e-6
-    return (n - 1) / n * nbytes / bw + (n - 1) * lat
-
-
 def _weight_bytes(layer) -> int:
     from ..dtypes import itemsize
     from ..ops import get_op_def
@@ -86,10 +70,14 @@ def bank_group_cost(k: int, w_bytes: float, o_bytes: float, n: int,
     hbm = cm.spec.hbm_bandwidth
     local_k = k / bank_deg
     replicas = max(1, n // bank_deg)
-    grad_ar = _ring_allreduce_s(local_k * w_bytes, replicas, cm)
+    # collectives priced by the SAME calibrated/hierarchical model the
+    # rest of the search uses (costmodel.xfer_cost handles multi-slice
+    # ICI+DCN decomposition and measured-coll constants)
+    grad_ar = cm.xfer_cost(local_k * w_bytes, "all_reduce", replicas) \
+        if replicas > 1 else 0.0
     update = 3.0 * local_k * w_bytes / hbm
-    rejoin = _allgather_s(k * o_bytes * (bank_deg - 1) / bank_deg,
-                          bank_deg, cm) if bank_deg > 1 else 0.0
+    rejoin = cm.xfer_cost(k * o_bytes, "all_gather", bank_deg) \
+        if bank_deg > 1 else 0.0
     return grad_ar + update + rejoin
 
 

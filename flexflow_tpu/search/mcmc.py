@@ -116,11 +116,63 @@ def data_parallel_assignment(layers: Sequence[Layer], dmesh: DeviceMesh,
     return assign
 
 
+def _option_signature(opts: Sequence[ShardOption]) -> Tuple:
+    return tuple((o.kind, o.out_dim) for o in opts)
+
+
+def _propagate_neighbors(layer: Layer, cand: Tuple[int, ...],
+                         sim: StrategySimulator,
+                         consumers: Dict[int, List[Layer]],
+                         dmesh: DeviceMesh, rng,
+                         p_cont: float = 0.7) -> Dict[str, Tuple[int, ...]]:
+    """Flood the mutated config to same-shape neighbors.
+
+    Reference ``FFModel::propagate`` (``model.cc:3181-3261``,
+    ``FF_USE_PROPAGATE``): after rewriting one op's parallel config, the
+    proposal copies it to graph neighbors with matching output shape and
+    option structure, continuing each hop with probability ``p_cont`` —
+    so chain-structured graphs (transformer blocks) change whole
+    segments per step instead of one op, removing the resharding seams
+    single-op moves leave behind."""
+    sig = _option_signature(sim.options[layer.name])
+    oshape = tuple(layer.outputs[0].shape) if layer.outputs else None
+    changed: Dict[str, Tuple[int, ...]] = {layer.name: cand}
+    frontier = [layer]
+    while frontier:
+        cur = frontier.pop()
+        nbrs: List[Layer] = []
+        for t in cur.inputs:
+            if t.owner_layer is not None:
+                nbrs.append(t.owner_layer)
+        for t in cur.outputs:
+            nbrs.extend(consumers.get(t.guid, ()))
+        for nb in nbrs:
+            if nb.name in changed or nb.name not in sim.options:
+                continue
+            if not nb.outputs \
+                    or tuple(nb.outputs[0].shape) != oshape:
+                continue
+            if _option_signature(sim.options[nb.name]) != sig:
+                continue
+            if rng.random() > p_cont:
+                continue
+            if assignment_to_sharding(nb, sim.options[nb.name], cand,
+                                      dmesh) is None:
+                continue
+            changed[nb.name] = cand
+            frontier.append(nb)
+    return changed
+
+
 def mcmc_search(layers: Sequence[Layer], dmesh: DeviceMesh,
                 cost_model: OpCostModel, budget: int = 1000,
                 alpha: float = 0.05, seed: int = 0,
-                verbose: bool = False):
-    """Returns (best_assignment, best_cost, simulator)."""
+                verbose: bool = False, propagate: bool = True):
+    """Returns (best_assignment, best_cost, simulator).
+
+    ``propagate`` enables the reference's ``FF_USE_PROPAGATE`` proposal
+    (``model.cc:3181-3261``): each accepted rewrite may carry its config
+    to same-shape neighbors, accepted/rejected atomically."""
     rng = random.Random(seed)
     sim = StrategySimulator(layers, dmesh, cost_model)
     valid_degrees = dmesh.valid_degrees()
@@ -130,6 +182,10 @@ def mcmc_search(layers: Sequence[Layer], dmesh: DeviceMesh,
     shardable = [l for l in layers if sim.options[l.name]]
     if not shardable or budget <= 0:
         return best, best_cost, sim
+    consumers: Dict[int, List[Layer]] = {}
+    for l in layers:
+        for t in l.inputs:
+            consumers.setdefault(t.guid, []).append(l)
     for it in range(budget):
         layer = rng.choice(shardable)
         opts = sim.options[layer.name]
@@ -146,7 +202,13 @@ def mcmc_search(layers: Sequence[Layer], dmesh: DeviceMesh,
         # realizability check (divisibility + axis allocation)
         if assignment_to_sharding(layer, opts, cand, dmesh) is None:
             continue
-        current[layer.name] = cand
+        if propagate:
+            moves = _propagate_neighbors(layer, cand, sim, consumers,
+                                         dmesh, rng)
+        else:
+            moves = {layer.name: cand}
+        olds = {n: current[n] for n in moves}
+        current.update(moves)
         new_cost = sim.evaluate(current).total
         delta = new_cost - cur_cost
         if delta < 0 or rng.random() < math.exp(-delta / max(
@@ -157,7 +219,7 @@ def mcmc_search(layers: Sequence[Layer], dmesh: DeviceMesh,
                 if verbose:
                     print(f"  mcmc iter {it}: best {best_cost * 1e3:.3f} ms")
         else:
-            current[layer.name] = old
+            current.update(olds)
     return best, best_cost, sim
 
 

@@ -93,11 +93,15 @@ class TaskGraphBuilder:
         on the shared link processors."""
         out = []
         if self.topo is not None and len(devices) > 1:
+            # heterogeneous fabrics (GraphTopology): a DCN or degraded
+            # link serializes the same bytes for link_factor x longer
+            factor = getattr(self.topo, "link_factor", None)
             for hops in self.topo.ring_links(devices):
                 prev = None
                 for link in hops:
                     t = self.add_task(self.n_dev + self.link_idx[link],
-                                      seconds)
+                                      seconds * (factor(link)
+                                                 if factor else 1.0))
                     if prev is None:
                         for a in after:
                             self.dep(a, t)
@@ -112,9 +116,14 @@ class TaskGraphBuilder:
             if out:
                 return out
             # fully-local ring (all routes empty): charge the first
-            # participant's first link so time is still accounted
-            first = (devices[0], 0, 1)
-            procs = [self.n_dev + self.link_idx[first]] * len(devices)
+            # participant's first outgoing link so time is accounted
+            first = next((l for l in self.link_idx
+                          if l[0] == devices[0]), None)
+            if first is None:
+                procs = [self.n_dev + d for d in devices]
+            else:
+                procs = [self.n_dev + self.link_idx[first]] \
+                    * len(devices)
         else:
             procs = [self.n_dev + d for d in devices]
         for p in procs:

@@ -12,8 +12,8 @@ live ``FFModel`` or from the torch-frontend's serialization hand-off
 (``ModelRepository.load_graph`` -> ``file_to_ff``).
 """
 from .session import InferenceSession, ModelRepository
-from .scheduler import BatchScheduler
+from .scheduler import BatchScheduler, QueueFullError, SchedulerMetrics
 from .http_server import serve_http
 
 __all__ = ["InferenceSession", "ModelRepository", "BatchScheduler",
-           "serve_http"]
+           "QueueFullError", "SchedulerMetrics", "serve_http"]

@@ -3,12 +3,17 @@
 Endpoints (JSON bodies, shapes row-major):
   - ``GET  /v2/health/ready``            -> 200 when serving
   - ``GET  /v2/models``                  -> {"models": [names]}
+  - ``GET  /v2/metrics``                 -> per-model scheduler counters
+    (requests/completed/rejected, queue depth, mean batch rows,
+    latency p50/p99 ms, instances)
   - ``POST /v2/models/<name>/infer``     -> {"outputs": [{"data", "shape"}]}
-    body: {"inputs": [{"name": ..., "shape": [...], "data": [flat]}]}
+    body: {"inputs": [{"name": ..., "shape": [...], "data": [flat]}]};
+    bounded-queue overflow -> 503
   - ``POST /v2/models/<name>/generate``  -> {"outputs": [{"name":
     "output_ids", ...}]} — causal-LM decode; body adds
     {"parameters": {"prompt_len", "max_new_tokens", "temperature", "top_k", "top_p",
     "seed", "eos_token_id"}}
+  - ``POST /v2/repository/models/<name>/unload`` -> remove a model
 
 Reference analog: the Triton backend's HTTP surface
 (``/root/reference/triton/README.md``); stdlib-only so it runs anywhere
@@ -22,6 +27,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
+
+from .scheduler import QueueFullError
 
 
 def _make_handler(repo, schedulers):
@@ -42,10 +49,32 @@ def _make_handler(repo, schedulers):
                 return self._send(200, {"ready": True})
             if self.path == "/v2/models":
                 return self._send(200, {"models": repo.names()})
+            if self.path == "/v2/metrics":
+                # per-model scheduler counters + latency percentiles
+                # (Triton's /metrics endpoint, prometheus-lite as JSON)
+                out = {}
+                # snapshot: a concurrent unload may pop from schedulers
+                for name, sched in list(schedulers.items()):
+                    out[name] = sched.metrics.snapshot(
+                        sched._q.qsize())
+                    out[name]["instances"] = sched.num_instances
+                return self._send(200, {"models": out})
             return self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
             parts = self.path.strip("/").split("/")
+            # v2/repository/models/<name>/unload (Triton repository API)
+            if len(parts) == 5 and parts[:3] == ["v2", "repository",
+                                                 "models"] \
+                    and parts[4] == "unload":
+                try:
+                    repo.unload(parts[3])
+                    sched = schedulers.pop(parts[3], None)
+                    if sched is not None:
+                        sched.close()
+                    return self._send(200, {"unloaded": parts[3]})
+                except KeyError as e:
+                    return self._send(404, {"error": str(e)})
             # v2/models/<name>/{infer,generate}
             if len(parts) != 4 or parts[:2] != ["v2", "models"] \
                     or parts[3] not in ("infer", "generate"):
@@ -101,6 +130,9 @@ def _make_handler(repo, schedulers):
                     "data": np.asarray(out, np.float32).ravel().tolist()}]})
             except KeyError as e:
                 self._send(404, {"error": str(e)})
+            except QueueFullError as e:
+                # bounded-queue backpressure: shed load explicitly
+                self._send(503, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — report, don't die
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
 
@@ -109,16 +141,19 @@ def _make_handler(repo, schedulers):
 
 def serve_http(repo, host: str = "127.0.0.1", port: int = 8000,
                batching: bool = True, block: bool = True,
-               max_batch: int = 64, max_delay_ms: float = 2.0):
+               max_batch: int = 64, max_delay_ms: float = 2.0,
+               max_queue: int = 256):
     """Serve a :class:`ModelRepository`. ``block=False`` returns the
-    (server, thread, schedulers) triple for in-process testing."""
+    (server, thread, schedulers) triple for in-process testing. Each
+    model's scheduler drains a bounded queue (``max_queue``; overflow =
+    HTTP 503) with one worker per registered instance."""
     from .scheduler import BatchScheduler
     schedulers = {}
     if batching:
         for name in repo.names():
             schedulers[name] = BatchScheduler(
-                repo.get(name), max_batch=max_batch,
-                max_delay_ms=max_delay_ms)
+                repo.get_instances(name), max_batch=max_batch,
+                max_delay_ms=max_delay_ms, max_queue=max_queue)
     srv = ThreadingHTTPServer((host, port), _make_handler(repo, schedulers))
     if block:
         try:

@@ -1,50 +1,135 @@
 """Dynamic micro-batching for inference requests.
 
 Triton's dynamic batcher (``preferred_batch_size`` +
-``max_queue_delay_microseconds``) reimplemented in ~100 lines: requests
-queue up; a worker drains up to ``max_batch`` of them (or whatever
-arrived within ``max_delay_ms``), stacks them into one device batch, and
-fans the result back out per request. On TPU the win is identical to the
-GPU case — one big MXU-shaped batch instead of many tiny dispatches.
+``max_queue_delay_microseconds``) reimplemented in a few hundred lines:
+requests queue up; per-instance workers drain up to ``max_batch`` of
+them (or whatever arrived within ``max_delay_ms``), stack them into one
+device batch, and fan the result back out per request. On TPU the win is
+identical to the GPU case — one big MXU-shaped batch instead of many
+tiny dispatches.
+
+Triton-scope hardening (reference ``triton/src/instance.cc``,
+``backend.cc``):
+  - **bounded queue + backpressure**: the queue holds at most
+    ``max_queue`` requests; beyond that ``infer`` raises
+    :class:`QueueFullError` (HTTP 503) instead of growing without bound;
+  - **N concurrent instances**: one worker thread per model instance
+    (Triton's ``instance_group { count: N }``), all draining the shared
+    queue;
+  - **metrics**: per-model counters + latency reservoir feeding the
+    ``/v2/metrics`` endpoint (p50/p99, queue depth, batch sizes).
 """
 from __future__ import annotations
 
+import collections
 import queue
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 
+class QueueFullError(RuntimeError):
+    """Raised by ``infer`` when the bounded request queue is full —
+    callers should shed load (HTTP 503)."""
+
+
+class SchedulerMetrics:
+    """Thread-safe counters + latency reservoir for one scheduler."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self.batches = 0
+        self.batched_rows = 0
+        self._lat = collections.deque(maxlen=window)
+
+    def record_done(self, latency_s: float, ok: bool):
+        with self._lock:
+            self.completed += ok
+            self.failed += (not ok)
+            self._lat.append(latency_s)
+
+    def snapshot(self, queue_depth: int) -> Dict:
+        with self._lock:
+            lat = sorted(self._lat)
+            pct = (lambda p: lat[min(len(lat) - 1,
+                                     int(p * len(lat)))] if lat else 0.0)
+            return {
+                "requests": self.requests,
+                "completed": self.completed,
+                "failed": self.failed,
+                "rejected": self.rejected,
+                "batches": self.batches,
+                "mean_batch_rows": (self.batched_rows
+                                    / max(self.batches, 1)),
+                "queue_depth": queue_depth,
+                "latency_p50_ms": round(pct(0.50) * 1e3, 3),
+                "latency_p99_ms": round(pct(0.99) * 1e3, 3),
+            }
+
+
 class _Request:
-    __slots__ = ("inputs", "event", "result", "error")
+    __slots__ = ("inputs", "event", "result", "error", "t0")
 
     def __init__(self, inputs):
         self.inputs = inputs
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
+        self.t0 = time.perf_counter()
 
 
 class BatchScheduler:
-    """Queue + worker thread around an :class:`InferenceSession`."""
+    """Bounded queue + N instance workers around
+    :class:`InferenceSession` replicas.
 
-    def __init__(self, session, max_batch: int = 64,
-                 max_delay_ms: float = 2.0):
-        self.session = session
+    ``sessions`` may be one session or a list (one per concurrent
+    instance — Triton's instance group); each gets its own worker
+    thread draining the shared queue.
+    """
+
+    def __init__(self, sessions, max_batch: int = 64,
+                 max_delay_ms: float = 2.0, max_queue: int = 256):
+        if not isinstance(sessions, (list, tuple)):
+            sessions = [sessions]
+        assert sessions, "need at least one session instance"
+        self.sessions: List = list(sessions)
+        self.session = self.sessions[0]    # back-compat alias
         self.max_batch = max_batch
         self.max_delay_s = max_delay_ms / 1e3
-        self._q: "queue.Queue[_Request]" = queue.Queue()
+        self.metrics = SchedulerMetrics()
+        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
-        self._worker = threading.Thread(target=self._run, daemon=True)
-        self._worker.start()
+        self._workers = [
+            threading.Thread(target=self._run, args=(s,), daemon=True)
+            for s in self.sessions]
+        for w in self._workers:
+            w.start()
+
+    @property
+    def num_instances(self) -> int:
+        return len(self.sessions)
 
     # ------------------------------------------------------------------
     def infer(self, inputs: Dict[str, np.ndarray],
               timeout: float = 30.0) -> np.ndarray:
-        """Blocking single-request API (each row batch is one request)."""
+        """Blocking single-request API (each row batch is one request).
+        Raises :class:`QueueFullError` when the bounded queue is full."""
         r = _Request(inputs)
-        self._q.put(r)
+        try:
+            self._q.put_nowait(r)
+        except queue.Full:
+            with self.metrics._lock:
+                self.metrics.rejected += 1
+            raise QueueFullError(
+                f"request queue full ({self._q.maxsize}); retry later")
+        with self.metrics._lock:
+            self.metrics.requests += 1
         if not r.event.wait(timeout):
             raise TimeoutError("inference request timed out")
         if r.error is not None:
@@ -52,8 +137,20 @@ class BatchScheduler:
         return r.result
 
     def close(self):
+        """Stop the workers and promptly fail anything still queued —
+        an unload must not leave clients blocked until their timeout."""
         self._stop.set()
-        self._worker.join(timeout=5)
+        for w in self._workers:
+            w.join(timeout=5)
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            r.error = RuntimeError("scheduler closed (model unloaded)")
+            self.metrics.record_done(time.perf_counter() - r.t0,
+                                     ok=False)
+            r.event.set()
 
     # ------------------------------------------------------------------
     def _drain(self) -> List[_Request]:
@@ -66,7 +163,6 @@ class BatchScheduler:
         batch = [first]
         rows = int(next(iter(first.inputs.values())).shape[0])
         deadline = self.max_delay_s
-        import time
         t0 = time.perf_counter()
         while rows < self.max_batch:
             remaining = deadline - (time.perf_counter() - t0)
@@ -80,25 +176,34 @@ class BatchScheduler:
             rows += int(next(iter(r.inputs.values())).shape[0])
         return batch
 
-    def _run(self):
+    def _run(self, session):
         while not self._stop.is_set():
             batch = self._drain()
             if not batch:
                 continue
+            with self.metrics._lock:
+                self.metrics.batches += 1
+                self.metrics.batched_rows += sum(
+                    int(next(iter(r.inputs.values())).shape[0])
+                    for r in batch)
             try:
-                names = self.session.input_names
+                names = session.input_names
                 stacked = {
                     n: np.concatenate([r.inputs[n] for r in batch], axis=0)
                     for n in names}
-                out = self.session.infer(stacked)
+                out = session.infer(stacked)
             except Exception as e:  # noqa: BLE001 — fan the error out
+                now = time.perf_counter()
                 for r in batch:
                     r.error = e
+                    self.metrics.record_done(now - r.t0, ok=False)
                     r.event.set()
                 continue
             off = 0
+            now = time.perf_counter()
             for r in batch:
                 n = int(next(iter(r.inputs.values())).shape[0])
                 r.result = out[off:off + n]
                 off += n
+                self.metrics.record_done(now - r.t0, ok=True)
                 r.event.set()

@@ -35,6 +35,18 @@ class InferenceSession:
         self._fwd = ff.executor.make_forward()
         self._lock = threading.Lock()
 
+    def clone(self) -> "InferenceSession":
+        """A concurrent instance of the same model: shares the compiled
+        forward and parameters, carries its OWN dispatch lock — jitted
+        executions are thread-safe, so clones genuinely overlap
+        (Triton's instance_group over one device)."""
+        c = InferenceSession.__new__(InferenceSession)
+        c.ff = self.ff
+        c.buckets = self.buckets
+        c._fwd = self._fwd
+        c._lock = threading.Lock()
+        return c
+
     @property
     def input_names(self) -> List[str]:
         return [t.name for t in self.ff.graph_inputs]
@@ -129,13 +141,37 @@ class InferenceSession:
 
 
 class ModelRepository:
-    """Name -> session registry (Triton model-repository analog)."""
+    """Name -> session-instances registry (Triton model repository +
+    instance groups, ``triton/src/backend.cc``/``instance.cc``).
+
+    Each model may have N concurrent instances (session replicas); the
+    HTTP layer gives all of them to one :class:`BatchScheduler`, whose
+    per-instance workers drain a shared bounded queue. Models can be
+    loaded/unloaded by name at runtime (Triton repository API)."""
 
     def __init__(self):
-        self._models: Dict[str, InferenceSession] = {}
+        self._models: Dict[str, List[InferenceSession]] = {}
 
-    def register(self, name: str, session: InferenceSession):
-        self._models[name] = session
+    def register(self, name: str, session: InferenceSession,
+                 instances: "int | None" = None):
+        """Register a model. Pass a list of sessions OR ``instances=N``
+        to clone one session N times — clones share the compiled
+        forward and weights but have independent dispatch locks, so
+        the N scheduler workers genuinely overlap (Triton instances
+        sharing one device)."""
+        if isinstance(session, (list, tuple)):
+            self._models[name] = list(session)
+        elif instances and instances > 1:
+            self._models[name] = [session] + [
+                session.clone() for _ in range(int(instances) - 1)]
+        else:
+            self._models[name] = [session]
+
+    def unload(self, name: str):
+        """Remove a model by name (Triton ``.../unload``)."""
+        if name not in self._models:
+            raise KeyError(f"model {name!r} not loaded")
+        del self._models[name]
 
     def load_graph(self, name: str, path: str,
                    input_shapes: Sequence[Sequence[int]],
@@ -167,6 +203,10 @@ class ModelRepository:
         return sess
 
     def get(self, name: str) -> InferenceSession:
+        """First (primary) instance — the single-session API."""
+        return self.get_instances(name)[0]
+
+    def get_instances(self, name: str) -> List[InferenceSession]:
         if name not in self._models:
             raise KeyError(
                 f"model {name!r} not loaded (have {list(self._models)})")

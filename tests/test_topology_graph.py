@@ -1,0 +1,107 @@
+"""Arbitrary connection-matrix topologies + weighted shortest-path
+routing (parallel/topology.py::GraphTopology) and their effect on the
+task simulator. Reference: ``NetworkedMachineModel`` + topology
+generators + ``WeightedShortestPathRoutingStrategy``
+(``src/runtime/network.cc:1-586``, ``include/flexflow/
+simulator.h:381-515``)."""
+import numpy as np
+
+from flexflow_tpu.parallel.topology import (GraphTopology, TorusTopology,
+                                            topology_from_json)
+from flexflow_tpu.parallel.machine import MachineSpec
+
+
+def test_graph_torus_matches_torus_routing():
+    t = TorusTopology((4, 8))
+    g = GraphTopology.from_torus((4, 8), 50e9)
+    for a, b in [(0, 5), (3, 28), (7, 7), (31, 0)]:
+        assert len(g.route(a, b)) == t.hop_distance(a, b), (a, b)
+
+
+def test_big_switch_one_hop():
+    g = GraphTopology.big_switch(16, 50e9)
+    for a, b in [(0, 15), (3, 7)]:
+        assert len(g.route(a, b)) == 1
+    assert g.link_factor((0, 0, 15)) == 1.0
+
+
+def test_degraded_link_routes_around_and_costs_more():
+    base = GraphTopology.from_torus((4,), 50e9)
+    # ring 0-1-2-3; degrade 0->1 by 8x
+    deg = GraphTopology.degraded(base, [(0, 1)], 8.0)
+    assert deg.link_factor((0, 0, 1)) == 8.0
+    # weighted shortest path 0->1 now prefers 0->3->2->1 (3 fast hops
+    # beat one 8x-slow hop)
+    r = deg.route(0, 1)
+    assert len(r) == 3, r
+
+
+def test_multi_slice_dcn_factor():
+    g = GraphTopology.multi_slice_torus((2, 2), 2, ici_bw=50e9,
+                                        dcn_bw=5e9, hosts_per_slice=1)
+    assert g.num_devices == 8
+    # cross-slice route passes exactly one DCN link (factor 10)
+    r = g.route(0, 4)
+    factors = [g.link_factor(l) for l in r]
+    assert max(factors) == 10.0, factors
+    # intra-slice stays on ICI
+    assert all(g.link_factor(l) == 1.0 for l in g.route(0, 3))
+
+
+def test_topology_from_json_kinds():
+    spec = MachineSpec(num_devices=8, generation="v5e")
+    for doc in (
+        {"kind": "torus", "shape": [2, 4]},
+        {"kind": "big_switch", "n": 8},
+        {"kind": "multi_slice_torus", "shape": [2, 2], "n_slices": 2},
+        {"kind": "degraded", "base": {"kind": "torus", "shape": [8]},
+         "slow_links": [[0, 1]], "factor": 4},
+        {"kind": "matrix", "n": 3,
+         "links": [[0, 1, 50], [1, 2, 50], [2, 0, 5]]},
+    ):
+        g = topology_from_json(doc, spec)
+        assert g.num_devices >= 3
+
+
+def test_spec_multi_slice_topology_auto():
+    """num_slices > 1 + ici_shape derives the ICI+DCN graph fabric."""
+    spec = MachineSpec(num_devices=8, generation="v5e",
+                       ici_shape=(2, 2), num_slices=2, num_hosts=2)
+    topo = spec.topology
+    assert isinstance(topo, GraphTopology)
+    assert topo.num_devices == 8
+    assert any(topo.link_factor(l) > 1.0
+               for l in topo.link_index())
+
+
+def test_tasksim_charges_dcn_crossing():
+    """The event-driven sim costs a 2-slice mesh MORE than one healthy
+    slice for the same DP graph (its gradient all-reduce crosses DCN)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models import build_mlp
+    from flexflow_tpu.pcg.graph import Graph, ParAnn
+    from flexflow_tpu.parallel.machine import DeviceMesh
+    from flexflow_tpu.search.costmodel import OpCostModel
+    from flexflow_tpu.search.tasksim import TaskGraphEvaluator
+
+    def makespan(spec):
+        cfg = FFConfig()
+        cfg.batch_size = 64
+        ff = FFModel(cfg)
+        build_mlp(ff, 64, in_dim=64, hidden=(256,), num_classes=8)
+        dmesh = DeviceMesh(spec)
+        g = Graph.from_layers(ff.layers, ff.input_tensors,
+                              [ff.layers[-1].outputs[0]])
+        for node in g.topo_order():
+            if node.layer.outputs and node.layer.outputs[0].shape and \
+                    node.layer.outputs[0].shape[0] % 8 == 0:
+                node.ann = ParAnn(groups=(("dp", 8),),
+                                  out=((0, 0, "dp"),))
+        ev = TaskGraphEvaluator(OpCostModel(spec), dmesh)
+        return ev.graph_cost(g).total
+
+    one = MachineSpec(num_devices=8, generation="v5e",
+                      ici_shape=(2, 2, 2))
+    two = MachineSpec(num_devices=8, generation="v5e",
+                      ici_shape=(2, 2), num_slices=2, num_hosts=2)
+    assert makespan(two) > makespan(one) * 1.2

@@ -45,6 +45,27 @@ def run_example(name: str, build: Callable[[FFModel, FFConfig], object],
     ab = "--ab" in argv
     if ab:
         argv.remove("--ab")
+    def _take_int_flag(flag: str, default: int) -> int:
+        """Pop `--flag N` or `--flag=N` from argv; clear error if N is
+        missing/non-numeric (FFConfig would reject the leftover flag)."""
+        for i, a in enumerate(argv):
+            if a == flag or a.startswith(flag + "="):
+                if "=" in a:
+                    raw, end = a.split("=", 1)[1], i + 1
+                else:
+                    if i + 1 >= len(argv):
+                        raise SystemExit(f"{flag} requires a value")
+                    raw, end = argv[i + 1], i + 2
+                try:
+                    val = int(raw)
+                except ValueError:
+                    raise SystemExit(f"{flag} expects an int, got {raw!r}")
+                del argv[i:end]
+                return val
+        return default
+
+    repeats = max(1, _take_int_flag("--repeats", 1))
+    steps = max(steps, _take_int_flag("--min-steps", 0))
     cfg = FFConfig.parse_args(argv)
 
     def timed(only_dp: bool) -> float:
@@ -59,16 +80,24 @@ def run_example(name: str, build: Callable[[FFModel, FFConfig], object],
         step = ff.executor.make_train_step()
         bm = ff._run_train_step(step, b)     # compile + warmup
         float(np.asarray(bm["loss"]))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            bm = ff._run_train_step(step, b)
-        loss_v = float(np.asarray(bm["loss"]))  # D2H sync
-        dt = time.perf_counter() - t0
-        sps = c.batch_size * steps / dt
+        # --repeats N times the steady-state loop N times on the same
+        # compiled step and reports mean +/- stddev, so A/B ratios carry
+        # error bars instead of a single noisy sample
+        runs = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                bm = ff._run_train_step(step, b)
+            loss_v = float(np.asarray(bm["loss"]))  # D2H sync
+            dt = time.perf_counter() - t0
+            runs.append(c.batch_size * steps / dt)
+        sps = float(np.mean(runs))
+        std = float(np.std(runs, ddof=1)) if len(runs) > 1 else 0.0
         mode = "data-parallel" if c.only_data_parallel else "searched"
         # fixed-point, never scientific: osdi22ae/run_all.py parses this
         print(f"[{name}] {mode}: {sps:.3f} samples/s "
-              f"(loss {loss_v:.4f}, {steps} steps in {dt:.2f}s)")
+              f"(std {std:.3f}, n={repeats}, loss {loss_v:.4f}, "
+              f"{steps} steps in {dt:.2f}s)")
         pred = getattr(ff, "_search_predicted", None)
         if pred and not c.only_data_parallel:
             ratio = pred["dp_cost_s"] / max(pred["searched_cost_s"], 1e-12)

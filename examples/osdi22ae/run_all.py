@@ -34,7 +34,8 @@ MODELS = [
 ]
 
 _LINE = re.compile(r"\[(?P<name>[\w-]+)\] (?P<mode>data-parallel|searched):"
-                   r" (?P<sps>[\d.]+) samples/s")
+                   r" (?P<sps>[\d.]+) samples/s"
+                   r"(?: \(std (?P<std>[\d.]+), n=(?P<n>\d+))?")
 _RATIO = re.compile(r"searched vs data-parallel: (?P<ratio>[\d.]+)x")
 _PRED = re.compile(r"predicted searched-vs-dp: (?P<ratio>[\d.]+)x")
 _GUARD = re.compile(r"floor-guard adopted: (?P<which>\w+)")
@@ -70,9 +71,13 @@ def main():
     for script, args in MODELS:
         # --floor-guard true: the searched leg times itself against the
         # DP program and falls back when it measures slower, so no A/B
-        # row can lose to data parallel by more than timing noise
+        # row can lose to data parallel by more than timing noise.
+        # --repeats 3: each leg's steady-state loop is timed three times
+        # so every sps row carries a stddev; --min-steps 8 floors the
+        # short bert/transformer loops so per-run noise stays bounded
         cmd = [sys.executable, os.path.join(EXAMPLES, script), "--ab",
-               "--budget", "8", "--floor-guard", "true"] + args + extra
+               "--budget", "8", "--floor-guard", "true",
+               "--repeats", "3", "--min-steps", "8"] + args + extra
         t0 = time.time()
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,
@@ -84,9 +89,27 @@ def main():
                 key = "dp_sps" if m.group("mode") == "data-parallel" \
                     else "searched_sps"
                 entry[key] = float(m.group("sps"))
+                if m.group("std") is not None:
+                    entry[key + "_std"] = float(m.group("std"))
+                    entry[key + "_n"] = int(m.group("n"))
             m = _RATIO.search(out)
             if m:
                 entry["searched_vs_dp"] = float(m.group("ratio"))
+            # ratio error from per-leg standard errors of the mean
+            # (the sps values are means over n runs, so their
+            # uncertainty is std/sqrt(n), not the raw run-to-run std)
+            if ("searched_vs_dp" in entry and "dp_sps_std" in entry
+                    and "searched_sps_std" in entry
+                    and entry.get("dp_sps", 0) > 0
+                    and entry.get("searched_sps", 0) > 0):
+                sem_dp = (entry["dp_sps_std"]
+                          / entry.get("dp_sps_n", 1) ** 0.5)
+                sem_s = (entry["searched_sps_std"]
+                         / entry.get("searched_sps_n", 1) ** 0.5)
+                rel = ((sem_dp / entry["dp_sps"]) ** 2
+                       + (sem_s / entry["searched_sps"]) ** 2) ** 0.5
+                entry["searched_vs_dp_std"] = round(
+                    entry["searched_vs_dp"] * rel, 4)
             m = _PRED.search(out)
             if m:
                 entry["predicted_searched_vs_dp"] = float(m.group("ratio"))

@@ -2,10 +2,11 @@
 
 The CI tier runs the kernels in Pallas interpret mode on CPU
 (`tests/test_kernels.py`); this script is the compiled-on-TPU
-counterpart the driver environment can actually execute, covering the
-TPU-only path as well: in-kernel regenerated dropout
-(`flexflow_tpu/kernels/flash_attention.py` — pltpu PRNG has no
-interpret-mode lowering, so dropout_rate > 0 can ONLY run here).
+counterpart: Mosaic lowering, MXU-precision numerics, and the
+counter-based in-kernel dropout running compiled. (The round-4 run of
+this script caught two TPU-only bugs CPU CI cannot see: Mosaic's
+two-word PRNG seed limit, and a per-tile-seeded mask the
+differently-blocked backward could not regenerate.)
 
 Checks (each prints PASS/FAIL, exit code 1 on any failure):
   1. fwd numerics vs the plain-XLA golden, f32 + bf16, causal on/off,
@@ -50,11 +51,28 @@ def main():
 
     rng = np.random.default_rng(0)
 
+    # MXU default precision is a SINGLE bf16 pass even for fp32 inputs —
+    # both the Pallas kernel and the XLA oracle round their matmul
+    # operands to bf16, but with different accumulation orders (online
+    # softmax vs one-shot), so fp32-on-TPU agreement is bounded by bf16
+    # rounding (~1e-2), not fp32 eps. Measured r4 on v5e: fwd <=3.1e-3,
+    # bwd <=7.8e-3. The diagnostic below quantifies the hardware
+    # rounding itself: oracle@default vs oracle@HIGHEST (3-pass fp32).
+    b0, h0, s0, d0 = 2, 4, 512, 64
+    qd = jnp.asarray(rng.normal(size=(b0, h0, s0, d0)), jnp.float32)
+    kd = jnp.asarray(rng.normal(size=(b0, h0, s0, d0)), jnp.float32)
+    vd = jnp.asarray(rng.normal(size=(b0, h0, s0, d0)), jnp.float32)
+    o_def = mha_reference(qd, kd, vd)
+    o_hi = mha_reference(qd, kd, vd, precision=jax.lax.Precision.HIGHEST)
+    mxu_rel = rel_err(o_def, o_hi)
+    print(f"INFO mxu default-vs-HIGHEST oracle rel={mxu_rel:.2e} "
+          f"(fp32 tolerance floor on this hardware)", flush=True)
+
     # -- 1/2: numerics + grads ------------------------------------------
     # f32 covers the padded-seq case too; bf16 covers block-aligned only
     # (each (dtype, causal, seq) combo is ~2 remote compiles — keep it lean)
     for dtype, tol_f, tol_g, seqs in (
-            (jnp.float32, 2e-5, 2e-4, (512, 393)),
+            (jnp.float32, 1e-2, 2e-2, (512, 393)),
             (jnp.bfloat16, 2e-2, 4e-2, (512,))):
         for causal in (False, True):
             for seq in seqs:
@@ -103,22 +121,47 @@ def main():
     check("dropout keep-rate ~ E=1", abs(mean_keep - 1.0) < 0.05,
           f"mean={mean_keep:.4f}")
 
-    # vjp consistency: recover the kernel's keep mask by probing each
-    # attention with identity-ish tricks is overkill — instead verify the
-    # custom vjp against finite differences of the kernel itself.
-    def f_scalar(qv):
-        o = flash_attention(qv, k, v, dropout_rate=rate, dropout_seed=11)
-        return jnp.sum(o.astype(jnp.float32) * probe)
+    # vjp consistency: the keep mask is a pure position hash, so the
+    # exact mask is computable in plain XLA (dropout_keep_mask) and the
+    # kernel's grads can be checked against jax.grad of an explicit-
+    # masked golden. (Finite differences are useless here: MXU default
+    # precision rounds inputs to bf16, whose ~8e-3 resolution swallows
+    # an eps-sized perturbation — measured rel ~1 in the r4 runs even
+    # though compiled-vs-interpret grads agreed to 1e-4. fp32 fd runs
+    # in CPU CI: tests/test_kernels.py.)
+    from flexflow_tpu.kernels import dropout_keep_mask
+
+    def golden(qv, kv, vv):
+        import math as _m
+        sc = 1.0 / _m.sqrt(d)
+        s = (jnp.einsum("bhqd,bhkd->bhqk", qv, kv,
+                        precision=jax.lax.Precision.HIGHEST)
+             .astype(jnp.float32) * sc)
+        p = jax.nn.softmax(s, axis=-1)
+        keep = dropout_keep_mask(b, h, seq, seq, rate, 11)
+        p_eff = jnp.where(keep, p / (1.0 - rate), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p_eff, vv,
+                          precision=jax.lax.Precision.HIGHEST)
 
     probe = jnp.asarray(rng.normal(size=(b, h, seq, d)), jnp.float32)
-    g = jax.grad(f_scalar)(q)
-    eps = 1e-2
-    u = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
-    u = u / jnp.linalg.norm(u.reshape(-1))
-    fd = (f_scalar(q + eps * u) - f_scalar(q - eps * u)) / (2 * eps)
-    an = jnp.sum(g * u)
-    rel = abs(float(fd - an)) / (abs(float(fd)) + 1e-6)
-    check("dropout vjp vs finite-diff", rel < 2e-2, f"rel={rel:.2e}")
+
+    def loss_k(*x):
+        return jnp.sum(flash_attention(
+            *x, dropout_rate=rate, dropout_seed=11).astype(jnp.float32)
+            * probe)
+
+    def loss_g(*x):
+        return jnp.sum(golden(*x) * probe)
+
+    o_k = flash_attention(q, k, v, dropout_rate=rate, dropout_seed=11)
+    rel = rel_err(o_k, golden(q, k, v))
+    check("dropout fwd vs explicit-mask golden", rel < 1e-2,
+          f"rel={rel:.2e}")
+    g_k = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    g_g = jax.grad(loss_g, argnums=(0, 1, 2))(q, k, v)
+    worst = max(rel_err(a, b_) for a, b_ in zip(g_k, g_g))
+    check("dropout vjp vs explicit-mask golden", worst < 2e-2,
+          f"rel={worst:.2e}")
 
     print(f"\n{len(FAILED)} failures" if FAILED else "\nALL PASS")
     return 1 if FAILED else 0

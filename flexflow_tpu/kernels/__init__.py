@@ -17,10 +17,12 @@ with:
 All kernels run compiled on TPU and in Pallas interpret mode on CPU, so the
 test suite exercises them without hardware.
 """
-from .flash_attention import flash_attention, mha_reference
+from .flash_attention import (dropout_keep_mask, flash_attention,
+                              mha_reference)
 from .ring_attention import ring_attention, ulysses_attention
 
 __all__ = [
+    "dropout_keep_mask",
     "flash_attention",
     "mha_reference",
     "ring_attention",

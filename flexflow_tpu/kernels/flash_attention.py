@@ -8,10 +8,10 @@ and a custom VJP whose dq and dk/dv passes are separate Pallas kernels
 (the standard split so each pass has a sequential accumulation grid).
 
 Attention-probability dropout (the reference's cuDNN attnDropout) runs
-in-kernel: each (bh, q-block, k-block) tile seeds the per-core PRNG with
-(seed, tile coords), so the backward kernels regenerate the identical keep
-mask without storing it. The PRNG primitives only exist compiled-on-TPU,
-so dropout > 0 requires TPU; interpret mode (CPU tests) covers rate == 0.
+in-kernel and counter-based: keep[i, j] is a pure hash of (seed, bh,
+absolute q/k positions), so the differently-blocked backward kernels
+regenerate the identical keep mask without storing it, and the same
+hash lowers in interpret mode for CPU CI.
 
 Layout: (batch, heads, seq, head_dim), batch*heads collapsed into one grid
 axis. Sequence/head dims are padded to block/lane multiples; the padded-key
@@ -64,12 +64,44 @@ def _key_mask(iq, ik, block_q, block_k, kv_len, causal):
 
 
 def _tile_keep_mask(seed_ref, b, iq, ik, block_q, block_k, rate):
-    """Regenerable dropout keep-mask for one tile (rate is static)."""
-    pltpu.prng_seed(seed_ref[0, 0], b, iq, ik)
-    bits = pltpu.bitcast(pltpu.prng_random_bits((block_q, block_k)),
-                         jnp.uint32)
+    """Counter-based dropout keep-mask (rate is static).
+
+    keep[i, j] is a pure hash of (seed, batch-head, ABSOLUTE query
+    position, ABSOLUTE key position) — independent of the tiling — so
+    the forward (512x512 blocks) and backward (128x128 blocks) kernels
+    regenerate bit-identical masks. Found compiling on a real v5e: a
+    pltpu-PRNG mask seeded per (b, iq, ik) tile cannot be reproduced by
+    a differently-blocked backward pass, which silently corrupted dq
+    (and Mosaic's prng_set_seed_32 takes at most two seed words anyway).
+    A position hash also lowers in interpret mode, so CPU CI now covers
+    the dropout path. Mix: odd-constant multiplies folded by xor, then
+    the murmur3 fmix32 finalizer in uint32."""
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return _position_keep(seed_ref[0, 0], jnp.asarray(b, jnp.int32),
+                          q_pos, k_pos, rate)
+
+
+def _position_keep(seed, bh, q_pos, k_pos, rate):
+    """keep = hash(seed, bh, q_pos, k_pos) >= rate-threshold, in ops that
+    lower identically inside Pallas and in plain XLA — the single source
+    of truth for the dropout mask shared by the kernels (via
+    :func:`_tile_keep_mask`) and the explicit-mask golden (via
+    :func:`dropout_keep_mask`)."""
+    h = (seed * jnp.int32(-1640531527)                 # 0x9E3779B1
+         ^ bh * jnp.int32(840146601)                   # 0x3243F6A9
+         ^ q_pos * jnp.int32(-2048144789)              # 0x85EBCA6B
+         ^ k_pos * jnp.int32(-1028477387))             # 0xC2B2AE35
+    u = jax.lax.bitcast_convert_type(h, jnp.uint32)
+    u = u ^ (u >> jnp.uint32(16))
+    u = u * jnp.uint32(0x85EBCA6B)
+    u = u ^ (u >> jnp.uint32(13))
+    u = u * jnp.uint32(0xC2B2AE35)
+    u = u ^ (u >> jnp.uint32(16))
     thresh = min(int(rate * 4294967296.0), 4294967295)
-    return bits >= jnp.uint32(thresh)
+    return u >= jnp.uint32(thresh)
 
 
 # ---------------------------------------------------------------------------
@@ -359,8 +391,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     Pads seq dims to block multiples and head_dim to a multiple of 64
     (padded keys masked, padded head dims sliced off), runs the Pallas
     kernels, and is differentiable via the custom VJP. ``dropout_rate`` > 0
-    applies in-kernel dropout to the attention probabilities (TPU-compiled
-    only; requires ``dropout_seed``, an int32 scalar).
+    applies in-kernel counter-based dropout to the attention
+    probabilities (requires ``dropout_seed``, an int32 scalar).
 
     Block defaults are measured on v5e (head_dim 64): the forward wants
     large tiles (512x512 — k/v are re-streamed once per q block, so
@@ -368,13 +400,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     (128x128 — its dq/dkv scratch accumulators serialize the grid)."""
     if interpret is None:
         interpret = not _on_tpu()
-    if dropout_rate > 0.0:
-        if interpret:
-            raise NotImplementedError(
-                "in-kernel dropout requires compiled TPU execution "
-                "(pltpu PRNG has no interpret-mode lowering)")
-        if dropout_seed is None:
-            raise ValueError("dropout_rate > 0 requires dropout_seed")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if causal and sq != sk:
@@ -417,16 +444,37 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
 
 def mha_reference(q, k, v, *, causal: bool = False,
-                  sm_scale: Optional[float] = None):
+                  sm_scale: Optional[float] = None, precision=None):
     """Plain-XLA attention used as the numerics golden for the kernels.
-    Same layout as :func:`flash_attention`."""
+    Same layout as :func:`flash_attention`. ``precision`` feeds the
+    einsums (pass ``jax.lax.Precision.HIGHEST`` to force multi-pass fp32
+    on the MXU, whose default is a single bf16 pass)."""
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    s = (jnp.einsum("bhqd,bhkd->bhqk", q, k, precision=precision)
+         .astype(jnp.float32) * sm_scale)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = np.tril(np.ones((sq, sk), dtype=bool), sk - sq)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                      precision=precision).astype(q.dtype)
+
+
+def dropout_keep_mask(b, h, sq, sk, rate, seed):
+    """The kernel's counter-based keep mask, computed in plain XLA.
+
+    Bit-identical to what :func:`_tile_keep_mask` generates inside the
+    Pallas kernels under ANY block decomposition (same hash of the same
+    absolute coordinates), so an explicit-mask golden —
+    ``where(keep, softmax(s)/(1-rate), 0) @ v`` — reproduces the
+    kernel's dropout semantics exactly. Used by the on-chip validator
+    to check the compiled vjp without finite differences (MXU bf16
+    rounding swamps an eps-sized central difference)."""
+    bh = jnp.arange(b * h, dtype=jnp.int32)[:, None, None]
+    qp = jnp.arange(sq, dtype=jnp.int32)[None, :, None]
+    kp = jnp.arange(sk, dtype=jnp.int32)[None, None, :]
+    keep = _position_keep(jnp.int32(seed), bh, qp, kp, rate)
+    return keep.reshape(b, h, sq, sk)

@@ -533,14 +533,15 @@ class MultiHeadAttentionOp(OpDef):
                 and not params.get("sliding_window", 0):
             # (sliding-window masking stays on the XLA path — the Pallas
             # kernel has no window support)
-            # Pallas flash kernel ((b,h,s,d) layout); in-kernel prob dropout
-            # only when compiled on TPU — interpret mode falls back to XLA.
+            # Pallas flash kernel ((b,h,s,d) layout); counter-based
+            # in-kernel prob dropout runs compiled on TPU and in
+            # interpret mode alike.
             # (causal cross-attention with sq != sk stays on the XLA path.)
             # In "auto" mode the dropout>0 case stays on XLA (the in-kernel
-            # PRNG path is opt-in via use_flash_attention="true").
+            # dropout path is opt-in via use_flash_attention="true").
             from ..kernels import flash_attention
             on_tpu = jax.default_backend() == "tpu"
-            if rate > 0.0 and (not on_tpu or flash_mode != "true"):
+            if rate > 0.0 and flash_mode != "true":
                 pass  # fall through to the XLA path below
             else:
                 seed = None

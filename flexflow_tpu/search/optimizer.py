@@ -114,11 +114,13 @@ def _synth_batch(ff):
 
 def _time_strategy(ff, strategy, info):
     """Compile + time `floor_guard_steps` train steps of one strategy.
-    Returns (seconds/step, executor): the executor carries the compiled
-    jitted step, so FFModel.compile can adopt it instead of re-jitting
-    the winning program from scratch. The device->host fetch is the
-    sync point (block_until_ready does not synchronize on tunneled
-    backends)."""
+    Returns (mean seconds/step, executor, per_step_times, carry): the
+    executor carries the compiled jitted step, so FFModel.compile can
+    adopt it instead of re-jitting the winning program from scratch;
+    per_step_times + carry let the guard extend the measurement via
+    :func:`_extend_timing` when the decision is within timing noise.
+    The device->host fetch is the sync point (block_until_ready does
+    not synchronize on tunneled backends)."""
     import jax.numpy as jnp
     import numpy as np
     from ..executor import Executor, GraphProgram
@@ -138,11 +140,40 @@ def _time_strategy(ff, strategy, info):
     step = ex.make_train_step()
     p, o, s, bm = step(params, opt_state, state, jnp.int32(0), batch)
     float(np.asarray(bm["loss"]))  # compile + sync
-    t0 = time.perf_counter()
+    # per-step wall times (synced each step) so the guard can judge
+    # whether its decision margin exceeds the timing noise
+    times = []
     for i in range(steps):
+        t0 = time.perf_counter()
         p, o, s, bm = step(p, o, s, jnp.int32(i + 1), batch)
-    float(np.asarray(bm["loss"]))
-    return (time.perf_counter() - t0) / steps, ex
+        float(np.asarray(bm["loss"]))
+        times.append(time.perf_counter() - t0)
+    return sum(times) / len(times), ex, times, [step, p, o, s, batch]
+
+
+def _extend_timing(carry, times, extra):
+    """Run `extra` more synced steps on an already-compiled guard
+    executor, appending to its per-step time list. `carry` is mutated in
+    place: the step donates its inputs, so the post-step arrays must
+    replace the donated ones before any later extension round."""
+    import jax.numpy as jnp
+    import numpy as np
+    step, p, o, s, batch = carry
+    base = len(times)
+    for i in range(extra):
+        t0 = time.perf_counter()
+        p, o, s, bm = step(p, o, s, jnp.int32(base + i + 1), batch)
+        float(np.asarray(bm["loss"]))
+        times.append(time.perf_counter() - t0)
+    carry[1:4] = [p, o, s]
+    return times
+
+
+def _mean_std(times):
+    n = len(times)
+    m = sum(times) / n
+    var = sum((t - m) ** 2 for t in times) / (n - 1) if n > 1 else 0.0
+    return m, var ** 0.5
 
 
 def _apply_floor_guard(ff, result):
@@ -167,15 +198,32 @@ def _apply_floor_guard(ff, result):
     dp = ShardingStrategy.data_parallel(ff.layers, ff.graph_inputs,
                                         ff.dmesh)
     try:
-        t_s, ex_s = _time_strategy(ff, strategy, info)
-        t_dp, ex_dp = _time_strategy(ff, dp, None)
+        t_s, ex_s, times_s, carry_s = _time_strategy(ff, strategy, info)
+        t_dp, ex_dp, times_dp, carry_dp = _time_strategy(ff, dp, None)
+        # when the margin between the two means is inside the combined
+        # timing noise (2 x standard error), keep measuring — up to 4x
+        # the base step count — instead of deciding from ~3 noisy steps
+        max_steps = max(len(times_s), 4 * max(1, cfg.floor_guard_steps))
+        while len(times_s) < max_steps:
+            m_s, sd_s = _mean_std(times_s)
+            m_dp, sd_dp = _mean_std(times_dp)
+            sem = 2.0 * (sd_s ** 2 / len(times_s)
+                         + sd_dp ** 2 / len(times_dp)) ** 0.5
+            if abs(m_s - m_dp) > sem or (sd_s == 0.0 and sd_dp == 0.0):
+                break
+            extra = min(len(times_s), max_steps - len(times_s))
+            _extend_timing(carry_s, times_s, extra)
+            _extend_timing(carry_dp, times_dp, extra)
+        t_s, sd_s = _mean_std(times_s)
+        t_dp, sd_dp = _mean_std(times_dp)
     except Exception as e:  # noqa: BLE001 — guard must never kill compile
         if cfg.profiling:
             print(f"floor guard skipped ({e!r})")
         return result
     adopted = "searched" if t_s <= t_dp else "dp"
     record = {"searched_s_per_step": t_s, "dp_s_per_step": t_dp,
-              "adopted": adopted}
+              "searched_std": sd_s, "dp_std": sd_dp,
+              "n_steps": len(times_s), "adopted": adopted}
     ff._floor_guard_record = record
     # hand the winning side's compiled executor to FFModel.compile so
     # the adopted program is not re-jitted a third time (params are

@@ -72,6 +72,13 @@ class OpCostModel:
         # of searched strategies losing to DP on DLRM/XDL.
         self.coll_bw: Optional[float] = None
         self.coll_lat: Optional[float] = None
+        # segmented-transfer settings for the task simulator (reference
+        # EnhancedMachineModel, machine_model.cc: --simulator-segment-size
+        # / --simulator-max-num-segments). max_segments 1 = whole-message
+        # store-and-forward; >1 lets multi-hop transfers pipeline
+        # segment-wise across their route in tasksim.py.
+        self.segment_size: int = 16777216
+        self.max_segments: int = 1
         # on-device measurement (reference measure_operator_cost analog)
         self.measure_on_device = False
         self.measure_budget_s = 120.0   # total wall budget for microbenches

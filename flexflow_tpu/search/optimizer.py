@@ -34,6 +34,8 @@ def optimize_strategy(ff):
         return _import_strategy(ff, cfg.import_strategy_file, dmesh)
     spec = dmesh.spec
     cost_model = OpCostModel(spec)
+    cost_model.segment_size = max(1, cfg.simulator_segment_size)
+    cost_model.max_segments = max(1, cfg.simulator_max_num_segments)
     import jax
     if jax.devices()[0].platform != "cpu":
         # real chip: refine MXU efficiency with a matmul microbenchmark
@@ -203,13 +205,19 @@ def _apply_floor_guard(ff, result):
         # when the margin between the two means is inside the combined
         # timing noise (2 x standard error), keep measuring — up to 4x
         # the base step count — instead of deciding from ~3 noisy steps
-        max_steps = max(len(times_s), 4 * max(1, cfg.floor_guard_steps))
+        max_steps = max(2, len(times_s), 4 * max(1, cfg.floor_guard_steps))
         while len(times_s) < max_steps:
             m_s, sd_s = _mean_std(times_s)
             m_dp, sd_dp = _mean_std(times_dp)
             sem = 2.0 * (sd_s ** 2 / len(times_s)
                          + sd_dp ** 2 / len(times_dp)) ** 0.5
-            if abs(m_s - m_dp) > sem or (sd_s == 0.0 and sd_dp == 0.0):
+            # with a single sample the std is vacuously 0 and any margin
+            # would "exceed the noise" — force a second step first so a
+            # real variance estimate exists; past that, identical-to-the-
+            # bit times (only monkeypatched fakes) cannot shrink the sem
+            # by measuring more, so stop
+            if len(times_s) >= 2 and (abs(m_s - m_dp) > sem
+                                      or (sd_s == 0.0 and sd_dp == 0.0)):
                 break
             extra = min(len(times_s), max_steps - len(times_s))
             _extend_timing(carry_s, times_s, extra)

@@ -62,6 +62,8 @@ class TaskGraphBuilder:
         self.topo = topo if topo is not None \
             and topo.num_devices == n_dev else None
         self.link_idx = self.topo.link_index() if self.topo else None
+        self.segment_size = getattr(cost, "segment_size", 16777216)
+        self.max_segments = getattr(cost, "max_segments", 1)
 
     @property
     def num_procs(self) -> int:
@@ -83,36 +85,54 @@ class TaskGraphBuilder:
         return [i * stride for i in range(degree)]
 
     def comm_tasks(self, devices: List[int], seconds: float,
-                   after: List[int]) -> List[int]:
+                   after: List[int], nbytes: int = 0) -> List[int]:
         """Communication tasks for one ring collective.
 
         Without a topology: one task on each participant's injection
         port. With a torus: one task per physical link on each
         participant's route to its ring successor — multi-hop routes and
         link sharing between concurrent collectives then cost real time
-        on the shared link processors."""
+        on the shared link processors.
+
+        ``nbytes`` > 0 with ``--simulator-max-num-segments`` > 1 splits
+        each transfer into segments that pipeline across the route
+        (segment s can occupy hop k while segment s+1 is on hop k-1),
+        the reference EnhancedMachineModel's segmented transfers
+        (machine_model.cc, --simulator-segment-size): a multi-hop
+        transfer then costs ~(n_seg + hops - 1)/n_seg of its
+        store-and-forward time, and congestion on shared links is
+        resolved at segment granularity instead of whole messages."""
         out = []
+        n_seg = 1
+        if nbytes > 0 and self.max_segments > 1:
+            n_seg = min(self.max_segments,
+                        max(1, -(-nbytes // self.segment_size)))
         if self.topo is not None and len(devices) > 1:
             # heterogeneous fabrics (GraphTopology): a DCN or degraded
             # link serializes the same bytes for link_factor x longer
             factor = getattr(self.topo, "link_factor", None)
             for hops in self.topo.ring_links(devices):
-                prev = None
-                for link in hops:
-                    t = self.add_task(self.n_dev + self.link_idx[link],
-                                      seconds * (factor(link)
+                for s in range(n_seg):
+                    prev = None
+                    for link in hops:
+                        t = self.add_task(
+                            self.n_dev + self.link_idx[link],
+                            (seconds / n_seg) * (factor(link)
                                                  if factor else 1.0))
-                    if prev is None:
-                        for a in after:
-                            self.dep(a, t)
-                    else:
-                        # store-and-forward along the route: hop k starts
-                        # after hop k-1 (the reference charges each
-                        # CommDevice on the path the same way)
-                        self.dep(prev, t)
-                    prev = t
-                if prev is not None:
-                    out.append(prev)
+                        if prev is None:
+                            for a in after:
+                                self.dep(a, t)
+                        else:
+                            # store-and-forward along the route: this
+                            # segment's hop k starts after its hop k-1
+                            # (the reference charges each CommDevice on
+                            # the path the same way); segments of one
+                            # message serialize on each shared link via
+                            # the per-processor queue
+                            self.dep(prev, t)
+                        prev = t
+                    if prev is not None:
+                        out.append(prev)
             if out:
                 return out
             # fully-local ring (all routes empty): charge the first
@@ -195,10 +215,11 @@ class TaskGraphBuilder:
                     fwd_tasks[n.guid] = preds
                     continue
                 own = deg if t == OperatorType.OP_COMBINE else 1
-                secs = self.cost.xfer_cost(in_region(n, in_bytes, own),
-                                           coll, deg)
+                region = in_region(n, in_bytes, own)
+                secs = self.cost.xfer_cost(region, coll, deg)
                 devs = self.shard_devices(deg)
-                fwd_tasks[n.guid] = self.comm_tasks(devs, secs, preds)
+                fwd_tasks[n.guid] = self.comm_tasks(devs, secs, preds,
+                                                    nbytes=region)
                 continue
             if t in (OperatorType.OP_PIPELINE,
                      OperatorType.OP_FUSED_PARALLEL):
@@ -256,10 +277,11 @@ class TaskGraphBuilder:
                     bwd_tasks[n.guid] = succs
                     continue
                 own = deg if t == OperatorType.OP_COMBINE else 1
-                secs = self.cost.xfer_cost(in_region(n, in_bytes, own),
-                                           coll, deg)
+                region = in_region(n, in_bytes, own)
+                secs = self.cost.xfer_cost(region, coll, deg)
                 devs = self.shard_devices(deg)
-                bwd_tasks[n.guid] = self.comm_tasks(devs, secs, succs)
+                bwd_tasks[n.guid] = self.comm_tasks(devs, secs, succs,
+                                                    nbytes=region)
                 continue
             ann = n.ann
             scale_deg, place_deg = _compute_and_place_degree(ann)
@@ -282,7 +304,8 @@ class TaskGraphBuilder:
                 dp_deg = max(1, self.n_dev // wdeg)
                 secs = self.cost.weight_sync_cost(wbytes // wdeg, dp_deg)
                 if secs > 0:
-                    self.comm_tasks(self.shard_devices(place_deg), secs, ids)
+                    self.comm_tasks(self.shard_devices(place_deg), secs,
+                                    ids, nbytes=wbytes // wdeg)
 
         makespan = native.simulate(self.proc, self.dur, self.edges,
                                    self.num_procs)

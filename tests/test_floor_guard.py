@@ -37,7 +37,7 @@ def test_guard_keeps_dp_when_searched_measures_slower(monkeypatch):
         times["calls"] += 1
         # first call times the searched strategy, second times DP
         t = 1.0 if times["calls"] == 1 else 0.5
-        return t, None, [t], None
+        return t, None, [t, t], None
 
     monkeypatch.setattr(opt_mod, "_time_strategy", fake_time)
     ff = _searched_model(floor_guard="true")
@@ -64,7 +64,7 @@ def test_guard_adopts_searched_when_it_wins(monkeypatch):
     def fake_time(ff, strategy, info):
         times["calls"] += 1
         t = 0.5 if times["calls"] == 1 else 1.0
-        return t, None, [t], None
+        return t, None, [t, t], None
 
     monkeypatch.setattr(opt_mod, "_time_strategy", fake_time)
     ff = _searched_model(floor_guard="true")
@@ -89,7 +89,7 @@ def test_guard_real_timing_path():
 
 def test_guard_export_annotation(tmp_path, monkeypatch):
     def fake_time(ff, strategy, info):
-        return 0.5, None, [0.5], None
+        return 0.5, None, [0.5, 0.5], None
 
     monkeypatch.setattr(opt_mod, "_time_strategy", fake_time)
     path = str(tmp_path / "strategy.json")
@@ -119,7 +119,7 @@ def test_guard_export_rewritten_on_rejection(tmp_path, monkeypatch):
     def fake_time(ff, strategy, info):
         calls["n"] += 1
         t = 1.0 if calls["n"] == 1 else 0.5
-        return t, None, [t], None
+        return t, None, [t, t], None
 
     monkeypatch.setattr(opt_mod, "_time_strategy", fake_time)
     path = str(tmp_path / "strategy.json")

@@ -231,3 +231,58 @@ def test_compile_with_machine_model_file(tmp_path):
         .astype(np.int32)
     hist = ff.fit(x, y, epochs=1, verbose=False)
     assert np.isfinite(hist[-1]["loss"])
+
+
+# ----------------------------------------------------------------------
+# segmented transfers (reference EnhancedMachineModel,
+# --simulator-segment-size / --simulator-max-num-segments)
+# ----------------------------------------------------------------------
+
+def _pair_transfer_makespan(max_segments, nbytes=1 << 24):
+    """One transfer between two far-apart chips on a (4, 8) torus —
+    dimension-ordered routing gives a multi-hop route for segments to
+    pipeline across."""
+    from flexflow_tpu.search.costmodel import OpCostModel
+    from flexflow_tpu.search.tasksim import TaskGraphBuilder
+    from flexflow_tpu import native
+    spec = MachineSpec(num_devices=32, generation="v5e", ici_shape=(4, 8))
+    cm = OpCostModel(spec)
+    cm.segment_size = 1 << 22          # 4 MiB
+    cm.max_segments = max_segments
+    b = TaskGraphBuilder(cm, 32)
+    t = spec.topology
+    pair = [t.device((0, 0)), t.device((2, 3))]   # 2+3 = 5 hops
+    secs = cm.xfer_cost(nbytes, "all_gather", 2)
+    b.comm_tasks(pair, secs, [], nbytes=nbytes)
+    return native.simulate(b.proc, b.dur, b.edges, b.num_procs)
+
+
+def test_segmented_transfer_pipelines_multihop_route():
+    whole = _pair_transfer_makespan(max_segments=1)
+    seg = _pair_transfer_makespan(max_segments=4)
+    assert whole > 0 and seg > 0
+    # 16 MiB over a 5-hop route: whole-message store-and-forward costs
+    # 5 x T; 4 segments pipeline to (4 + 5 - 1)/4 x T = 2 x T per
+    # direction — strictly faster, and no faster than a single hop
+    assert seg < whole * 0.75
+    assert seg > whole / 5.0 * 0.99
+
+
+def test_segmented_transfer_default_off_is_unchanged():
+    """max_segments=1 (the default; the reference's simple machine
+    model) must reproduce the previous whole-message numbers exactly,
+    nbytes hint or not."""
+    from flexflow_tpu.search.costmodel import OpCostModel
+    from flexflow_tpu.search.tasksim import TaskGraphBuilder
+    from flexflow_tpu import native
+    spec = MachineSpec(num_devices=32, generation="v5e", ici_shape=(4, 8))
+    cm = OpCostModel(spec)
+    secs = cm.xfer_cost(1 << 24, "all_gather", 4)
+    g = [spec.topology.device((0, j)) for j in range(4)]
+    b1 = TaskGraphBuilder(cm, 32)
+    b1.comm_tasks(g, secs, [], nbytes=1 << 24)
+    b2 = TaskGraphBuilder(cm, 32)
+    b2.comm_tasks(g, secs, [])
+    m1 = native.simulate(b1.proc, b1.dur, b1.edges, b1.num_procs)
+    m2 = native.simulate(b2.proc, b2.dur, b2.edges, b2.num_procs)
+    assert m1 == m2

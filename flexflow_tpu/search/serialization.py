@@ -166,6 +166,142 @@ def program_from_json(doc: Dict, graph_inputs: List[Tensor]):
     return layers, out_t
 
 
+# ---------------------------------------------------------------------------
+# Legacy text strategy format (reference save/load_strategies_to_file,
+# src/runtime/strategy.cc:100-196): line-oriented —
+#   <num_ops>
+#   then per op: <name> / <device_type> / <nDims> / dim[0..n) /
+#   <num_device_ids> / device_ids[0..n)
+# The reference's DeviceType enum: 0 = GPU (accelerator), 1 = CPU; we
+# write 0 (the TPU plays the accelerator role).
+# ---------------------------------------------------------------------------
+def _spec_degrees(spec: Optional[P], rank: int, axis_sizes: Dict[str, int],
+                  ) -> List[int]:
+    """Per-tensor-dim shard degree for one PartitionSpec."""
+    degs = [1] * rank
+    if spec is None:
+        return degs
+    for j, e in enumerate(spec):
+        if j >= rank or e is None:
+            continue
+        names = e if isinstance(e, tuple) else (e,)
+        d = 1
+        for nm in names:
+            d *= axis_sizes.get(nm, 1)
+        degs[j] = d
+    return degs
+
+
+def save_legacy_strategies(path: str, strategy: ShardingStrategy,
+                           layers: List[Layer]) -> None:
+    """Export the searched strategy in the reference's text wire format
+    so its tooling (and ``load_strategies_from_file``-based flows) can
+    consume strategies searched here. Device ids are the flat mesh
+    order; ops with a bank placement write their bank members instead."""
+    axis_sizes = dict(strategy.dmesh.axis_sizes)
+    bank_of = {}
+    for b in getattr(strategy, "banks", None) or []:
+        for m in b.members:
+            bank_of[m] = b
+    by_name = {l.name: l for l in layers}
+    rows = []
+    for name, os in strategy.ops.items():
+        layer = by_name.get(name)
+        out_spec = os.outputs[0] if os.outputs else None
+        rank = len(layer.outputs[0].shape) if layer is not None \
+            and layer.outputs else (len(out_spec) if out_spec else 1)
+        degs = _spec_degrees(out_spec, rank, axis_sizes)
+        n = 1
+        for d in degs:
+            n *= d
+        bank = bank_of.get(name)
+        if bank is not None:
+            # banked op: its devices are the bank member's subset; the
+            # reference loader asserts prod(dims) == len(device_ids), so
+            # fold the subset's dp replication into the batch dim — and
+            # refuse to write a file the reference cannot load when the
+            # subset size is not a multiple of the sharded degree
+            view = bank.machine_views(strategy.dmesh)[name]
+            ids = list(view.device_ids)
+            if not degs or n == 0 or len(ids) % n != 0:
+                raise ValueError(
+                    f"op {name}: bank subset of {len(ids)} devices is "
+                    f"incompatible with shard degrees {degs} "
+                    f"(prod(dims) must equal the device count)")
+            degs[0] *= len(ids) // n
+            n = len(ids)
+        else:
+            ids = list(range(n))
+        rows.append((name, degs, ids))
+    with open(path, "w") as f:
+        f.write(f"{len(rows)}\n")
+        for name, degs, ids in rows:
+            f.write(f"{name}\n0\n{len(degs)}\n")
+            f.write("\t".join(str(d) for d in degs) + "\n")
+            f.write(f"{len(ids)}\n")
+            f.write("\t".join(str(i) for i in ids) + "\n")
+
+
+def load_legacy_strategies(path: str, layers, dmesh: DeviceMesh,
+                           ) -> ShardingStrategy:
+    """Import the reference's text strategy format. Per-dim degrees are
+    mapped back onto mesh axes greedily (axes in mesh order, largest
+    dims first); degrees that don't factor over the mesh raise."""
+    with open(path) as f:
+        toks = f.read().split()
+    pos = 0
+
+    def take() -> str:
+        nonlocal pos
+        t = toks[pos]
+        pos += 1
+        return t
+
+    n_ops = int(take())
+    st = ShardingStrategy(dmesh)
+    axis_items = list(dict(dmesh.axis_sizes).items())
+    for _ in range(n_ops):
+        name = take()
+        int(take())                       # device_type (accelerator)
+        ndims = int(take())
+        degs = [int(take()) for _ in range(ndims)]
+        n_ids = int(take())
+        for _ in range(n_ids):
+            take()                        # flat ids: placement implicit
+        free = dict(axis_items)           # axis -> size, unconsumed
+        entries = []
+        for d in degs:
+            if d == 1:
+                entries.append(None)
+                continue
+            # exact subset-product match over the unconsumed axes
+            # (greedy-in-mesh-order fails on e.g. {x0:2, x1:8} with
+            # d=8: consuming x0 first strands rem=4); axis counts are
+            # tiny so brute force is fine
+            import itertools
+            got: Optional[Tuple[str, ...]] = None
+            names = list(free)
+            for r in range(1, len(names) + 1):
+                for combo in itertools.combinations(names, r):
+                    p = 1
+                    for ax in combo:
+                        p *= free[ax]
+                    if p == d:
+                        got = combo
+                        break
+                if got:
+                    break
+            if got is None:
+                raise ValueError(
+                    f"op {name}: degree {d} does not factor over mesh "
+                    f"axes {dict(axis_items)}")
+            for ax in got:
+                del free[ax]
+            entries.append(got[0] if len(got) == 1 else tuple(got))
+        st.ops[name] = OpSharding([P(*entries)], {})
+    return st
+
+
 def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
     with open(path) as f:
         doc = json.load(f)

@@ -1,0 +1,144 @@
+"""Runtime inspection helpers — the TPU-native analog of the
+reference's gdb pretty-printers (``gdb/pretty_print.py``: Node / Edge /
+MachineView / Domain / TensorShape printers for debugging the C++
+runtime under gdb).
+
+Here the runtime objects are live Python/JAX values, so "pretty
+printing" means human-readable dumps of the same entities:
+
+  - :func:`describe_mesh` — the device mesh (MachineViewPrinter analog)
+  - :func:`describe_strategy` — per-op shardings + bank machine views
+    (Node/MachineView printers)
+  - :func:`describe_sharding` — how one array is laid out across
+    devices (DomainPrinter analog, per-shard index windows)
+  - :func:`dump_hlo` — the lowered/optimized HLO of the current train
+    step (what gdb-stepping the task graph becomes under XLA)
+  - :func:`compiled_memory_stats` — per-executable memory analysis
+
+All helpers are read-only and safe to call from a REPL or breakpoint at
+any point after ``FFModel.compile``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def describe_mesh(dmesh) -> str:
+    """One line per mesh axis plus the flat device order."""
+    axes = dict(dmesh.axis_sizes)
+    devs = list(dmesh.mesh.devices.ravel())
+    lines = [f"DeviceMesh<{dmesh.num_devices} devices, axes={axes}, "
+             f"gen={dmesh.spec.generation}>"]
+    for d in devs[:16]:
+        lines.append(f"  {d!r}")
+    if len(devs) > 16:
+        lines.append(f"  ... {len(devs) - 16} more")
+    return "\n".join(lines)
+
+
+def _spec_str(spec) -> str:
+    if spec is None:
+        return "replicated"
+    ent = []
+    for e in spec:
+        if e is None:
+            ent.append("*")
+        elif isinstance(e, tuple):
+            ent.append("+".join(e))
+        else:
+            ent.append(str(e))
+    return f"P({', '.join(ent)})"
+
+
+def describe_strategy(strategy, layers: Optional[List] = None) -> str:
+    """Tabular per-op view of a ShardingStrategy: output / weight specs
+    and, for banked ops, the reference-style machine view
+    (start:num:stride over flat device ids)."""
+    by_name = {l.name: l for l in (layers or [])}
+    bank_view = {}
+    for b in getattr(strategy, "banks", None) or []:
+        try:
+            for m, v in b.machine_views(strategy.dmesh).items():
+                bank_view[m] = v
+        except Exception:  # noqa: BLE001 — describe must never raise
+            pass
+    lines = [f"ShardingStrategy<{len(strategy.ops)} ops, "
+             f"mesh={dict(strategy.dmesh.axis_sizes)}>"]
+    for name, os in strategy.ops.items():
+        outs = ", ".join(_spec_str(s) for s in os.outputs) or "-"
+        ws = ", ".join(f"{w}={_spec_str(s)}"
+                       for w, s in os.weights.items()) or "-"
+        shape = ""
+        layer = by_name.get(name)
+        if layer is not None and layer.outputs:
+            shape = f" {tuple(layer.outputs[0].shape)}"
+        row = f"  {name}{shape}: out={outs} w={ws}"
+        v = bank_view.get(name)
+        if v is not None:
+            row += (f" view=[{v.start_device_id}:"
+                    f"{v.start_device_id + v.num_parts * v.stride}:"
+                    f"{v.stride}]")
+        lines.append(row)
+    return "\n".join(lines)
+
+
+def describe_sharding(array) -> str:
+    """Per-shard placement of one jax.Array: device + index window —
+    the Domain printer's ``i=[lo:hi]`` per dimension, per shard."""
+    try:
+        shards = array.addressable_shards
+    except AttributeError:
+        return f"{type(array).__name__}{getattr(array, 'shape', '')} " \
+               f"(no sharding info)"
+    lines = [f"Array{tuple(array.shape)} "
+             f"spec={getattr(array.sharding, 'spec', None)}"]
+    for s in shards:
+        win = ", ".join(
+            f"{i}=[{sl.start or 0}:{sl.stop if sl.stop is not None else n}]"
+            for i, (sl, n) in enumerate(zip(s.index, array.shape)))
+        lines.append(f"  {s.device!r}: {win or 'scalar'}")
+    return "\n".join(lines)
+
+
+def _lowered_train_step(ff):
+    """Re-trace the model's train step unjitted arguments -> jax.Lowered
+    (uses the executor's own jit wrapper + a synthetic batch)."""
+    import jax
+    from ..search.optimizer import _synth_batch
+    ex = ff.executor
+    step = ex.make_train_step()
+    inner = getattr(step, "__wrapped__", step)
+    batch = _synth_batch(ff)
+    import jax.numpy as jnp
+    fn = inner if hasattr(inner, "lower") else jax.jit(inner)
+    return fn.lower(ff.params, ff.opt_state, ff.state, jnp.int32(0), batch)
+
+
+def dump_hlo(ff, path: Optional[str] = None, optimized: bool = False) -> str:
+    """HLO text of the current train step; ``optimized=True`` returns
+    the post-XLA-passes module (requires a compile)."""
+    low = _lowered_train_step(ff)
+    if optimized:
+        txt = low.compile().as_text()
+    else:
+        txt = low.as_text()
+    if path:
+        with open(path, "w") as f:
+            f.write(txt)
+    return txt
+
+
+def compiled_memory_stats(ff) -> Dict[str, int]:
+    """XLA memory analysis of the compiled train step (bytes):
+    argument/output/temp/generated-code sizes. The practical answer to
+    'why did this strategy OOM' without a device dump."""
+    low = _lowered_train_step(ff)
+    ma = low.compile().memory_analysis()
+    out = {}
+    for k in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "generated_code_size_in_bytes",
+              "alias_size_in_bytes"):
+        v = getattr(ma, k, None)
+        if v is not None:
+            out[k] = int(v)
+    return out

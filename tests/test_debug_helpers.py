@@ -1,0 +1,50 @@
+"""utils/debug.py — runtime inspection helpers (the TPU-native analog
+of the reference's gdb pretty-printers, gdb/pretty_print.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.utils import debug
+
+
+def _model():
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 32), name="x")
+    out = ff.dense(ff.dense(x, 64, ActiMode.AC_MODE_RELU), 4)
+    ff.compile(SGDOptimizer(0.1), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    return ff
+
+
+def test_describe_mesh_and_strategy():
+    ff = _model()
+    m = debug.describe_mesh(ff.dmesh)
+    assert "DeviceMesh<8 devices" in m
+    s = debug.describe_strategy(ff.strategy, ff.layers)
+    assert "ShardingStrategy<" in s
+    # every op row shows an output spec
+    assert all("out=" in line for line in s.splitlines()[1:])
+
+
+def test_describe_sharding_windows():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(8), ("d",))
+    sh = jax.sharding.NamedSharding(mesh,
+                                    jax.sharding.PartitionSpec("d"))
+    arr = jax.device_put(jnp.arange(32.0).reshape(16, 2), sh)
+    txt = debug.describe_sharding(arr)
+    # 8 shards, each a [lo:hi] window over dim 0
+    assert txt.count("0=[") == 8
+    assert "0=[0:2]" in txt and "0=[14:16]" in txt
+
+
+def test_dump_hlo_and_memory_stats():
+    ff = _model()
+    hlo = debug.dump_hlo(ff)
+    assert "module" in hlo.lower()
+    stats = debug.compiled_memory_stats(ff)
+    assert stats.get("argument_size_in_bytes", 0) > 0
+    assert "temp_size_in_bytes" in stats

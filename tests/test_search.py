@@ -154,3 +154,60 @@ def test_mcmc_propagate_reaches_better_cost_in_fewer_iters():
         prop.append(c_p)
         noprop.append(c_n)
     assert sum(prop) < sum(noprop), (prop, noprop)
+
+
+def test_legacy_text_strategy_roundtrip(tmp_path):
+    """Reference-parity text strategy format (strategy.cc:100-196):
+    export a searched strategy, re-import it, and get the same per-dim
+    shard degrees back."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.search.serialization import (
+        load_legacy_strategies, save_legacy_strategies, _spec_degrees)
+
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.only_data_parallel = False
+    cfg.search_budget = 4
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 64), name="x")
+    t = ff.dense(x, 128, ActiMode.AC_MODE_RELU, name="fc0")
+    out = ff.dense(t, 8, name="out")
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    path = str(tmp_path / "strategy.txt")
+    layers = ff.executor.program.layers if hasattr(ff.executor, "program") \
+        else ff.layers
+    save_legacy_strategies(path, ff.strategy, layers)
+    # file structure: first token is the op count
+    toks = open(path).read().split()
+    assert int(toks[0]) == len(ff.strategy.ops)
+    st2 = load_legacy_strategies(path, layers, ff.dmesh)
+    axis_sizes = dict(ff.dmesh.axis_sizes)
+    by_name = {l.name: l for l in layers}
+    for name, os in ff.strategy.ops.items():
+        if name not in st2.ops or not os.outputs:
+            continue
+        layer = by_name.get(name)
+        rank = len(layer.outputs[0].shape) if layer is not None else None
+        if rank is None:
+            continue
+        d1 = _spec_degrees(os.outputs[0], rank, axis_sizes)
+        d2 = _spec_degrees(st2.ops[name].outputs[0], rank, axis_sizes)
+        assert d1 == d2, (name, d1, d2)
+
+
+def test_legacy_import_factors_over_uneven_axes(tmp_path):
+    """Regression: degree 4 on a {x0: 2, x1: 4} mesh must import as
+    ('x1',) — a greedy scan consuming x0 first strands remainder 2 and
+    falsely rejects the file."""
+    from flexflow_tpu.parallel.machine import DeviceMesh, MachineSpec
+    from flexflow_tpu.search.serialization import load_legacy_strategies
+    spec = MachineSpec(num_devices=8, generation="v5e")
+    dmesh = DeviceMesh(spec, mesh_shape=(2, 4))
+    assert dict(dmesh.axis_sizes) == {"x0": 2, "x1": 4}
+    path = str(tmp_path / "s.txt")
+    with open(path, "w") as f:
+        f.write("1\nfc0\n0\n2\n4\t1\n4\n0\t1\t2\t3\n")
+    st = load_legacy_strategies(path, [], dmesh)
+    spec0 = st.ops["fc0"].outputs[0]
+    assert tuple(spec0) == ("x1", None)

@@ -206,6 +206,11 @@ def save_legacy_strategies(path: str, strategy: ShardingStrategy,
     by_name = {l.name: l for l in layers}
     rows = []
     for name, os in strategy.ops.items():
+        if any(c.isspace() for c in name):
+            raise ValueError(
+                f"op name {name!r} contains whitespace, which the "
+                f"line-oriented legacy format cannot represent — "
+                f"rename the layer or use the JSON export")
         layer = by_name.get(name)
         out_spec = os.outputs[0] if os.outputs else None
         rank = len(layer.outputs[0].shape) if layer is not None \
@@ -266,8 +271,16 @@ def load_legacy_strategies(path: str, layers, dmesh: DeviceMesh,
         ndims = int(take())
         degs = [int(take()) for _ in range(ndims)]
         n_ids = int(take())
-        for _ in range(n_ids):
-            take()                        # flat ids: placement implicit
+        ids = [int(take()) for _ in range(n_ids)]
+        if ids and ids != list(range(len(ids))):
+            # a non-prefix device subset means a bank/machine-view
+            # placement, which per-dim degrees cannot express — refuse
+            # rather than silently import a different strategy (the
+            # JSON format round-trips banks losslessly)
+            raise ValueError(
+                f"op {name}: device ids {ids[:8]}... describe a device-"
+                f"subset placement; the legacy text import cannot "
+                f"represent it — use the JSON strategy format")
         free = dict(axis_items)           # axis -> size, unconsumed
         entries = []
         for d in degs:

@@ -13,7 +13,7 @@ captures queueing and compute/comm overlap that the additive
 """
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..ffconst import OperatorType
 from ..pcg.graph import Graph, PNode
@@ -84,6 +84,78 @@ class TaskGraphBuilder:
         stride = self.n_dev // degree
         return [i * stride for i in range(degree)]
 
+    # ring-algorithm round counts (reference LogicalTaskgraphBasedSimulator
+    # expands a logical allreduce into physical p2p rounds at sim time,
+    # simulator.h:785; same algebra as the calibrated cost model)
+    _ROUNDS = {"all_reduce": (lambda d: 2 * (d - 1)),
+               "all_gather": (lambda d: d - 1),
+               "reduce_scatter": (lambda d: d - 1),
+               "all_to_all": (lambda d: d - 1)}
+
+    def _chain_route(self, hops, secs: float, deps: List[int],
+                     n_seg: int, factor) -> List[int]:
+        """Segment-pipelined store-and-forward over one route; returns
+        the final-hop task of each segment (empty if the route is)."""
+        out = []
+        for _s in range(n_seg):
+            prev = None
+            for link in hops:
+                t = self.add_task(self.n_dev + self.link_idx[link],
+                                  (secs / n_seg) * (factor(link)
+                                                    if factor else 1.0))
+                for d in (deps if prev is None else [prev]):
+                    self.dep(d, t)
+                prev = t
+            if prev is not None:
+                out.append(prev)
+        return out
+
+    def collective_tasks(self, devices: List[int], coll: str,
+                         seconds: float, after: List[int],
+                         nbytes: int = 0) -> List[int]:
+        """Expand one logical collective into physical ring rounds.
+
+        Round r of participant i transfers its chunk to the ring
+        successor and cannot start before round r-1 of the PREDECESSOR
+        delivered (the chunk being forwarded) — the actual ring
+        dataflow, so concurrent collectives interleave with other
+        traffic at round granularity instead of whole-collective lumps.
+        The calibrated total is preserved: rounds x per-round = the
+        cost model's collective seconds. Falls back to the lump-sum
+        :meth:`comm_tasks` without a physical topology or for
+        degenerate/oversized expansions."""
+        deg = len(devices)
+        rounds = self._ROUNDS.get(coll, lambda d: 1)(deg) \
+            if deg > 1 else 1
+        if (self.topo is None or rounds <= 1 or rounds > 128):
+            return self.comm_tasks(devices, seconds, after, nbytes)
+        routes = self.topo.ring_links(devices)
+        if not routes or all(not h for h in routes):
+            return self.comm_tasks(devices, seconds, after, nbytes)
+        factor = getattr(self.topo, "link_factor", None)
+        n_seg = 1
+        round_bytes = nbytes // rounds if nbytes else 0
+        if round_bytes > 0 and self.max_segments > 1:
+            n_seg = min(self.max_segments,
+                        max(1, -(-round_bytes // self.segment_size)))
+        per_round = seconds / rounds
+        n = len(routes)
+        prev_last: List[Optional[int]] = [None] * n
+        for r in range(rounds):
+            cur: List[Optional[int]] = [None] * n
+            for i, hops in enumerate(routes):
+                if r == 0:
+                    deps = list(after)
+                else:
+                    deps = [t for t in (prev_last[(i - 1) % n],
+                                        prev_last[i]) if t is not None]
+                segs = self._chain_route(hops, per_round, deps, n_seg,
+                                         factor)
+                cur[i] = segs[-1] if segs else prev_last[i]
+            prev_last = cur
+        out = [t for t in prev_last if t is not None]
+        return out or self.comm_tasks(devices, seconds, after, nbytes)
+
     def comm_tasks(self, devices: List[int], seconds: float,
                    after: List[int], nbytes: int = 0) -> List[int]:
         """Communication tasks for one ring collective.
@@ -112,27 +184,8 @@ class TaskGraphBuilder:
             # link serializes the same bytes for link_factor x longer
             factor = getattr(self.topo, "link_factor", None)
             for hops in self.topo.ring_links(devices):
-                for s in range(n_seg):
-                    prev = None
-                    for link in hops:
-                        t = self.add_task(
-                            self.n_dev + self.link_idx[link],
-                            (seconds / n_seg) * (factor(link)
-                                                 if factor else 1.0))
-                        if prev is None:
-                            for a in after:
-                                self.dep(a, t)
-                        else:
-                            # store-and-forward along the route: this
-                            # segment's hop k starts after its hop k-1
-                            # (the reference charges each CommDevice on
-                            # the path the same way); segments of one
-                            # message serialize on each shared link via
-                            # the per-processor queue
-                            self.dep(prev, t)
-                        prev = t
-                    if prev is not None:
-                        out.append(prev)
+                out.extend(self._chain_route(hops, seconds, after,
+                                             n_seg, factor))
             if out:
                 return out
             # fully-local ring (all routes empty): charge the first
@@ -218,8 +271,8 @@ class TaskGraphBuilder:
                 region = in_region(n, in_bytes, own)
                 secs = self.cost.xfer_cost(region, coll, deg)
                 devs = self.shard_devices(deg)
-                fwd_tasks[n.guid] = self.comm_tasks(devs, secs, preds,
-                                                    nbytes=region)
+                fwd_tasks[n.guid] = self.collective_tasks(
+                    devs, coll, secs, preds, nbytes=region)
                 continue
             if t in (OperatorType.OP_PIPELINE,
                      OperatorType.OP_FUSED_PARALLEL):
@@ -280,8 +333,8 @@ class TaskGraphBuilder:
                 region = in_region(n, in_bytes, own)
                 secs = self.cost.xfer_cost(region, coll, deg)
                 devs = self.shard_devices(deg)
-                bwd_tasks[n.guid] = self.comm_tasks(devs, secs, succs,
-                                                    nbytes=region)
+                bwd_tasks[n.guid] = self.collective_tasks(
+                    devs, coll, secs, succs, nbytes=region)
                 continue
             ann = n.ann
             scale_deg, place_deg = _compute_and_place_degree(ann)
@@ -304,8 +357,12 @@ class TaskGraphBuilder:
                 dp_deg = max(1, self.n_dev // wdeg)
                 secs = self.cost.weight_sync_cost(wbytes // wdeg, dp_deg)
                 if secs > 0:
-                    self.comm_tasks(self.shard_devices(place_deg), secs,
-                                    ids, nbytes=wbytes // wdeg)
+                    # participants = the dp replica group the cost was
+                    # priced for (a dp_deg-way ring), NOT all placement
+                    # devices — the round count derives from len(devices)
+                    self.collective_tasks(self.shard_devices(dp_deg),
+                                          "all_reduce", secs, ids,
+                                          nbytes=wbytes // wdeg)
 
         makespan = native.simulate(self.proc, self.dur, self.edges,
                                    self.num_procs)

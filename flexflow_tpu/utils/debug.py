@@ -103,15 +103,15 @@ def describe_sharding(array) -> str:
 def _lowered_train_step(ff):
     """Re-trace the model's train step unjitted arguments -> jax.Lowered
     (uses the executor's own jit wrapper + a synthetic batch)."""
-    import jax
     from ..search.optimizer import _synth_batch
     ex = ff.executor
     step = ex.make_train_step()
-    inner = getattr(step, "__wrapped__", step)
     batch = _synth_batch(ff)
     import jax.numpy as jnp
-    fn = inner if hasattr(inner, "lower") else jax.jit(inner)
-    return fn.lower(ff.params, ff.opt_state, ff.state, jnp.int32(0), batch)
+    # lower the jitted step itself (donations and all) so the dumped
+    # HLO/memory analysis describe the executable that actually trains
+    return step.lower(ff.params, ff.opt_state, ff.state, jnp.int32(0),
+                      batch)
 
 
 def dump_hlo(ff, path: Optional[str] = None, optimized: bool = False) -> str:

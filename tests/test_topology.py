@@ -286,3 +286,65 @@ def test_segmented_transfer_default_off_is_unchanged():
     m1 = native.simulate(b1.proc, b1.dur, b1.edges, b1.num_procs)
     m2 = native.simulate(b2.proc, b2.dur, b2.edges, b2.num_procs)
     assert m1 == m2
+
+
+# ----------------------------------------------------------------------
+# ring-round collective expansion (reference
+# LogicalTaskgraphBasedSimulator's allreduce expansion, simulator.h:785)
+# ----------------------------------------------------------------------
+
+def _ring_builders(nbytes=1 << 22):
+    from flexflow_tpu.search.costmodel import OpCostModel
+    from flexflow_tpu.search.tasksim import TaskGraphBuilder
+    spec = MachineSpec(num_devices=32, generation="v5e", ici_shape=(4, 8))
+    cm = OpCostModel(spec)
+    t = spec.topology
+    g = [t.device((0, j)) for j in range(4)]
+    secs = cm.xfer_cost(nbytes, "all_reduce", 4)
+    return cm, g, secs
+
+
+def test_collective_round_expansion_task_count():
+    from flexflow_tpu.search.tasksim import TaskGraphBuilder
+    cm, g, secs = _ring_builders()
+    b_lump = TaskGraphBuilder(cm, 32)
+    b_lump.comm_tasks(g, secs, [])
+    b_ring = TaskGraphBuilder(cm, 32)
+    b_ring.collective_tasks(g, "all_reduce", secs, [])
+    # deg 4 all-reduce: 2*(4-1) = 6 rounds -> 6x the per-route tasks
+    assert len(b_ring.proc) == 6 * len(b_lump.proc)
+    # total charged link-seconds identical (calibrated total preserved)
+    assert abs(sum(b_ring.dur) - sum(b_lump.dur)) < 1e-12
+
+
+def test_collective_round_expansion_makespan_sane():
+    from flexflow_tpu.search.tasksim import TaskGraphBuilder
+    from flexflow_tpu import native
+    cm, g, secs = _ring_builders()
+    b_lump = TaskGraphBuilder(cm, 32)
+    b_lump.comm_tasks(g, secs, [])
+    m_lump = native.simulate(b_lump.proc, b_lump.dur, b_lump.edges,
+                             b_lump.num_procs)
+    b_ring = TaskGraphBuilder(cm, 32)
+    b_ring.collective_tasks(g, "all_reduce", secs, [])
+    m_ring = native.simulate(b_ring.proc, b_ring.dur, b_ring.edges,
+                             b_ring.num_procs)
+    assert m_ring > 0
+    # ring dataflow serializes each participant's rounds: the isolated-
+    # collective makespan must be at least the per-participant serial
+    # time (seconds) and bounded by the fully-serial worst case
+    assert m_ring >= secs * 0.99
+    assert m_ring <= secs * 6 + 1e-9
+    # and the expansion cannot be cheaper than the lump on its own ring
+    assert m_ring >= m_lump * 0.99
+
+
+def test_collective_expansion_falls_back_without_topology():
+    from flexflow_tpu.search.costmodel import OpCostModel
+    from flexflow_tpu.search.tasksim import TaskGraphBuilder
+    spec = MachineSpec(num_devices=8, generation="v5e")
+    cm = OpCostModel(spec)
+    b = TaskGraphBuilder(cm, 8)
+    ids = b.collective_tasks([0, 2, 4, 6], "all_reduce", 1e-3, [])
+    # no topology: identical to lump comm_tasks (injection ports)
+    assert len(ids) == 4 and len(b.proc) == 4

@@ -982,7 +982,13 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
         # event-driven simulator end-to-end, simulator.cc:822-1200).
         # The additive evaluator remains the pruner inside the DP; only
         # the few finalists are re-simulated.
-        if evaluator_cls is GraphCostEvaluator and len(finalists) > 1:
+        # FF_FINAL_RANKER=additive keeps the additive evaluator's
+        # ranking (fidelity A/Bs between the two rankers —
+        # examples/osdi22ae/ranker_fidelity.py)
+        import os as _os
+        if (evaluator_cls is GraphCostEvaluator and len(finalists) > 1
+                and _os.environ.get("FF_FINAL_RANKER",
+                                    "tasksim") != "additive"):
             try:
                 from .tasksim import TaskGraphEvaluator
                 tev = TaskGraphEvaluator(cost_model, dmesh)

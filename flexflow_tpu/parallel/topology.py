@@ -26,6 +26,10 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
+# path-length comparison tolerance; safe because Dijkstra weights are
+# normalized to max_bw/bw (dimensionless, >= 1 per hop)
+_EPS = 1e-9
+
 Link = Tuple[int, int, int]  # (device, dim, direction ±1) — outgoing port
 
 
@@ -131,11 +135,18 @@ class GraphTopology:
         self.num_devices = num_devices
         self.conn = dict(conn)
         self.max_bw = max(conn.values()) if conn else 1.0
-        self._route_cache: Dict[Tuple[int, int], List[Link]] = {}
-        # Dijkstra weight: transfer time per byte (1/bw)
+        self._routes_cache: Dict[Tuple[int, int, int], List[List[Link]]] = {}
+        # Dijkstra weight: dimensionless time factor max_bw/bw (>= 1 per
+        # hop, the same normalization as link_factor). Raw per-byte
+        # weights (1/bw ~ 1e-11 for real ICI bandwidths) would sit at
+        # the same scale as any absolute epsilon and break the
+        # shortest-path-DAG edge test on fast fabrics.
         self._adj: Dict[int, List[Tuple[int, float]]] = {}
+        self._radj: Dict[int, List[Tuple[int, float]]] = {}
         for (i, j), bw in conn.items():
-            self._adj.setdefault(i, []).append((j, 1.0 / max(bw, 1.0)))
+            w = self.max_bw / max(bw, 1e-30)
+            self._adj.setdefault(i, []).append((j, w))
+            self._radj.setdefault(j, []).append((i, w))
 
     # ---- constructors (reference network.cc topology generators) ----
     @classmethod
@@ -200,37 +211,74 @@ class GraphTopology:
         return cls(per * n_slices, conn)
 
     # ---- routing (WeightedShortestPathRoutingStrategy analog) ----
-    def route(self, src: int, dst: int) -> List[Link]:
+    def routes(self, src: int, dst: int, k: int = 4) -> List[List[Link]]:
+        """Up to ``k`` equal-cost weighted-shortest paths src -> dst.
+
+        All shortest paths live on the Dijkstra shortest-path DAG
+        (edges u->v with dist[v] == dist[u] + w); a depth-first walk in
+        sorted-neighbor order enumerates them deterministically. The
+        reference's WeightedShortestPathRoutingStrategy returns one
+        path chosen by a random tie-break (network.cc:89 —
+        ``unif(gen) < 0.5``), spreading flows across equal-cost paths
+        statistically; here :meth:`route` hash-selects per (src, dst)
+        flow, the deterministic form of the same ECMP spreading."""
         if src == dst:
-            return []
-        hit = self._route_cache.get((src, dst))
+            return [[]]
+        hit = self._routes_cache.get((src, dst, k))
         if hit is not None:
             return hit
         import heapq
-        dist = {src: 0.0}
-        prev: Dict[int, int] = {}
-        pq = [(0.0, src)]
-        while pq:
-            d, u = heapq.heappop(pq)
-            if u == dst:
-                break
-            if d > dist.get(u, float("inf")):
-                continue
-            for v, w in self._adj.get(u, ()):
-                nd = d + w
-                if nd < dist.get(v, float("inf")):
-                    dist[v] = nd
-                    prev[v] = u
-                    heapq.heappush(pq, (nd, v))
-        if dst not in prev:
+
+        def dijkstra(start: int, adj) -> Dict[int, float]:
+            dist = {start: 0.0}
+            pq = [(0.0, start)]
+            while pq:
+                d, u = heapq.heappop(pq)
+                if d > dist.get(u, float("inf")):
+                    continue
+                for v, w in adj.get(u, ()):
+                    nd = d + w
+                    if nd < dist.get(v, float("inf")) - _EPS:
+                        dist[v] = nd
+                        heapq.heappush(pq, (nd, v))
+            return dist
+
+        dist = dijkstra(src, self._adj)
+        if dst not in dist:
             raise ValueError(f"no route {src} -> {dst} in topology")
-        path = [dst]
-        while path[-1] != src:
-            path.append(prev[path[-1]])
-        path.reverse()
-        links = [(path[i], 0, path[i + 1]) for i in range(len(path) - 1)]
-        self._route_cache[(src, dst)] = links
-        return links
+        # reverse distances prune the DFS to edges that actually lie on
+        # a shortest src->dst path (dist[u] + w + rdist[v] == dist[dst]);
+        # without this the walk explores whole subtrees heading away
+        # from dst and explodes combinatorially on pod-size fabrics
+        rdist = dijkstra(dst, self._radj)
+        total = dist[dst]
+        paths: List[List[int]] = []
+        stack: List[Tuple[int, List[int]]] = [(src, [])]
+        while stack and len(paths) < k:
+            u, acc = stack.pop()
+            if u == dst:
+                paths.append(acc + [u])
+                continue
+            for v, w in sorted(self._adj.get(u, ()), reverse=True):
+                if abs(dist[u] + w + rdist.get(v, float("inf"))
+                       - total) < _EPS:
+                    stack.append((v, acc + [u]))
+        out = [[(p[i], 0, p[i + 1]) for i in range(len(p) - 1)]
+               for p in paths]
+        self._routes_cache[(src, dst, k)] = out
+        return out
+
+    def route(self, src: int, dst: int) -> List[Link]:
+        """One weighted-shortest path; equal-cost alternatives are
+        hash-selected per flow (deterministic ECMP — see
+        :meth:`routes`)."""
+        if src == dst:
+            return []
+        cands = self.routes(src, dst)   # cached per (src, dst, k)
+        # deterministic per-flow spreading: distinct (src, dst) pairs
+        # land on different equal-cost paths; repeated queries agree
+        idx = (src * 2654435761 + dst * 40503) % len(cands)
+        return cands[idx]
 
     def hop_distance(self, a: int, b: int) -> int:
         return len(self.route(a, b))

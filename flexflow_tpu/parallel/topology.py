@@ -136,6 +136,8 @@ class GraphTopology:
         self.conn = dict(conn)
         self.max_bw = max(conn.values()) if conn else 1.0
         self._routes_cache: Dict[Tuple[int, int, int], List[List[Link]]] = {}
+        self._dist_cache: Dict[int, Dict[int, float]] = {}
+        self._rdist_cache: Dict[int, Dict[int, float]] = {}
         # Dijkstra weight: dimensionless time factor max_bw/bw (>= 1 per
         # hop, the same normalization as link_factor). Raw per-byte
         # weights (1/bw ~ 1e-11 for real ICI bandwidths) would sit at
@@ -227,46 +229,73 @@ class GraphTopology:
         hit = self._routes_cache.get((src, dst, k))
         if hit is not None:
             return hit
-        import heapq
-
-        def dijkstra(start: int, adj) -> Dict[int, float]:
-            dist = {start: 0.0}
-            pq = [(0.0, start)]
-            while pq:
-                d, u = heapq.heappop(pq)
-                if d > dist.get(u, float("inf")):
-                    continue
-                for v, w in adj.get(u, ()):
-                    nd = d + w
-                    if nd < dist.get(v, float("inf")) - _EPS:
-                        dist[v] = nd
-                        heapq.heappush(pq, (nd, v))
-            return dist
-
-        dist = dijkstra(src, self._adj)
+        dist = self._dist_from(src)
         if dst not in dist:
             raise ValueError(f"no route {src} -> {dst} in topology")
-        # reverse distances prune the DFS to edges that actually lie on
-        # a shortest src->dst path (dist[u] + w + rdist[v] == dist[dst]);
-        # without this the walk explores whole subtrees heading away
-        # from dst and explodes combinatorially on pod-size fabrics
-        rdist = dijkstra(dst, self._radj)
+        rdist = self._dist_from(dst, rev=True)
         total = dist[dst]
+        # relative tolerance: weights are dimensionless (max_bw/bw >= 1)
+        # but long routes accumulate fp error proportional to length
+        tol = _EPS * max(1.0, total)
+        # one candidate per equal-cost FIRST HOP (sorted, deterministic):
+        # distinct egress links by construction, so per-flow selection
+        # genuinely spreads source traffic (a k-truncated DFS kept only
+        # paths differing near dst — every candidate shared hop 1)
+        inf = float("inf")
+        firsts = [v for v, w in sorted(self._adj.get(src, ()))
+                  if w + rdist.get(v, inf) <= total + tol]
+        if not firsts:
+            # fp-pathological fabric: fall back to the single best hop
+            firsts = [min(self._adj.get(src, ()),
+                          key=lambda vw: (vw[1] + rdist.get(vw[0], inf),
+                                          vw[0]))[0]]
         paths: List[List[int]] = []
-        stack: List[Tuple[int, List[int]]] = [(src, [])]
-        while stack and len(paths) < k:
-            u, acc = stack.pop()
-            if u == dst:
-                paths.append(acc + [u])
-                continue
-            for v, w in sorted(self._adj.get(u, ()), reverse=True):
-                if abs(dist[u] + w + rdist.get(v, float("inf"))
-                       - total) < _EPS:
-                    stack.append((v, acc + [u]))
+        for first in firsts[:max(1, k)]:
+            # greedy descent on rdist: from any node on a shortest path
+            # the neighbor minimizing (w + rdist) continues one, so the
+            # walk reaches dst in <= num_devices hops; a step cap guards
+            # degenerate fp cases (such a path is simply dropped)
+            path = [src, first]
+            u = first
+            for _ in range(self.num_devices):
+                if u == dst:
+                    break
+                u = min(self._adj.get(u, ()),
+                        key=lambda vw: (vw[1] + rdist.get(vw[0], inf),
+                                        vw[0]))[0]
+                path.append(u)
+            if path[-1] == dst:
+                paths.append(path)
+        if not paths:
+            raise ValueError(f"no route {src} -> {dst} in topology")
         out = [[(p[i], 0, p[i + 1]) for i in range(len(p) - 1)]
                for p in paths]
         self._routes_cache[(src, dst, k)] = out
         return out
+
+    def _dist_from(self, node: int, rev: bool = False) -> Dict[int, float]:
+        """Cached full Dijkstra distance map from ``node`` (forward or
+        reverse graph) — ring_links issues a route per device pair, so
+        per-node caching turns 2P sweeps into at most 2V."""
+        cache = self._rdist_cache if rev else self._dist_cache
+        hit = cache.get(node)
+        if hit is not None:
+            return hit
+        import heapq
+        adj = self._radj if rev else self._adj
+        dist = {node: 0.0}
+        pq = [(0.0, node)]
+        while pq:
+            d, u = heapq.heappop(pq)
+            if d > dist.get(u, float("inf")):
+                continue
+            for v, w in adj.get(u, ()):
+                nd = d + w
+                if nd < dist.get(v, float("inf")) - _EPS:
+                    dist[v] = nd
+                    heapq.heappush(pq, (nd, v))
+        cache[node] = dist
+        return dist
 
     def route(self, src: int, dst: int) -> List[Link]:
         """One weighted-shortest path; equal-cost alternatives are

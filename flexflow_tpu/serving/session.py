@@ -177,30 +177,61 @@ class ModelRepository:
                    input_shapes: Sequence[Sequence[int]],
                    checkpoint_dir: Optional[str] = None,
                    batch_buckets: Sequence[int] = (1, 4, 16, 64),
-                   config=None):
+                   config=None, strategy_file=None, instances: int = 1):
         """Serve a serialized graph (``PyTorchModel.torch_to_file`` /
         strategy-export output) without its source framework: rebuild
         through ``file_to_ff``, optionally restore trained weights from
-        a checkpoint, and register an eval session."""
+        a checkpoint, and register an eval session.
+
+        ``strategy_file`` imports a searched strategy instead of plain
+        data parallelism; pass a LIST (one entry per instance, None =
+        DP) to give each instance its own parallelization — the
+        reference Triton backend's per-instance strategy files
+        (``triton/src/instance.cc``). A single value with
+        ``instances=N`` compiles once and clones (instances sharing one
+        program); a list compiles each instance separately."""
+        import copy
+
         from ..config import FFConfig
         from ..model import FFModel
         from ..runtime.optimizers import SGDOptimizer
         from ..frontends.torch_fx import PyTorchModel
 
-        cfg = config or FFConfig()
-        cfg.only_data_parallel = True
-        ff = FFModel(cfg)
-        ins = [ff.create_tensor(tuple(s), name=f"in{i}")
-               for i, s in enumerate(input_shapes)]
-        outs = PyTorchModel.file_to_ff(path, ff, ins)
-        ff.compile(SGDOptimizer(0.0), "identity", [],
-                   output_tensor=outs[0])
-        if checkpoint_dir:
-            from ..runtime.checkpoint import restore_model_checkpoint
-            restore_model_checkpoint(ff, checkpoint_dir)
-        sess = InferenceSession(ff, batch_buckets)
-        self.register(name, sess)
-        return sess
+        per_instance = isinstance(strategy_file, (list, tuple))
+        files = (list(strategy_file) if per_instance
+                 else [strategy_file])
+        if per_instance and instances != 1 and instances != len(files):
+            raise ValueError(
+                f"instances={instances} conflicts with "
+                f"{len(files)} per-instance strategy files — the list "
+                f"length alone sets the instance count")
+
+        def build(sf):
+            cfg = copy.deepcopy(config) if config is not None \
+                else FFConfig()
+            if sf:
+                cfg.import_strategy_file = sf
+                cfg.only_data_parallel = False
+            else:
+                cfg.only_data_parallel = True
+            ff = FFModel(cfg)
+            ins = [ff.create_tensor(tuple(s), name=f"in{i}")
+                   for i, s in enumerate(input_shapes)]
+            outs = PyTorchModel.file_to_ff(path, ff, ins)
+            ff.compile(SGDOptimizer(0.0), "identity", [],
+                       output_tensor=outs[0])
+            if checkpoint_dir:
+                from ..runtime.checkpoint import restore_model_checkpoint
+                restore_model_checkpoint(ff, checkpoint_dir)
+            return InferenceSession(ff, batch_buckets)
+
+        sessions = [build(sf) for sf in files]
+        if per_instance:
+            self.register(name, sessions)
+        else:
+            # register's own clone path handles instances=N
+            self.register(name, sessions[0], instances=instances)
+        return sessions[0]
 
     def get(self, name: str) -> InferenceSession:
         """First (primary) instance — the single-session API."""

@@ -195,3 +195,47 @@ def test_http_generate_roundtrip():
         srv.shutdown()
         for s_ in scheds.values():
             s_.close()
+
+
+def test_repository_per_instance_strategy_files(tmp_path):
+    """Reference Triton parity (triton/src/instance.cc): each model
+    instance may carry its own strategy file. Instance 0 imports a
+    searched strategy; instance 1 stays data-parallel; both serve the
+    same graph and agree numerically."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+    from flexflow_tpu.search.serialization import save_strategy
+
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3)).eval()
+    pm = PyTorchModel(m)
+    gpath = str(tmp_path / "g.json")
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    x_t = ff.create_tensor((4, 8), name="x")
+    pm.torch_to_file(ff, [x_t], gpath)
+
+    # produce a strategy file for this graph: search on a fresh build
+    cfg2 = FFConfig()
+    cfg2.only_data_parallel = False
+    cfg2.search_budget = 2
+    cfg2.search_floor_guard = "false"
+    spath = str(tmp_path / "strategy.json")
+    cfg2.export_strategy_file = spath
+    ff2 = FFModel(cfg2)
+    ins2 = [ff2.create_tensor((4, 8), name="in0")]
+    outs2 = PyTorchModel.file_to_ff(gpath, ff2, ins2)
+    from flexflow_tpu import SGDOptimizer
+    ff2.compile(SGDOptimizer(0.0), "identity", [], output_tensor=outs2[0])
+
+    repo = ModelRepository()
+    repo.load_graph("net", gpath, input_shapes=[(4, 8)],
+                    strategy_file=[spath, None])
+    insts = repo.get_instances("net")
+    assert len(insts) == 2
+    assert insts[0].ff is not insts[1].ff   # separately compiled
+    x = np.random.default_rng(3).normal(size=(2, 8)).astype(np.float32)
+    o0 = insts[0].infer({"x": x})
+    o1 = insts[1].infer({"x": x})
+    np.testing.assert_allclose(o0, o1, rtol=1e-4, atol=1e-4)

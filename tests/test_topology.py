@@ -407,3 +407,37 @@ def test_ecmp_scales_to_pod_size_and_fast_links():
     tf = GraphTopology.from_torus((4, 4), bw=2e12)
     assert len(tf.route(0, 5)) == 2
     assert len(tf.routes(0, 5)) >= 2
+
+
+def test_ecmp_first_hop_diversity_and_heterogeneous_bw():
+    """Regressions from review: (a) k-truncated DFS kept only paths
+    sharing the first hop (verified 8x8 torus 0->27: all 4 candidates
+    left on the same egress link) — enumeration is now one candidate
+    per equal-cost first hop; (b) random per-link bandwidths spanning
+    decades made the fp DAG-edge test reject every edge and route()
+    divided by zero."""
+    import itertools
+    from flexflow_tpu.parallel.topology import GraphTopology
+    t = GraphTopology.from_torus((8, 8), bw=1.0)
+    paths = t.routes(0, 27)
+    assert len(paths) >= 2
+    assert len({p[0] for p in paths}) >= 2, "first hops must differ"
+    # heterogeneous fabric fuzz (reviewer repro): 12-node chain + a few
+    # shortcuts, bandwidths spanning ten decades
+    import random
+    rng = random.Random(1)
+    for trial in range(5):
+        conn = {}
+        for i in range(11):
+            bw = 10 ** rng.uniform(-10, 0)
+            conn[(i, i + 1)] = bw
+            conn[(i + 1, i)] = bw
+        for _ in range(4):
+            a, b = rng.sample(range(12), 2)
+            bw = 10 ** rng.uniform(-10, 0)
+            conn[(a, b)] = bw
+            conn[(b, a)] = bw
+        t = GraphTopology(12, conn)
+        for a, b in itertools.combinations(range(0, 12, 3), 2):
+            r = t.route(a, b)
+            assert r and r[0][0] == a and r[-1][2] == b

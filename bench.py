@@ -117,9 +117,10 @@ def _train_flops_per_step(ff) -> float:
 
 def timed_mfu(ff, batch_dict, steps: int):
     """Shared train-step measurement (bench stage_bert + the profiling
-    sweep in examples/tpu_profile_bert.py): warmup, timed loop with a
-    D2H sync, PER-CHIP samples/s and MFU. Returns
-    (sps_per_chip, mfu, flops_per_step, n_chips, seconds)."""
+    sweep in examples/tpu_profile_bert.py): warmup, timed loop in three
+    synced chunks so the headline number carries a spread, PER-CHIP
+    samples/s and MFU. Returns
+    (sps_per_chip, mfu, flops_per_step, n_chips, seconds, sps_std)."""
     import jax
     from flexflow_tpu.parallel.machine import MachineSpec
     batch = next(iter(batch_dict.values())).shape[0]
@@ -127,17 +128,29 @@ def timed_mfu(ff, batch_dict, steps: int):
     for _ in range(3):
         bm = ff._run_train_step(step, batch_dict)
     _sync_fetch(bm["loss"])  # compile + sync
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        bm = ff._run_train_step(step, batch_dict)
-    _sync_fetch(bm["loss"])
-    dt = time.perf_counter() - t0
     n_chips = max(1, len(jax.devices()))
+    steps = max(1, steps)
+    chunk = -(-steps // 3)     # ceil: 20 -> 7/7/6, no short tail chunk
+    chunk_sps = []
+    done = 0
+    t_all = time.perf_counter()
+    while done < steps:
+        n = min(chunk, steps - done)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            bm = ff._run_train_step(step, batch_dict)
+        _sync_fetch(bm["loss"])
+        chunk_sps.append(batch * n / (time.perf_counter() - t0) / n_chips)
+        done += n
+    dt = time.perf_counter() - t_all
     sps = batch * steps / dt / n_chips
+    m = sum(chunk_sps) / len(chunk_sps)
+    sps_std = (sum((c - m) ** 2 for c in chunk_sps)
+               / (len(chunk_sps) - 1)) ** 0.5 if len(chunk_sps) > 1 else 0.0
     spec = MachineSpec.detect()
     flops_step = _train_flops_per_step(ff)
     mfu = flops_step * (steps / dt) / (spec.peak_flops * n_chips)
-    return sps, mfu, flops_step, n_chips, dt
+    return sps, mfu, flops_step, n_chips, dt, sps_std
 
 
 def stage_bert(flash: str, searched: bool, budget: int, steps: int,
@@ -172,7 +185,7 @@ def stage_bert(flash: str, searched: bool, budget: int, steps: int,
          "position_ids": np.tile(np.arange(seq, dtype=np.int32),
                                  (batch, 1)),
          "label": rng.integers(0, 2, size=(batch, 1)).astype(np.int32)}
-    sps, mfu, flops_step, n_chips, _dt = timed_mfu(ff, b, steps)
+    sps, mfu, flops_step, n_chips, _dt, sps_std = timed_mfu(ff, b, steps)
     spec = MachineSpec.detect()
     # resolved kernel choice: "auto" on CPU means the XLA path — the
     # emitted record must say which kernel actually ran, not the knob.
@@ -186,14 +199,16 @@ def stage_bert(flash: str, searched: bool, budget: int, steps: int,
 
     on_tpu = jax.default_backend() == "tpu"
     enabled = MultiHeadAttentionOp._flash_enabled(_Ctx, seq_len=seq)
-    dropout_blocks = bcfg.dropout > 0.0 \
-        and (not on_tpu or flash != "true")
+    # in-kernel counter-based dropout runs compiled AND in interpret
+    # mode since r4 — only the auto-mode policy keeps dropout on XLA
+    dropout_blocks = bcfg.dropout > 0.0 and flash != "true"
     if enabled and not dropout_blocks:
         # off-TPU the kernel runs in (slow) interpret mode — say so
         resolved = "pallas-flash" if on_tpu else "pallas-interpret"
     else:
         resolved = "xla"
-    _emit({"sps": round(sps, 3), "mfu": round(mfu, 4),
+    _emit({"sps": round(sps, 3), "sps_std": round(sps_std, 3),
+           "mfu": round(mfu, 4),
            "flops_per_step": flops_step, "n_chips": n_chips,
            "search_time_s": round(search_time, 2),
            "flash_resolved": resolved,
@@ -315,6 +330,8 @@ def main():
             errors.append(f"bert(flash=false): {err}")
             return bail()
     out["dp_sps"] = dp["sps"]
+    if "sps_std" in dp:
+        out["dp_sps_std"] = dp["sps_std"]
     out["mfu"] = dp["mfu"]
     if out["platform"] == "cpu":
         # CPU-fallback MFU divides by the synthetic cpu-sim peak_flops
@@ -352,6 +369,8 @@ def main():
                 out["platform"] = reprobe["platform"]
                 out["n_devices"] = reprobe["n"]
                 out["dp_sps"] = dp2["sps"]
+                if "sps_std" in dp2:
+                    out["dp_sps_std"] = dp2["sps_std"]
                 out["mfu"] = dp2["mfu"]
                 out.pop("mfu_note", None)  # now a real TPU MFU
                 out["flash"] = flash_used
@@ -373,6 +392,8 @@ def main():
                          "--budget", "8"], 600, env)
         if srch is not None:
             out["searched_sps"] = srch["sps"]
+            if "sps_std" in srch:
+                out["searched_sps_std"] = srch["sps_std"]
             out["search_time_s"] = srch["search_time_s"]
         else:
             errors.append(f"bert(searched): {err}")

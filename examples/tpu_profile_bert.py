@@ -85,11 +85,12 @@ def run_config(name, flash, bf16_act, batch, seq, steps, trace_dir=None):
             _sync(bm["loss"])
     # shared bench harness: per-chip sps + MFU, same conventions as
     # BENCH_r* records
-    sps, mfu, flops, n_chips, dt = timed_mfu(ff, b, steps)
+    sps, mfu, flops, n_chips, dt, sps_std = timed_mfu(ff, b, steps)
     spec = MachineSpec.detect()
     rec = {"config": name, "flash": flash, "bf16_act": bf16_act,
            "batch": batch, "seq": seq, "steps": steps, "n_chips": n_chips,
            "sps_per_chip": round(sps, 2),
+           "sps_std": round(sps_std, 2),
            "ms_per_step": round(dt / steps * 1e3, 3),
            "mfu": round(mfu, 4), "generation": spec.generation}
     print(json.dumps(rec), flush=True)

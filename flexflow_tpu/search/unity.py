@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import itertools
+import os
 import time
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
@@ -985,10 +986,9 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
         # FF_FINAL_RANKER=additive keeps the additive evaluator's
         # ranking (fidelity A/Bs between the two rankers —
         # examples/osdi22ae/ranker_fidelity.py)
-        import os as _os
         if (evaluator_cls is GraphCostEvaluator and len(finalists) > 1
-                and _os.environ.get("FF_FINAL_RANKER",
-                                    "tasksim") != "additive"):
+                and os.environ.get("FF_FINAL_RANKER",
+                                   "tasksim") != "additive"):
             try:
                 from .tasksim import TaskGraphEvaluator
                 tev = TaskGraphEvaluator(cost_model, dmesh)

@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-5 opportunistic on-chip capture daemon.
+# Probes the axon tunnel every ~7 min; the moment it answers, runs
+# bench_results/r05_pipeline.sh (kept in a separate file so the
+# pipeline can be extended while this loop is already running — bash
+# reads a script as it executes it, so editing THIS file mid-run is
+# unsafe, but editing the pipeline file is fine).
+# After a successful full pass it keeps probing and re-runs the
+# pipeline at most once more if >2h have passed (fresher artifacts win).
+cd /root/repo || exit 1
+export PYTHONPATH=/root/repo:/root/.axon_site
+LOG=/root/repo/bench_results/r05_capture_daemon.log
+echo "[$(date +%H:%M:%S)] daemon start" >> "$LOG"
+PASSES=0
+LAST_PASS=0
+for i in $(seq 1 400); do
+  JAX_PLATFORMS=axon timeout 180 python -c "
+import jax, numpy as np
+x = jax.numpy.ones((256,256))
+print('probe-ok', float(np.asarray((x@x).sum())))
+" >> "$LOG" 2>&1
+  if [ $? -ne 0 ]; then
+    echo "[$(date +%H:%M:%S)] probe $i down" >> "$LOG"
+    sleep 380
+    continue
+  fi
+  NOW=$(date +%s)
+  if [ $PASSES -ge 2 ]; then
+    echo "[$(date +%H:%M:%S)] probe $i ok (2 passes done, idle)" >> "$LOG"
+    sleep 1800
+    continue
+  fi
+  if [ $PASSES -ge 1 ] && [ $((NOW - LAST_PASS)) -lt 7200 ]; then
+    echo "[$(date +%H:%M:%S)] probe $i ok (pass done, waiting)" >> "$LOG"
+    sleep 900
+    continue
+  fi
+  echo "[$(date +%H:%M:%S)] TPU ALIVE — running pipeline (pass $PASSES)" >> "$LOG"
+  date +%s > /root/repo/bench_results/tpu_alive.flag
+  bash /root/repo/bench_results/r05_pipeline.sh >> "$LOG" 2>&1
+  PASSES=$((PASSES+1))
+  LAST_PASS=$(date +%s)
+  echo "[$(date +%H:%M:%S)] pipeline pass $PASSES complete" >> "$LOG"
+done

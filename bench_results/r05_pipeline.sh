@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 on-chip capture pipeline (invoked by r05_capture_daemon.sh the
+# moment the tunnel answers). Stages in VERDICT-r4 priority order; a
+# failing stage does not stop later ones. Every stage has a hard
+# timeout — recovery windows have historically been short (~40 min), so
+# the cheap, highest-value stages go first.
+cd /root/repo || exit 1
+export PYTHONPATH=/root/repo:/root/.axon_site
+export JAX_PLATFORMS=axon
+R=/root/repo/bench_results
+
+echo "[pipeline $(date +%H:%M:%S)] stage 1: kernel validation"
+timeout 1800 python examples/tpu_validate_kernels.py \
+  > "$R/r05_kernel_validation.log" 2>&1
+echo "[pipeline $(date +%H:%M:%S)] validation rc=$?"
+
+echo "[pipeline $(date +%H:%M:%S)] stage 2: calibrate + fidelity"
+timeout 2400 python examples/tpu_fidelity.py \
+  > "$R/r05_fidelity.log" 2>&1
+echo "[pipeline $(date +%H:%M:%S)] fidelity rc=$?"
+
+echo "[pipeline $(date +%H:%M:%S)] stage 3: MFU sweep"
+timeout 3600 python examples/tpu_profile_bert.py --steps 20 \
+  --out "$R/r05_profile.json" \
+  > "$R/r05_profile.log" 2>&1
+echo "[pipeline $(date +%H:%M:%S)] profile rc=$?"
+
+echo "[pipeline $(date +%H:%M:%S)] stage 4: bench.py"
+BENCH_DEADLINE_S=2400 timeout 2600 python bench.py \
+  > "$R/r05_onchip_bench.log" 2>&1
+echo "[pipeline $(date +%H:%M:%S)] bench rc=$?"
+tail -1 "$R/r05_onchip_bench.log" > "$R/r05_onchip.json" 2>/dev/null
+
+echo "[pipeline $(date +%H:%M:%S)] stage 5: memory validation (estimate only; the CPU-only constrained stage runs outside tunnel windows)"
+timeout 1200 python examples/tpu_memory_validation.py --skip-constrained \
+  > "$R/r05_memory_validation.log" 2>&1
+echo "[pipeline $(date +%H:%M:%S)] memory rc=$?"

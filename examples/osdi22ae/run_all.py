@@ -41,28 +41,11 @@ _PRED = re.compile(r"predicted searched-vs-dp: (?P<ratio>[\d.]+)x")
 _GUARD = re.compile(r"floor-guard adopted: (?P<which>\w+)")
 
 
-def _spearman(xs, ys):
-    """Spearman rank correlation without scipy."""
-    def ranks(v):
-        order = sorted(range(len(v)), key=lambda i: v[i])
-        r = [0.0] * len(v)
-        k = 0
-        while k < len(order):
-            j = k
-            while j + 1 < len(order) and v[order[j + 1]] == v[order[k]]:
-                j += 1
-            avg = (k + j) / 2.0          # averaged rank for ties
-            for t in order[k:j + 1]:
-                r[t] = avg
-            k = j + 1
-        return r
-    rx, ry = ranks(xs), ranks(ys)
-    n = len(xs)
-    mx, my = sum(rx) / n, sum(ry) / n
-    num = sum((a - mx) * (b - my) for a, b in zip(rx, ry))
-    dx = sum((a - mx) ** 2 for a in rx) ** 0.5
-    dy = sum((b - my) ** 2 for b in ry) ** 0.5
-    return num / (dx * dy) if dx > 0 and dy > 0 else 0.0
+if EXAMPLES not in sys.path:
+    sys.path.insert(0, EXAMPLES)
+# _stats is stdlib-only: the sweep parent must stay importable when the
+# framework/jax is broken (failures belong in per-model subprocess rows)
+from _stats import spearman as _spearman  # noqa: E402
 
 
 def main():

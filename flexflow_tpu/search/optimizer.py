@@ -404,7 +404,9 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
                 ff.graph_inputs + getattr(ff, "const_inputs", []),
                 [ff._output_tensor], dmesh)).total
         ff._search_predicted = {"searched_cost_s": gc.total,
-                                "dp_cost_s": dp_pred}
+                                "dp_cost_s": dp_pred,
+                                "peak_mem_per_dev_bytes": gc.peak_memory
+                                / max(dmesh.num_devices, 1)}
     except Exception:  # noqa: BLE001 — reporting only
         pass
     if cfg.profiling:

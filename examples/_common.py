@@ -30,8 +30,6 @@ if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
 
 from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
 
-from _stats import spearman  # noqa: F401 — re-export for convenience
-
 
 def run_example(name: str, build: Callable[[FFModel, FFConfig], object],
                 make_batch: Callable[[FFConfig, np.random.Generator], Dict],

@@ -131,6 +131,10 @@ def main():
         "speedup": round(speedup, 3),
         "via": "FFModel.compile",
         "compile_time_s": round(compile_s, 1),
+        # search vs materialization split (ff._compile_phases): on the
+        # virtual CPU mesh the replicated-param host copies dominate
+        # compile_time_s; on real hardware they are parallel DMA
+        "compile_phases": getattr(ff, "_compile_phases", None),
     }
     os.makedirs(os.path.dirname(a.out), exist_ok=True)
     with open(a.out, "w") as f:

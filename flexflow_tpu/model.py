@@ -646,7 +646,10 @@ class FFModel:
         if strategy is not None:
             self.strategy = strategy
         else:
+            _t0 = time.perf_counter()
             self.strategy, program_info = self._optimize_strategy()
+            self._compile_phases = {
+                "search_s": round(time.perf_counter() - _t0, 3)}
             if self.strategy.dmesh is not self.dmesh:
                 # the search chose a strategy on its own mesh layout
                 # (e.g. a (dp, S) pipeline mesh) — adopt it
@@ -677,7 +680,14 @@ class FFModel:
                                      self.strategy, self.optimizer,
                                      self.loss_type, self.metrics,
                                      seed=self.config.seed)
+        _t0 = time.perf_counter()
         self.params, self.state = self.executor.init_params_and_state()
+        if hasattr(self, "_compile_phases"):
+            # init/materialization separated from search: on a virtual
+            # many-device CPU mesh the replicated-shard host copies
+            # dominate, which would misattribute wall time to the search
+            self._compile_phases["init_s"] = round(
+                time.perf_counter() - _t0, 3)
         self.opt_state = self.optimizer.init_state(self.params)
         if self.config.shard_optimizer_states and self.opt_state:
             # ZeRO-1: moments sharded over the axes their weight is

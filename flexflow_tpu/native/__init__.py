@@ -81,6 +81,27 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.ffgraph_closure.restype = ctypes.c_int32
         lib.ffgraph_closure.argtypes = [ctypes.c_int32, ctypes.c_int64,
                                         i32p, i32p, u64p]
+        lib.ffb_new.restype = ctypes.c_void_p
+        lib.ffb_free.argtypes = [ctypes.c_void_p]
+        lib.ffb_n_tasks.restype = ctypes.c_int64
+        lib.ffb_n_tasks.argtypes = [ctypes.c_void_p]
+        lib.ffb_n_edges.restype = ctypes.c_int64
+        lib.ffb_n_edges.argtypes = [ctypes.c_void_p]
+        lib.ffb_add_tasks.restype = ctypes.c_int32
+        lib.ffb_add_tasks.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                      i32p, f64p]
+        lib.ffb_cross_deps.restype = None
+        lib.ffb_cross_deps.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                       i32p, ctypes.c_int32, i32p]
+        lib.ffb_collective.restype = ctypes.c_int32
+        lib.ffb_collective.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i32p, i32p, f64p,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_int32, i32p, i32p]
+        lib.ffb_simulate.restype = ctypes.c_double
+        lib.ffb_simulate.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.ffb_get.restype = None
+        lib.ffb_get.argtypes = [ctypes.c_void_p, i32p, f64p, i32p, i32p]
         _lib = lib
         return _lib
 
@@ -197,6 +218,158 @@ def critical_path(duration, edges) -> float:
     if len(order) != n:
         raise ValueError("cycle")
     return best
+
+
+# ---------------------------------------------------------------------------
+# task-graph builder (search hot loop)
+# ---------------------------------------------------------------------------
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_F64P = ctypes.POINTER(ctypes.c_double)
+
+
+class TaskBuffer:
+    """Task-graph accumulation buffer for the strategy search.
+
+    Native-backed when libffruntime.so is available (the ring-collective
+    expansion of one search is ~20M dependency edges — the round-4
+    profile's hottest Python loop); the pure-Python branch implements
+    IDENTICAL semantics (tests assert parity). One logical collective is
+    one call either way."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        if self._lib is not None:
+            self._h = self._lib.ffb_new()
+        else:
+            self.proc: list = []
+            self.dur: list = []
+            self.edges: list = []
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.ffb_free(self._h)
+            self._h = None
+
+    @property
+    def n_tasks(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.ffb_n_tasks(self._h))
+        return len(self.proc)
+
+    def add_tasks(self, procs, durs) -> int:
+        """Append len(procs) tasks; returns the first id (consecutive)."""
+        if self._lib is not None:
+            p = _as(procs, np.int32)
+            d = _as(durs, np.float64)
+            return int(self._lib.ffb_add_tasks(
+                self._h, len(p), p.ctypes.data_as(_I32P),
+                d.ctypes.data_as(_F64P)))
+        first = len(self.proc)
+        self.proc.extend(int(x) for x in procs)
+        self.dur.extend(float(x) for x in durs)
+        return first
+
+    def cross_deps(self, a, b) -> None:
+        """All-pairs dependencies: every a[i] -> every b[j]."""
+        if not len(a) or not len(b):
+            return
+        if self._lib is not None:
+            aa = _as(a, np.int32)
+            bb = _as(b, np.int32)
+            self._lib.ffb_cross_deps(
+                self._h, len(aa), aa.ctypes.data_as(_I32P),
+                len(bb), bb.ctypes.data_as(_I32P))
+            return
+        for x in a:
+            for y in b:
+                self.edges.append((int(x), int(y)))
+
+    def collective(self, route_off, route_procs, route_fac, rounds: int,
+                   per_round_secs: float, n_seg: int, deps) -> list:
+        """Ring-collective expansion (see ffb_collective in
+        native/src/ffruntime.cc for the dependency structure). Returns
+        the final task id of each participant that produced tasks."""
+        n_routes = len(route_off) - 1
+        if n_routes <= 0 or rounds <= 0:
+            return []
+        if self._lib is not None:
+            off = _as(route_off, np.int32)
+            procs = _as(route_procs, np.int32)
+            fac = None if route_fac is None else _as(route_fac, np.float64)
+            dep = _as(deps, np.int32)
+            out = np.zeros(n_routes, np.int32)
+            n = self._lib.ffb_collective(
+                self._h, n_routes, off.ctypes.data_as(_I32P),
+                procs.ctypes.data_as(_I32P),
+                fac.ctypes.data_as(_F64P) if fac is not None else None,
+                int(rounds), float(per_round_secs), max(1, int(n_seg)),
+                len(dep), dep.ctypes.data_as(_I32P),
+                out.ctypes.data_as(_I32P))
+            return [int(x) for x in out[:n]]
+        # python mirror of ffb_collective
+        n_seg = max(1, int(n_seg))
+        prev_last = [-1] * n_routes
+        for r in range(rounds):
+            cur = [-1] * n_routes
+            for i in range(n_routes):
+                h0, h1 = route_off[i], route_off[i + 1]
+                if h0 >= h1:
+                    cur[i] = prev_last[i]
+                    continue
+                last = -1
+                for _s in range(n_seg):
+                    prev = -1
+                    for h in range(h0, h1):
+                        d = (per_round_secs / n_seg) * (
+                            route_fac[h] if route_fac is not None else 1.0)
+                        t = len(self.proc)
+                        self.proc.append(int(route_procs[h]))
+                        self.dur.append(d)
+                        if prev < 0:
+                            if r == 0:
+                                for k in deps:
+                                    self.edges.append((int(k), t))
+                            else:
+                                pp = prev_last[(i - 1) % n_routes]
+                                if pp >= 0:
+                                    self.edges.append((pp, t))
+                                if prev_last[i] >= 0:
+                                    self.edges.append((prev_last[i], t))
+                        else:
+                            self.edges.append((prev, t))
+                        prev = t
+                    if prev >= 0:
+                        last = prev
+                cur[i] = last if last >= 0 else prev_last[i]
+            prev_last = cur
+        return [t for t in prev_last if t >= 0]
+
+    def arrays(self):
+        """(proc, dur, edges Nx2) copies — tests/introspection only."""
+        if self._lib is None:
+            return (list(self.proc), list(self.dur),
+                    [tuple(e) for e in self.edges])
+        n = int(self._lib.ffb_n_tasks(self._h))
+        m = int(self._lib.ffb_n_edges(self._h))
+        proc = np.zeros(n, np.int32)
+        dur = np.zeros(n, np.float64)
+        esrc = np.zeros(m, np.int32)
+        edst = np.zeros(m, np.int32)
+        self._lib.ffb_get(self._h, proc.ctypes.data_as(_I32P),
+                          dur.ctypes.data_as(_F64P),
+                          esrc.ctypes.data_as(_I32P),
+                          edst.ctypes.data_as(_I32P))
+        return proc, dur, np.stack([esrc, edst], axis=1)
+
+    def simulate(self, n_procs: int) -> float:
+        """Play the accumulated DAG through the event simulator."""
+        if self._lib is not None:
+            ms = self._lib.ffb_simulate(self._h, int(n_procs))
+            if ms < 0:
+                raise ValueError("task graph contains a cycle or bad ids")
+            return float(ms)
+        return simulate_py(self.proc, self.dur, self.edges, n_procs)
 
 
 # ---------------------------------------------------------------------------

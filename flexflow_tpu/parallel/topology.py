@@ -310,7 +310,15 @@ class GraphTopology:
         return cands[idx]
 
     def hop_distance(self, a: int, b: int) -> int:
-        return len(self.route(a, b))
+        """Minimum hop count over the ENUMERATED equal-cost candidates
+        (:meth:`routes`, one greedy path per equal-cost first hop,
+        k <= 4): deterministic and independent of the per-flow hash
+        (ADVICE r4). Not guaranteed to be the global minimum-hop
+        equal-weight path — ties inside the greedy descent break by
+        node id, which is fine for the latency estimates this feeds."""
+        if a == b:
+            return 0
+        return min(len(p) for p in self.routes(a, b))
 
     def ring_links(self, devices: Sequence[int]) -> List[List[Link]]:
         n = len(devices)

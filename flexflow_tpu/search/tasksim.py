@@ -55,9 +55,11 @@ class TaskGraphBuilder:
     def __init__(self, cost: OpCostModel, n_dev: int):
         self.cost = cost
         self.n_dev = n_dev
-        self.proc: List[int] = []
-        self.dur: List[float] = []
-        self.edges: List[Tuple[int, int]] = []
+        # proc/duration/edge arrays live in the native TaskBuffer (C++
+        # when libffruntime.so is available): ring expansion of one
+        # search is ~20M dependency edges — the round-4 profile's
+        # hottest Python loop at ~60 s, now one call per collective
+        self.buf = native.TaskBuffer()
         topo = cost.spec.topology
         self.topo = topo if topo is not None \
             and topo.num_devices == n_dev else None
@@ -70,13 +72,25 @@ class TaskGraphBuilder:
         return self.n_dev + (len(self.link_idx) if self.link_idx
                              else self.n_dev)
 
+    # array views (full copies out of the native buffer on EVERY access
+    # — introspection only; to simulate, call buf.simulate directly)
+    @property
+    def proc(self):
+        return self.buf.arrays()[0]
+
+    @property
+    def dur(self):
+        return self.buf.arrays()[1]
+
+    @property
+    def edges(self):
+        return self.buf.arrays()[2]
+
     def add_task(self, proc: int, dur: float) -> int:
-        self.proc.append(proc)
-        self.dur.append(dur)
-        return len(self.proc) - 1
+        return self.buf.add_tasks([proc], [dur])
 
     def dep(self, a: int, b: int):
-        self.edges.append((a, b))
+        self.buf.cross_deps([a], [b])
 
     def shard_devices(self, degree: int) -> List[int]:
         """Block-distribute `degree` shards over the devices."""
@@ -92,23 +106,33 @@ class TaskGraphBuilder:
                "reduce_scatter": (lambda d: d - 1),
                "all_to_all": (lambda d: d - 1)}
 
-    def _chain_route(self, hops, secs: float, deps: List[int],
-                     n_seg: int, factor) -> List[int]:
-        """Segment-pipelined store-and-forward over one route; returns
-        the final-hop task of each segment (empty if the route is)."""
-        out = []
-        for _s in range(n_seg):
-            prev = None
-            for link in hops:
-                t = self.add_task(self.n_dev + self.link_idx[link],
-                                  (secs / n_seg) * (factor(link)
-                                                    if factor else 1.0))
-                for d in (deps if prev is None else [prev]):
-                    self.dep(d, t)
-                prev = t
-            if prev is not None:
-                out.append(prev)
-        return out
+    def _flat_routes(self, devices: Tuple[int, ...]):
+        """Flattened ring routes for one participant tuple, cached on
+        the topology object (device tuples repeat thousands of times
+        per search): (offsets, hop link-processor ids, per-hop duration
+        factors or None, any_hops)."""
+        cache = self.topo.__dict__.setdefault("_flat_routes", {})
+        hit = cache.get(devices)
+        if hit is None:
+            import numpy as np
+            routes = self.topo.ring_links(list(devices))
+            factor = getattr(self.topo, "link_factor", None)
+            off = [0]
+            procs: List[int] = []
+            fac: Optional[List[float]] = [] if factor else None
+            for hops in routes:
+                for link in hops:
+                    procs.append(self.n_dev + self.link_idx[link])
+                    if fac is not None:
+                        fac.append(float(factor(link)))
+                off.append(len(procs))
+            hit = (np.asarray(off, np.int32),
+                   np.asarray(procs, np.int32),
+                   np.asarray(fac, np.float64) if fac is not None
+                   else None,
+                   len(procs) > 0)
+            cache[devices] = hit
+        return hit
 
     def collective_tasks(self, devices: List[int], coll: str,
                          seconds: float, after: List[int],
@@ -129,31 +153,19 @@ class TaskGraphBuilder:
             if deg > 1 else 1
         if (self.topo is None or rounds <= 1 or rounds > 128):
             return self.comm_tasks(devices, seconds, after, nbytes)
-        routes = self.topo.ring_links(devices)
-        if not routes or all(not h for h in routes):
+        off, procs, fac, any_hops = self._flat_routes(tuple(devices))
+        if not any_hops:
             return self.comm_tasks(devices, seconds, after, nbytes)
-        factor = getattr(self.topo, "link_factor", None)
         n_seg = 1
-        round_bytes = nbytes // rounds if nbytes else 0
+        # segment sizing uses the ring CHUNK (nbytes / deg) — what each
+        # round actually moves per participant — not nbytes / rounds,
+        # which under-counts all_reduce chunks ~2x (ADVICE r4)
+        round_bytes = nbytes // max(deg, 1) if nbytes else 0
         if round_bytes > 0 and self.max_segments > 1:
             n_seg = min(self.max_segments,
                         max(1, -(-round_bytes // self.segment_size)))
-        per_round = seconds / rounds
-        n = len(routes)
-        prev_last: List[Optional[int]] = [None] * n
-        for r in range(rounds):
-            cur: List[Optional[int]] = [None] * n
-            for i, hops in enumerate(routes):
-                if r == 0:
-                    deps = list(after)
-                else:
-                    deps = [t for t in (prev_last[(i - 1) % n],
-                                        prev_last[i]) if t is not None]
-                segs = self._chain_route(hops, per_round, deps, n_seg,
-                                         factor)
-                cur[i] = segs[-1] if segs else prev_last[i]
-            prev_last = cur
-        out = [t for t in prev_last if t is not None]
+        out = self.buf.collective(off, procs, fac, rounds,
+                                  seconds / rounds, n_seg, list(after))
         return out or self.comm_tasks(devices, seconds, after, nbytes)
 
     def comm_tasks(self, devices: List[int], seconds: float,
@@ -173,8 +185,9 @@ class TaskGraphBuilder:
         (machine_model.cc, --simulator-segment-size): a multi-hop
         transfer then costs ~(n_seg + hops - 1)/n_seg of its
         store-and-forward time, and congestion on shared links is
-        resolved at segment granularity instead of whole messages."""
-        out = []
+        resolved at segment granularity instead of whole messages.
+        Returns each participant's last-segment final-hop task (segment
+        chains are symmetric, so it is the last to finish)."""
         n_seg = 1
         if nbytes > 0 and self.max_segments > 1:
             n_seg = min(self.max_segments,
@@ -182,28 +195,26 @@ class TaskGraphBuilder:
         if self.topo is not None and len(devices) > 1:
             # heterogeneous fabrics (GraphTopology): a DCN or degraded
             # link serializes the same bytes for link_factor x longer
-            factor = getattr(self.topo, "link_factor", None)
-            for hops in self.topo.ring_links(devices):
-                out.extend(self._chain_route(hops, seconds, after,
-                                             n_seg, factor))
-            if out:
-                return out
+            off, procs, fac, any_hops = self._flat_routes(tuple(devices))
+            if any_hops:
+                out = self.buf.collective(off, procs, fac, 1, seconds,
+                                          n_seg, list(after))
+                if out:
+                    return out
             # fully-local ring (all routes empty): charge the first
             # participant's first outgoing link so time is accounted
             first = next((l for l in self.link_idx
                           if l[0] == devices[0]), None)
             if first is None:
-                procs = [self.n_dev + d for d in devices]
+                procs2 = [self.n_dev + d for d in devices]
             else:
-                procs = [self.n_dev + self.link_idx[first]] \
+                procs2 = [self.n_dev + self.link_idx[first]] \
                     * len(devices)
         else:
-            procs = [self.n_dev + d for d in devices]
-        for p in procs:
-            t = self.add_task(p, seconds)
-            for a in after:
-                self.dep(a, t)
-            out.append(t)
+            procs2 = [self.n_dev + d for d in devices]
+        first_id = self.buf.add_tasks(procs2, [seconds] * len(procs2))
+        out = list(range(first_id, first_id + len(procs2)))
+        self.buf.cross_deps(list(after), out)
         return out
 
     # ------------------------------------------------------------------
@@ -286,12 +297,11 @@ class TaskGraphBuilder:
             degs = {0: scale_deg} if scale_deg > 1 else {}
             cm = self.cost.op_cost(n.layer, degs, ann.weight_degree())
             mem += cm.weights_memory * 4 + cm.outputs_memory
-            ids = []
-            for d in self.shard_devices(place_deg):
-                tid = self.add_task(d, cm.forward_time)
-                for p in preds:
-                    self.dep(p, tid)
-                ids.append(tid)
+            shards = self.shard_devices(place_deg)
+            first = self.buf.add_tasks(shards,
+                                       [cm.forward_time] * len(shards))
+            ids = list(range(first, first + len(shards)))
+            self.buf.cross_deps(preds, ids)
             fwd_tasks[n.guid] = ids
 
         # ---- backward (reverse topo; bwd(n) after fwd(n) and after bwd of
@@ -340,14 +350,12 @@ class TaskGraphBuilder:
             scale_deg, place_deg = _compute_and_place_degree(ann)
             degs = {0: scale_deg} if scale_deg > 1 else {}
             cm = self.cost.op_cost(n.layer, degs, ann.weight_degree())
-            ids = []
-            for d in self.shard_devices(place_deg):
-                tid = self.add_task(d, cm.backward_time)
-                for s in succs:
-                    self.dep(s, tid)
-                for f in fwd_tasks.get(n.guid, []):
-                    self.dep(f, tid)
-                ids.append(tid)
+            shards = self.shard_devices(place_deg)
+            first = self.buf.add_tasks(shards,
+                                       [cm.backward_time] * len(shards))
+            ids = list(range(first, first + len(shards)))
+            self.buf.cross_deps(succs, ids)
+            self.buf.cross_deps(fwd_tasks.get(n.guid, []), ids)
             bwd_tasks[n.guid] = ids
             # gradient sync + update riding the link processor, overlapping
             # with earlier ops' backward compute (reference NCCL path)
@@ -364,8 +372,7 @@ class TaskGraphBuilder:
                                           "all_reduce", secs, ids,
                                           nbytes=wbytes // wdeg)
 
-        makespan = native.simulate(self.proc, self.dur, self.edges,
-                                   self.num_procs)
+        makespan = self.buf.simulate(self.num_procs)
         return makespan, mem
 
 

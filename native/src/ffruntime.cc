@@ -190,4 +190,133 @@ int32_t ffgraph_closure(int32_t n, int64_t n_edges, const int32_t* esrc,
   return order.size() == static_cast<size_t>(n) ? 0 : -1;
 }
 
+// ---------------------------------------------------------------------------
+// 4. Task-graph builder (search hot loop)
+// ---------------------------------------------------------------------------
+// The auto-parallelization search expands each candidate PCG into a task DAG
+// (search/tasksim.py). The expansion of one logical collective into physical
+// ring rounds x segments x route hops is the hot loop: a BERT-large budget-8
+// search makes ~8.6k collective expansions totalling ~20M dependency edges,
+// which cost ~60 s in Python (round-4 profile). The builder keeps the
+// proc/duration/edge arrays in C++ and exposes batched task/dep insertion
+// plus the full ring expansion, so Python makes one call per logical
+// collective — the same division of labor as the reference, whose whole
+// simulator lives in C++ (src/runtime/simulator.cc:822-1200).
+
+struct FFBuilder {
+  std::vector<int32_t> proc;
+  std::vector<double> dur;
+  std::vector<int32_t> esrc, edst;
+};
+
+FFBuilder* ffb_new() { return new FFBuilder(); }
+void ffb_free(FFBuilder* b) { delete b; }
+int64_t ffb_n_tasks(FFBuilder* b) { return static_cast<int64_t>(b->proc.size()); }
+int64_t ffb_n_edges(FFBuilder* b) { return static_cast<int64_t>(b->esrc.size()); }
+
+// Append n tasks; returns the id of the first (ids are consecutive).
+int32_t ffb_add_tasks(FFBuilder* b, int32_t n, const int32_t* procs,
+                      const double* durs) {
+  int32_t first = static_cast<int32_t>(b->proc.size());
+  b->proc.insert(b->proc.end(), procs, procs + n);
+  b->dur.insert(b->dur.end(), durs, durs + n);
+  return first;
+}
+
+// All-pairs dependencies a[i] -> t for every t in b[]; used for the
+// per-shard compute tasks (preds x shards).
+void ffb_cross_deps(FFBuilder* b, int32_t na, const int32_t* a, int32_t nb,
+                    const int32_t* bs) {
+  for (int32_t i = 0; i < na; ++i)
+    for (int32_t j = 0; j < nb; ++j) {
+      b->esrc.push_back(a[i]);
+      b->edst.push_back(bs[j]);
+    }
+}
+
+// Ring-collective expansion (TaskGraphBuilder.collective_tasks semantics):
+// `rounds` rounds over `n_routes` participants; participant i's route to its
+// ring successor is the hop list route_procs[route_off[i] : route_off[i+1]]
+// (processor ids, already offset past the compute cores), with per-hop
+// duration multipliers route_fac (or null = 1.0). Each round costs
+// per_round_secs split over n_seg store-and-forward segments that pipeline
+// across the route. Round r of participant i depends on round r-1 of i and
+// of its ring predecessor (the chunk being forwarded); round 0 depends on
+// deps[]. Writes <= n_routes final task ids to out_ids; returns the count.
+int32_t ffb_collective(FFBuilder* b, int32_t n_routes,
+                       const int32_t* route_off, const int32_t* route_procs,
+                       const double* route_fac, int32_t rounds,
+                       double per_round_secs, int32_t n_seg,
+                       int32_t n_deps, const int32_t* deps,
+                       int32_t* out_ids) {
+  if (n_routes <= 0 || rounds <= 0) return 0;
+  if (n_seg < 1) n_seg = 1;
+  std::vector<int32_t> prev_last(n_routes, -1);
+  std::vector<int32_t> cur(n_routes, -1);
+  for (int32_t r = 0; r < rounds; ++r) {
+    for (int32_t i = 0; i < n_routes; ++i) {
+      int32_t h0 = route_off[i], h1 = route_off[i + 1];
+      if (h0 >= h1) {  // empty route: carry the previous round's task
+        cur[i] = prev_last[i];
+        continue;
+      }
+      int32_t last = -1;
+      for (int32_t s = 0; s < n_seg; ++s) {
+        int32_t prev = -1;
+        for (int32_t h = h0; h < h1; ++h) {
+          double d = (per_round_secs / n_seg) *
+                     (route_fac ? route_fac[h] : 1.0);
+          int32_t t = static_cast<int32_t>(b->proc.size());
+          b->proc.push_back(route_procs[h]);
+          b->dur.push_back(d);
+          if (prev < 0) {
+            if (r == 0) {
+              for (int32_t k = 0; k < n_deps; ++k) {
+                b->esrc.push_back(deps[k]);
+                b->edst.push_back(t);
+              }
+            } else {
+              int32_t pp = prev_last[(i - 1 + n_routes) % n_routes];
+              if (pp >= 0) { b->esrc.push_back(pp); b->edst.push_back(t); }
+              if (prev_last[i] >= 0) {
+                b->esrc.push_back(prev_last[i]);
+                b->edst.push_back(t);
+              }
+            }
+          } else {
+            b->esrc.push_back(prev);
+            b->edst.push_back(t);
+          }
+          prev = t;
+        }
+        if (prev >= 0) last = prev;
+      }
+      cur[i] = (last >= 0) ? last : prev_last[i];
+    }
+    std::swap(prev_last, cur);
+  }
+  int32_t n_out = 0;
+  for (int32_t i = 0; i < n_routes; ++i)
+    if (prev_last[i] >= 0) out_ids[n_out++] = prev_last[i];
+  return n_out;
+}
+
+// Copy out the accumulated arrays (sizes from ffb_n_tasks/ffb_n_edges);
+// any pointer may be null to skip that array. For tests/introspection.
+void ffb_get(FFBuilder* b, int32_t* proc, double* dur, int32_t* esrc,
+             int32_t* edst) {
+  if (proc) std::memcpy(proc, b->proc.data(), b->proc.size() * sizeof(int32_t));
+  if (dur) std::memcpy(dur, b->dur.data(), b->dur.size() * sizeof(double));
+  if (esrc) std::memcpy(esrc, b->esrc.data(), b->esrc.size() * sizeof(int32_t));
+  if (edst) std::memcpy(edst, b->edst.data(), b->edst.size() * sizeof(int32_t));
+}
+
+// Play the accumulated DAG through the event simulator.
+double ffb_simulate(FFBuilder* b, int32_t n_procs) {
+  return ffsim_simulate(static_cast<int32_t>(b->proc.size()), b->proc.data(),
+                        b->dur.data(),
+                        static_cast<int64_t>(b->esrc.size()), b->esrc.data(),
+                        b->edst.data(), n_procs, nullptr);
+}
+
 }  // extern "C"

@@ -147,7 +147,7 @@ def _two_group_makespan(spec) -> float:
         g2 = list(range(4, 8))
     b.comm_tasks(g1, secs, [])
     b.comm_tasks(g2, secs, [])
-    return native.simulate(b.proc, b.dur, b.edges, b.num_procs)
+    return b.buf.simulate(b.num_procs)
 
 
 def test_torus_vs_flat_simulation():
@@ -173,7 +173,7 @@ def _makespan(spec, groups, secs):
     b = TaskGraphBuilder(OpCostModel(spec), spec.num_devices)
     for g in groups:
         b.comm_tasks(g, secs, [])
-    return native.simulate(b.proc, b.dur, b.edges, b.num_procs)
+    return b.buf.simulate(b.num_procs)
 
 
 def test_torus_distance_and_contention():
@@ -254,7 +254,7 @@ def _pair_transfer_makespan(max_segments, nbytes=1 << 24):
     pair = [t.device((0, 0)), t.device((2, 3))]   # 2+3 = 5 hops
     secs = cm.xfer_cost(nbytes, "all_gather", 2)
     b.comm_tasks(pair, secs, [], nbytes=nbytes)
-    return native.simulate(b.proc, b.dur, b.edges, b.num_procs)
+    return b.buf.simulate(b.num_procs)
 
 
 def test_segmented_transfer_pipelines_multihop_route():
@@ -283,8 +283,8 @@ def test_segmented_transfer_default_off_is_unchanged():
     b1.comm_tasks(g, secs, [], nbytes=1 << 24)
     b2 = TaskGraphBuilder(cm, 32)
     b2.comm_tasks(g, secs, [])
-    m1 = native.simulate(b1.proc, b1.dur, b1.edges, b1.num_procs)
-    m2 = native.simulate(b2.proc, b2.dur, b2.edges, b2.num_procs)
+    m1 = b1.buf.simulate(b1.num_procs)
+    m2 = b2.buf.simulate(b2.num_procs)
     assert m1 == m2
 
 
@@ -323,12 +323,10 @@ def test_collective_round_expansion_makespan_sane():
     cm, g, secs = _ring_builders()
     b_lump = TaskGraphBuilder(cm, 32)
     b_lump.comm_tasks(g, secs, [])
-    m_lump = native.simulate(b_lump.proc, b_lump.dur, b_lump.edges,
-                             b_lump.num_procs)
+    m_lump = b_lump.buf.simulate(b_lump.num_procs)
     b_ring = TaskGraphBuilder(cm, 32)
     b_ring.collective_tasks(g, "all_reduce", secs, [])
-    m_ring = native.simulate(b_ring.proc, b_ring.dur, b_ring.edges,
-                             b_ring.num_procs)
+    m_ring = b_ring.buf.simulate(b_ring.num_procs)
     assert m_ring > 0
     # ring dataflow serializes each participant's rounds: the isolated-
     # collective makespan must be at least the per-participant serial

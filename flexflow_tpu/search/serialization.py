@@ -192,12 +192,45 @@ def _spec_degrees(spec: Optional[P], rank: int, axis_sizes: Dict[str, int],
     return degs
 
 
+def _spec_flat_ids(spec, rank: int, dmesh, n: int) -> List[int]:
+    """Flat device ids a PartitionSpec's shards actually occupy: one
+    representative device per shard (coordinate 0 on unmapped axes),
+    enumerated shard-major in tensor-dim order — so ops sharded over
+    non-leading mesh axes export their real placement instead of a
+    normalized 0..n-1 prefix (ADVICE r4). Specs a single tensor dim of
+    which spans multiple mesh axes fall back to the prefix form."""
+    import numpy as np
+    names = list(dmesh.axis_sizes.keys())
+    sizes = [dmesh.axis_sizes[a] for a in names]
+    used: List[str] = []
+    if spec is not None:
+        for j, e in enumerate(spec):
+            if j >= rank or e is None:
+                continue
+            ax = e if isinstance(e, tuple) else (e,)
+            if len(ax) != 1 or ax[0] in used or ax[0] not in names:
+                return list(range(n))   # composed/unknown: prefix form
+            used.append(ax[0])
+    if not used:
+        return list(range(n))
+    grid = np.arange(int(np.prod(sizes))).reshape(sizes)
+    index = tuple(slice(None) if a in used else 0 for a in names)
+    sub = grid[index]
+    # sub's axes are the used axes in MESH order; reorder to the order
+    # they appear across the tensor dims (shard-major enumeration)
+    mesh_order = [a for a in names if a in used]
+    sub = np.transpose(sub, [mesh_order.index(a) for a in used])
+    ids = [int(i) for i in sub.ravel()]
+    return ids if len(ids) == n else list(range(n))
+
+
 def save_legacy_strategies(path: str, strategy: ShardingStrategy,
                            layers: List[Layer]) -> None:
     """Export the searched strategy in the reference's text wire format
     so its tooling (and ``load_strategies_from_file``-based flows) can
-    consume strategies searched here. Device ids are the flat mesh
-    order; ops with a bank placement write their bank members instead."""
+    consume strategies searched here. Device ids are the flat ids each
+    shard actually occupies (see :func:`_spec_flat_ids`); ops with a
+    bank placement write their bank members instead."""
     axis_sizes = dict(strategy.dmesh.axis_sizes)
     bank_of = {}
     for b in getattr(strategy, "banks", None) or []:
@@ -236,7 +269,7 @@ def save_legacy_strategies(path: str, strategy: ShardingStrategy,
             degs[0] *= len(ids) // n
             n = len(ids)
         else:
-            ids = list(range(n))
+            ids = _spec_flat_ids(out_spec, rank, strategy.dmesh, n)
         rows.append((name, degs, ids))
     with open(path, "w") as f:
         f.write(f"{len(rows)}\n")
@@ -245,6 +278,39 @@ def save_legacy_strategies(path: str, strategy: ShardingStrategy,
             f.write("\t".join(str(d) for d in degs) + "\n")
             f.write(f"{len(ids)}\n")
             f.write("\t".join(str(i) for i in ids) + "\n")
+    # sidecar naming the bank rows: their id lists are true device
+    # subsets, byte-indistinguishable from the representative-per-shard
+    # pattern in the flat format; our importer refuses them with a
+    # pointer to the JSON format, reference tooling ignores the sidecar
+    if bank_of:
+        with open(path + ".banks.json", "w") as f:
+            json.dump({"banked_ops": sorted(
+                n for n, _, _ in rows if n in bank_of)}, f)
+
+
+def _axes_from_flat_ids(degs: List[int], ids: List[int],
+                        dmesh) -> Optional[List]:
+    """Invert :func:`_spec_flat_ids`: find the per-dim single-axis
+    assignment whose representative-device enumeration equals ``ids``.
+    Returns PartitionSpec entries, or None if no assignment matches
+    (a true subset placement). Sharded dims and mesh axes are both few,
+    so permutation search is fine."""
+    import itertools
+    names = list(dmesh.axis_sizes.keys())
+    sharded = [j for j, d in enumerate(degs) if d > 1]
+    cand_axes = [[a for a in names if dmesh.axis_sizes[a] == degs[j]]
+                 for j in sharded]
+    for combo in itertools.product(*cand_axes):
+        if len(set(combo)) != len(combo):
+            continue
+        entries: List = [None] * len(degs)
+        for j, ax in zip(sharded, combo):
+            entries[j] = ax
+        rank = len(degs)
+        got = _spec_flat_ids(P(*entries), rank, dmesh, len(ids))
+        if got == ids:
+            return entries
+    return None
 
 
 def load_legacy_strategies(path: str, layers, dmesh: DeviceMesh,
@@ -255,6 +321,12 @@ def load_legacy_strategies(path: str, layers, dmesh: DeviceMesh,
     with open(path) as f:
         toks = f.read().split()
     pos = 0
+    banked_names = set()
+    try:
+        with open(path + ".banks.json") as f:
+            banked_names = set(json.load(f).get("banked_ops", ()))
+    except OSError:
+        pass
 
     def take() -> str:
         nonlocal pos
@@ -272,15 +344,33 @@ def load_legacy_strategies(path: str, layers, dmesh: DeviceMesh,
         degs = [int(take()) for _ in range(ndims)]
         n_ids = int(take())
         ids = [int(take()) for _ in range(n_ids)]
-        if ids and ids != list(range(len(ids))):
-            # a non-prefix device subset means a bank/machine-view
-            # placement, which per-dim degrees cannot express — refuse
-            # rather than silently import a different strategy (the
-            # JSON format round-trips banks losslessly)
+        if name in banked_names:
+            # flagged by the exporter's sidecar: these ids are a true
+            # device-subset (bank) placement, which per-dim degrees
+            # cannot express — refuse rather than silently import a
+            # different strategy (the JSON format round-trips banks).
+            # The flat format alone cannot distinguish a subset from
+            # the representative-per-shard pattern below, hence the
+            # sidecar (reference tooling ignores it).
             raise ValueError(
-                f"op {name}: device ids {ids[:8]}... describe a device-"
-                f"subset placement; the legacy text import cannot "
-                f"represent it — use the JSON strategy format")
+                f"op {name}: device ids {ids[:8]}... describe a "
+                f"device-subset placement; the legacy text import "
+                f"cannot represent it — use the JSON strategy format")
+        if ids:
+            # representative-per-shard ids (what save_legacy_strategies
+            # writes): reconstruct the exact axis assignment from the
+            # id pattern — including prefix-shaped ids, which on a
+            # multi-axis mesh may correspond to a LAST (stride-1) axis,
+            # not the greedy first one
+            entries = _axes_from_flat_ids(degs, ids, dmesh)
+            if entries is not None:
+                st.ops[name] = OpSharding([P(*entries)], {})
+                continue
+            if ids != list(range(len(ids))):
+                raise ValueError(
+                    f"op {name}: device ids {ids[:8]}... match no axis "
+                    f"assignment of this mesh — use the JSON strategy "
+                    f"format")
         free = dict(axis_items)           # axis -> size, unconsumed
         entries = []
         for d in degs:

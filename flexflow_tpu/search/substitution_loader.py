@@ -25,11 +25,22 @@ integer enum values (e.g. ``AC_MODE_RELU == 11``).
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..ffconst import ActiMode, OperatorType
 from .substitution import (GraphXfer, OpX, PMConstraint, SkipRewrite,
                            TensorX)
+
+
+def default_collection_path() -> str:
+    """The vendored 640-rule collection shipped with the package
+    (``flexflow_tpu/data/graph_subst_v3.json``, decoded once from the
+    TASO-era ``.pb`` wire format by ``tools/pb_rules.py``) — what
+    ``--substitution-json`` points at in a standalone install."""
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "data", "graph_subst_v3.json")
+
 
 # reference OperatorType name -> our op type
 _OP_TYPE_MAP = {

@@ -214,6 +214,10 @@ class ModelRepository:
                 cfg.only_data_parallel = False
             else:
                 cfg.only_data_parallel = True
+                # a None list entry means plain DP for THIS instance:
+                # clear any import the caller's config carried, or the
+                # instance would silently adopt that strategy instead
+                cfg.import_strategy_file = ""
             ff = FFModel(cfg)
             ins = [ff.create_tensor(tuple(s), name=f"in{i}")
                    for i, s in enumerate(input_shapes)]

@@ -32,12 +32,12 @@ def test_pb_to_json_matches_reference_converter(tmp_path):
     assert len(xfers) > 0
 
 
-@pytest.mark.skipif(not os.path.exists(REF_JSON),
-                    reason="reference rules not mounted")
 def test_substitutions_to_dot(tmp_path):
+    from flexflow_tpu.search.substitution_loader import \
+        default_collection_path
     from flexflow_tpu.tools import substitutions_to_dot
     out = str(tmp_path / "rules.dot")
-    n = substitutions_to_dot(REF_JSON, out, limit=5)
+    n = substitutions_to_dot(default_collection_path(), out, limit=5)
     assert n == 5
     text = open(out).read()
     assert text.count("digraph") == 5

@@ -20,17 +20,26 @@ import time
 import numpy as np
 
 
-def build(banked: bool, rows: int, batch: int):
+def _vocabs(rows: int, hetero: bool):
+    if not hetero:
+        return (rows,) * 4
+    # heterogeneous tables averaging `rows` (the padded-bank case: the
+    # reference's MachineView places NON-identical tables on subsets)
+    return (rows // 2, rows * 3 // 4, rows * 5 // 4, rows * 3 // 2)
+
+
+def build(banked: bool, rows: int, batch: int, hetero: bool = False):
     from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
     from flexflow_tpu.models import DLRMConfig, build_dlrm
     from flexflow_tpu.parallel.banks import (BankSpec, choose_bank_axes,
-                                             find_bank_groups)
+                                             find_bank_groups,
+                                             group_is_padded)
     from flexflow_tpu.parallel.strategy import ShardingStrategy
     cfg = FFConfig()
     cfg.batch_size = batch
     cfg.only_data_parallel = True
     ff = FFModel(cfg)
-    dcfg = DLRMConfig(embedding_size=(rows,) * 4)
+    dcfg = DLRMConfig(embedding_size=_vocabs(rows, hetero))
     out = build_dlrm(ff, batch, dcfg)
     if not banked:
         ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy",
@@ -42,9 +51,12 @@ def build(banked: bool, rows: int, batch: int):
                                         ff.dmesh)
     groups = find_bank_groups(ff.layers)
     assert groups, "no bank group found"
+    padded = group_is_padded(groups[0])
+    assert padded == hetero
     bank_axes, batch_axes = choose_bank_axes(ff.dmesh, len(groups[0]))
     bk = BankSpec([l.name for l in groups[0]], bank_axes,
-                  batch_axes=batch_axes, param_name="__bank0__EMB")
+                  batch_axes=batch_axes, param_name="__bank0__EMB",
+                  padded=padded)
     st.banks = [bk]
     ff.compile(SGDOptimizer(0.05), "sparse_categorical_crossentropy", [],
                strategy=st, output_tensor=out)
@@ -80,6 +92,8 @@ def main():
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--hetero", action="store_true",
+                    help="heterogeneous vocab sizes (padded banks)")
     ap.add_argument("--out", default=None)
     a = ap.parse_args()
     import os
@@ -88,13 +102,16 @@ def main():
         # the ambient TPU plugin ignores the env var; force it through
         # jax.config before anything touches devices (tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
-    ff_dp, _ = build(False, a.rows, a.batch)
+    ff_dp, _ = build(False, a.rows, a.batch, a.hetero)
     t_dp, sd_dp = timed(ff_dp, a.batch, a.steps, a.repeats)
     del ff_dp
-    ff_bk, bk = build(True, a.rows, a.batch)
+    ff_bk, bk = build(True, a.rows, a.batch, a.hetero)
     t_bk, sd_bk = timed(ff_bk, a.batch, a.steps, a.repeats)
     rec = {
-        "workload": f"dlrm_4x{a.rows}x64",
+        "workload": (f"dlrm_4x{a.rows}x64" if not a.hetero else
+                     "dlrm_hetero_" + "x".join(
+                         str(v) for v in _vocabs(a.rows, True))),
+        "padded_banks": a.hetero,
         "platform": jax.default_backend(),
         "n_devices": len(jax.devices()),
         "bank_axes": list(bk.axes),

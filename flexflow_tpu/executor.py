@@ -168,9 +168,17 @@ class GraphProgram:
         xs = jax.lax.with_sharding_constraint(
             xs, NamedSharding(mesh, in_sp))
         w = params.get(bk.param_name, {})
+        emit_params = members[0].params
+        if getattr(bk, "padded", False):
+            # heterogeneous members: emit with weight-sizing params
+            # (e.g. num_entries) maxed to match the padded stack
+            from .parallel.banks import _PAD_FREE_PARAMS
+            emit_params = dict(members[0].params)
+            for key in _PAD_FREE_PARAMS.get(members[0].op_type, ()):
+                emit_params[key] = max(m.params[key] for m in members)
 
         def one(x_k, w_k):
-            return op.emit(members[0].params, [x_k], w_k, ctx,
+            return op.emit(emit_params, [x_k], w_k, ctx,
                            members[0].name)[0]
 
         out = jax.vmap(one)(xs, w)
@@ -405,8 +413,19 @@ class Executor:
             lp = {}
             wnames = list(bank_member_arrs[bk.members[0]].keys())
             for wname in wnames:
-                stacked = np.stack([bank_member_arrs[m][wname]
-                                    for m in bk.members])
+                arrs = [bank_member_arrs[m][wname] for m in bk.members]
+                if getattr(bk, "padded", False):
+                    # heterogeneous members (e.g. different vocab
+                    # sizes): zero-pad each weight to the group max —
+                    # lookups are bounded by each member's true vocab,
+                    # so the padding is never read
+                    tgt = tuple(max(a.shape[d] for a in arrs)
+                                for d in range(arrs[0].ndim))
+                    arrs = [np.pad(a, [(0, t - s) for s, t in
+                                       zip(a.shape, tgt)])
+                            if tuple(a.shape) != tgt else a
+                            for a in arrs]
+                stacked = np.stack(arrs)
                 psh.setdefault(bk.param_name, {})[wname] = NamedSharding(
                     self.dmesh.mesh,
                     P(bank_spec, *([None] * (stacked.ndim - 1))))

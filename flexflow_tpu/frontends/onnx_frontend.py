@@ -17,27 +17,43 @@ from ..model import FFModel
 
 
 def _attrs(node) -> Dict[str, Any]:
-    import onnx
+    try:
+        import onnx
+        get = onnx.helper.get_attribute_value
+    except ImportError:
+        from .onnx_wire import attribute_value as get
     out = {}
     for a in node.attribute:
-        out[a.name] = onnx.helper.get_attribute_value(a)
+        out[a.name] = get(a)
     return out
 
 
 class ONNXModel:
     def __init__(self, path_or_model):
+        """Accepts a path, serialized ModelProto bytes, or a loaded
+        model object. Uses the ``onnx`` package when installed, else
+        the built-in wire decoder (``onnx_wire`` — the reference's
+        Triton backend likewise parses ONNX itself,
+        ``triton/src/onnx_parser.cc``)."""
         try:
             import onnx
-        except ImportError as e:  # pragma: no cover
-            raise ImportError(
-                "the ONNX frontend requires the 'onnx' package "
-                "(pip install onnx)") from e
-        self.model = onnx.load(path_or_model) \
-            if isinstance(path_or_model, (str, bytes)) else path_or_model
+            import onnx.numpy_helper as nh
+            self.model = onnx.load(path_or_model) \
+                if isinstance(path_or_model, str) else \
+                (onnx.ModelProto.FromString(path_or_model)
+                 if isinstance(path_or_model, bytes) else path_or_model)
+            to_arr = nh.to_array
+        except ImportError:
+            from . import onnx_wire
+            if isinstance(path_or_model, str):
+                with open(path_or_model, "rb") as f:
+                    path_or_model = f.read()
+            self.model = onnx_wire.load_model(path_or_model) \
+                if isinstance(path_or_model, bytes) else path_or_model
+            to_arr = onnx_wire.to_array
         self.initializers: Dict[str, np.ndarray] = {}
-        import onnx.numpy_helper as nh
         for init in self.model.graph.initializer:
-            self.initializers[init.name] = nh.to_array(init)
+            self.initializers[init.name] = to_arr(init)
 
     # ------------------------------------------------------------------
     def apply(self, ff: FFModel, input_tensors: Dict[str, Tensor]
@@ -190,10 +206,13 @@ class ONNXModel:
         return env[node.input[0]]  # dtype policy handled by the executor
 
     def handle_Constant(self, ff, node, env):
-        import onnx.numpy_helper as nh
         a = _attrs(node)
         if "value" in a:
-            return nh.to_array(a["value"])
+            v = a["value"]
+            if isinstance(v, np.ndarray):   # wire-decoder path
+                return v
+            import onnx.numpy_helper as nh
+            return nh.to_array(v)
         for k in ("value_float", "value_int"):  # scalar attribute forms
             if k in a:
                 return np.asarray(a[k])

@@ -55,16 +55,26 @@ class MachineView:
 
 @dataclasses.dataclass
 class BankSpec:
-    """K independent same-signature ops placed on disjoint device
-    subsets. ``members`` is ordered: the stacked bank dim is sharded in
-    contiguous blocks, so member k lives at bank coordinate
-    ``k // (K / bank_degree)``. ``axes`` are the mesh axes forming the
-    bank dim; their sizes multiply to ``bank_degree``, which must
-    divide K."""
+    """K independent ops placed on disjoint device subsets. ``members``
+    is ordered: the stacked bank dim is sharded in contiguous blocks,
+    so member k lives at bank coordinate ``k // (K / bank_degree)``.
+    ``axes`` are the mesh axes forming the bank dim; their sizes
+    multiply to ``bank_degree``, which must divide K.
+
+    ``padded=False``: members share an exact signature (v1).
+    ``padded=True``: members share a signature FAMILY — same op type,
+    inputs and outputs, differing only in weight shapes (heterogeneous
+    embedding tables: different vocab sizes). Weights are zero-padded
+    to the per-name max shape before stacking; lookups never touch the
+    padding (ids are bounded by each member's true vocab), so banked
+    and unbanked runs stay numerically identical. This is the
+    reference's MachineView placement for NON-identical ops
+    (machine_view.h:14-62) — the r4 'banks v1 is narrow' gap."""
     members: List[str]                  # layer names, bank index = position
     axes: Tuple[str, ...]               # mesh axes carrying the bank dim
     batch_axes: Tuple[str, ...] = ()    # leftover axes for dp inside subsets
     param_name: str = "__bank__"
+    padded: bool = False
 
     def bank_degree(self, dmesh) -> int:
         d = 1
@@ -114,24 +124,34 @@ class BankSpec:
 _BANKABLE = {OperatorType.OP_EMBEDDING, OperatorType.OP_LINEAR}
 
 
-def _signature(layer):
+# params that only size the WEIGHT (never the output): members of a
+# padded family may differ in them
+_PAD_FREE_PARAMS = {OperatorType.OP_EMBEDDING: ("num_entries",)}
+
+
+def _signature(layer, family: bool = False):
     """Two layers may share a bank iff their signatures match: same op,
     same params, same input/output shapes+dtypes (so their emits are
-    vmappable over a stacked leading dim)."""
+    vmappable over a stacked leading dim). With ``family=True``,
+    weight-sizing params (``_PAD_FREE_PARAMS``) are excluded — members
+    then differ only in weight shape and are pad-stackable."""
+    skip = _PAD_FREE_PARAMS.get(layer.op_type, ()) if family else ()
     return (layer.op_type,
             tuple(sorted((k, v) for k, v in layer.params.items()
-                         if not callable(v))),
+                         if not callable(v) and k not in skip)),
             tuple((tuple(t.shape), t.dtype) for t in layer.inputs),
             tuple((tuple(t.shape), t.dtype) for t in layer.outputs))
 
 
-def find_bank_groups(layers: Sequence) -> List[List]:
-    """Groups of >= 2 mutually independent same-signature bankable
-    layers. Independence: no member's output (transitively) feeds
-    another member — guaranteed here by requiring every member's inputs
-    to be produced before the FIRST member (or be graph inputs), which
-    also lets the executor emit the whole group at the first member's
-    position."""
+def find_bank_groups(layers: Sequence,
+                     allow_padded: bool = True) -> List[List]:
+    """Groups of >= 2 mutually independent bankable layers sharing a
+    signature (or, with ``allow_padded``, a signature family — see
+    :class:`BankSpec`). Independence: no member's output (transitively)
+    feeds another member — guaranteed here by requiring every member's
+    inputs to be produced before the FIRST member (or be graph inputs),
+    which also lets the executor emit the whole group at the first
+    member's position."""
     by_sig: Dict[tuple, List] = {}
     produced_at: Dict[int, int] = {}    # tensor guid -> producer index
     for i, l in enumerate(layers):
@@ -143,7 +163,7 @@ def find_bank_groups(layers: Sequence) -> List[List]:
             continue
         if len(l.outputs) != 1 or len(l.inputs) != 1:
             continue
-        by_sig.setdefault(_signature(l), []).append(l)
+        by_sig.setdefault(_signature(l, family=allow_padded), []).append(l)
     groups = []
     for sig, ls in by_sig.items():
         if len(ls) < 2:
@@ -155,6 +175,12 @@ def find_bank_groups(layers: Sequence) -> List[List]:
         if len(ok) >= 2:
             groups.append(sorted(ok, key=lambda l: pos[l.name]))
     return groups
+
+
+def group_is_padded(group: Sequence) -> bool:
+    """True when the group's members differ in exact signature (weight
+    shapes) and need pad-stacking."""
+    return len({_signature(l) for l in group}) > 1
 
 
 def choose_bank_axes(dmesh, k_members: int,

@@ -31,25 +31,51 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
-from ..parallel.banks import BankSpec, choose_bank_axes, find_bank_groups
+from ..parallel.banks import (BankSpec, choose_bank_axes, find_bank_groups,
+                              group_is_padded)
 from ..parallel.machine import DeviceMesh
 from .costmodel import OpCostModel
 
 
-def _weight_bytes(layer) -> int:
-    from ..dtypes import itemsize
+def _weight_specs(layer):
     from ..ops import get_op_def
     op = get_op_def(layer.op_type)
-    specs = layer.weights or op.weights(
+    return layer.weights or op.weights(
         layer.params, [t.shape for t in layer.inputs],
         [t.dtype for t in layer.inputs])
+
+
+def _weight_bytes(layer) -> int:
+    from ..dtypes import itemsize
     total = 0
-    for s in specs:
+    for s in _weight_specs(layer):
         n = 1
         for d in s.shape:
             n *= d
         total += n * itemsize(s.dtype)
     return total
+
+
+def _padded_weight_bytes(group) -> float:
+    """Mean per-member weight bytes AFTER pad-stacking (heterogeneous
+    groups pay for the max shape per weight name on every member)."""
+    from ..dtypes import itemsize
+    shapes = {}
+    dt = {}
+    for l in group:
+        for s in _weight_specs(l):
+            cur = shapes.get(s.name)
+            shapes[s.name] = tuple(max(a, b)
+                                   for a, b in zip(cur, s.shape)) \
+                if cur is not None else tuple(s.shape)
+            dt[s.name] = s.dtype
+    total = 0
+    for nm, sh in shapes.items():
+        n = 1
+        for d in sh:
+            n *= d
+        total += n * itemsize(dt[nm])
+    return float(total)
 
 
 def _output_bytes(layer) -> int:
@@ -98,15 +124,28 @@ def propose_banks(layers: Sequence, dmesh: DeviceMesh,
         if axes is None:
             continue
         bank_axes, batch_axes = axes
+        padded = group_is_padded(group)
         spec = BankSpec([l.name for l in group], bank_axes,
                         batch_axes=batch_axes,
-                        param_name=f"__bank{gi}__{group[0].op_type.name}")
+                        param_name=f"__bank{gi}__{group[0].op_type.name}",
+                        padded=padded)
         bdeg = spec.bank_degree(dmesh)
-        w_b = float(sum(_weight_bytes(l) for l in group)) / k
+        # heterogeneous groups are charged their pad-stacked weight
+        # bytes: every member pays for the per-name max shape
+        w_b = _padded_weight_bytes(group) if padded \
+            else float(sum(_weight_bytes(l) for l in group)) / k
         o_b = float(sum(_output_bytes(l) for l in group)) / k
         c_whole = bank_group_cost(k, w_b, o_b, n, 1, cost_model)
         c_bank = bank_group_cost(k, w_b, o_b, n, bdeg, cost_model)
-        if mode == "force" or c_bank < 0.95 * c_whole:
+        # auto mode banks only when the win is material: a relative
+        # AND absolute margin, on a table-scale group. Without the
+        # floor, tiny embedding pairs (e.g. a transformer's wte/wpe,
+        # ~16 KB) bank for microsecond-level predicted savings, moving
+        # their params under the stacked bank leaf for nothing — the
+        # placement exists for DLRM-scale tables.
+        material = (c_whole - c_bank > 5e-5
+                    and w_b * k >= (1 << 20))
+        if mode == "force" or (c_bank < 0.95 * c_whole and material):
             out.append((spec, c_whole, c_bank))
     return out
 
@@ -114,13 +153,38 @@ def propose_banks(layers: Sequence, dmesh: DeviceMesh,
 def attach_banks(strategy, layers, cost_model,
                  mode: str = "auto",
                  reserved_axes: Sequence[str] = ()) -> List[BankSpec]:
-    """Attach winning banks to a ShardingStrategy in place. Skipped when
-    the strategy carries a pipeline region (bank members would need to
-    sit outside it; not composed in v1)."""
-    if getattr(strategy, "pipeline", None) is not None:
-        return []
+    """Attach winning banks to a ShardingStrategy in place.
+
+    Composes with a pipeline region: the prologue and epilogue are
+    emitted through the same bank-aware ``emit_layers`` path
+    (executor.py ``_forward``), so groups whose members sit entirely
+    before the region (e.g. DLRM-style embedding tables feeding a
+    pipelined MLP) or entirely after it bank normally; only groups
+    touching the region — whose members are stacked/scanned by the
+    pipeline engine itself — are skipped. The pp mesh axis is reserved
+    so the bank dim never claims it."""
+    pipe = getattr(strategy, "pipeline", None)
+    reserved = list(reserved_axes)
+    pre = post = None
+    if pipe is not None:
+        # layers absorbed into the edge stages are emitted inside the
+        # pipeline's shard_map (not the bank-aware emit_layers path):
+        # treat them as in-region
+        absorbed = {l.name
+                    for ls in (getattr(pipe, "prologue", None) or (),
+                               getattr(pipe, "epilogue", None) or ())
+                    for l in ls}
+        pre = {l.name for l in layers[:pipe.start]} - absorbed
+        post = {l.name for l in layers[pipe.end:]} - absorbed
+        for ax in (getattr(pipe, "pp_axis", None),
+                   getattr(pipe, "tp_axis", None)):
+            if ax and ax not in reserved:
+                reserved.append(ax)
     props = propose_banks(layers, strategy.dmesh, cost_model,
-                          reserved_axes=reserved_axes, mode=mode)
+                          reserved_axes=tuple(reserved), mode=mode)
     specs = [p[0] for p in props]
+    if pipe is not None:
+        specs = [s for s in specs
+                 if set(s.members) <= pre or set(s.members) <= post]
     strategy.banks = list(getattr(strategy, "banks", [])) + specs
     return specs

@@ -69,6 +69,7 @@ def banks_to_json(strategy: ShardingStrategy) -> List[Dict]:
         {"members": list(b.members), "axes": list(b.axes),
          "batch_axes": list(b.batch_axes),
          "param_name": b.param_name,
+         "padded": bool(getattr(b, "padded", False)),
          "machine_views": {
              m: dataclasses.asdict(v)
              for m, v in b.machine_views(strategy.dmesh).items()}}
@@ -427,6 +428,7 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
         from ..parallel.banks import BankSpec
         st.banks = [BankSpec(list(b["members"]), tuple(b["axes"]),
                              batch_axes=tuple(b.get("batch_axes", ())),
-                             param_name=b.get("param_name", "__bank__"))
+                             param_name=b.get("param_name", "__bank__"),
+                             padded=bool(b.get("padded", False)))
                     for b in doc["banks"]]
     return st

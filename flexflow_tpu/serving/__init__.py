@@ -14,6 +14,8 @@ live ``FFModel`` or from the torch-frontend's serialization hand-off
 from .session import InferenceSession, ModelRepository
 from .scheduler import BatchScheduler, QueueFullError, SchedulerMetrics
 from .http_server import serve_http
+from .async_server import serve_async
 
 __all__ = ["InferenceSession", "ModelRepository", "BatchScheduler",
-           "QueueFullError", "SchedulerMetrics", "serve_http"]
+           "QueueFullError", "SchedulerMetrics", "serve_http",
+           "serve_async"]

@@ -31,6 +31,101 @@ import numpy as np
 from .scheduler import QueueFullError
 
 
+def get_route(path: str, repo, schedulers):
+    """Route one GET; returns ``(status, json_obj)``. Shared by the
+    threading and asyncio front-ends."""
+    if path == "/v2/health/ready":
+        return 200, {"ready": True}
+    if path == "/v2/models":
+        return 200, {"models": repo.names()}
+    if path == "/v2/metrics":
+        # per-model scheduler counters + latency percentiles
+        # (Triton's /metrics endpoint, prometheus-lite as JSON)
+        out = {}
+        # snapshot: a concurrent unload may pop from schedulers
+        for name, sched in list(schedulers.items()):
+            out[name] = sched.metrics.snapshot(sched._q.qsize())
+            out[name]["instances"] = sched.num_instances
+        return 200, {"models": out}
+    return 404, {"error": f"no route {path}"}
+
+
+def post_route(path: str, body: bytes, repo, schedulers):
+    """Route one POST (BLOCKING — the batching scheduler's ``infer``
+    waits for the result; the asyncio front runs this in a thread
+    pool). Returns ``(status, json_obj)``."""
+    parts = path.strip("/").split("/")
+    # v2/repository/models/<name>/unload (Triton repository API)
+    if len(parts) == 5 and parts[:3] == ["v2", "repository", "models"] \
+            and parts[4] == "unload":
+        try:
+            repo.unload(parts[3])
+            sched = schedulers.pop(parts[3], None)
+            if sched is not None:
+                sched.close()
+            return 200, {"unloaded": parts[3]}
+        except KeyError as e:
+            return 404, {"error": str(e)}
+    # v2/models/<name>/{infer,generate}
+    if len(parts) != 4 or parts[:2] != ["v2", "models"] \
+            or parts[3] not in ("infer", "generate"):
+        return 404, {"error": f"no route {path}"}
+    name, verb = parts[2], parts[3]
+    try:
+        doc = json.loads(body)
+        inputs = {}
+        for rec in doc["inputs"]:
+            arr = np.asarray(rec["data"], dtype=np.dtype(
+                rec.get("datatype", "float32").lower()
+                .replace("fp", "float")))
+            inputs[rec["name"]] = arr.reshape(rec["shape"])
+        if verb == "generate":
+            sess = repo.get(name)      # unknown model -> 404
+            p = doc.get("parameters", {})
+            missing = [k for k in ("prompt_len",
+                                   "max_new_tokens") if k not in p]
+            if missing or "input_ids" not in inputs:
+                return 400, {
+                    "error": "generate needs inputs.input_ids "
+                             f"and parameters {missing or ''}"}
+            eos = p.get("eos_token_id")
+            top_k = int(p.get("top_k", 0))
+            top_p = float(p.get("top_p", 1.0))
+            temp = float(p.get("temperature", 0.0))
+            num_beams = int(p.get("num_beams", 1))
+            if not (0.0 < top_p <= 1.0) or top_k < 0 \
+                    or temp < 0.0 or num_beams < 1:
+                return 400, {
+                    "error": "need 0 < top_p <= 1, top_k >= 0, "
+                             "temperature >= 0, num_beams >= 1"}
+            pl = p["prompt_len"]
+            out = sess.generate(
+                inputs["input_ids"],
+                prompt_len=(np.asarray(pl, np.int32)
+                            if isinstance(pl, list) else int(pl)),
+                max_new_tokens=int(p["max_new_tokens"]),
+                temperature=temp,
+                seed=int(p.get("seed", 0)),
+                eos_token_id=None if eos is None else int(eos),
+                top_k=top_k, top_p=top_p, num_beams=num_beams)
+            return 200, {"outputs": [{
+                "name": "output_ids", "shape": list(out.shape),
+                "data": np.asarray(out, np.int32).ravel().tolist()}]}
+        sched = schedulers.get(name)
+        out = sched.infer(inputs) if sched is not None \
+            else repo.get(name).infer(inputs)
+        return 200, {"outputs": [{
+            "name": "output0", "shape": list(out.shape),
+            "data": np.asarray(out, np.float32).ravel().tolist()}]}
+    except KeyError as e:
+        return 404, {"error": str(e)}
+    except QueueFullError as e:
+        # bounded-queue backpressure: shed load explicitly
+        return 503, {"error": str(e)}
+    except Exception as e:  # noqa: BLE001 — report, don't die
+        return 400, {"error": f"{type(e).__name__}: {e}"}
+
+
 def _make_handler(repo, schedulers):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -45,96 +140,15 @@ def _make_handler(repo, schedulers):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/v2/health/ready":
-                return self._send(200, {"ready": True})
-            if self.path == "/v2/models":
-                return self._send(200, {"models": repo.names()})
-            if self.path == "/v2/metrics":
-                # per-model scheduler counters + latency percentiles
-                # (Triton's /metrics endpoint, prometheus-lite as JSON)
-                out = {}
-                # snapshot: a concurrent unload may pop from schedulers
-                for name, sched in list(schedulers.items()):
-                    out[name] = sched.metrics.snapshot(
-                        sched._q.qsize())
-                    out[name]["instances"] = sched.num_instances
-                return self._send(200, {"models": out})
-            return self._send(404, {"error": f"no route {self.path}"})
+            self._send(*get_route(self.path, repo, schedulers))
 
         def do_POST(self):
-            parts = self.path.strip("/").split("/")
-            # v2/repository/models/<name>/unload (Triton repository API)
-            if len(parts) == 5 and parts[:3] == ["v2", "repository",
-                                                 "models"] \
-                    and parts[4] == "unload":
-                try:
-                    repo.unload(parts[3])
-                    sched = schedulers.pop(parts[3], None)
-                    if sched is not None:
-                        sched.close()
-                    return self._send(200, {"unloaded": parts[3]})
-                except KeyError as e:
-                    return self._send(404, {"error": str(e)})
-            # v2/models/<name>/{infer,generate}
-            if len(parts) != 4 or parts[:2] != ["v2", "models"] \
-                    or parts[3] not in ("infer", "generate"):
-                return self._send(404, {"error": f"no route {self.path}"})
-            name, verb = parts[2], parts[3]
             try:
                 n = int(self.headers.get("Content-Length", 0))
-                doc = json.loads(self.rfile.read(n))
-                inputs = {}
-                for rec in doc["inputs"]:
-                    arr = np.asarray(rec["data"], dtype=np.dtype(
-                        rec.get("datatype", "float32").lower()
-                        .replace("fp", "float")))
-                    inputs[rec["name"]] = arr.reshape(rec["shape"])
-                if verb == "generate":
-                    sess = repo.get(name)      # unknown model -> 404
-                    p = doc.get("parameters", {})
-                    missing = [k for k in ("prompt_len",
-                                           "max_new_tokens") if k not in p]
-                    if missing or "input_ids" not in inputs:
-                        return self._send(400, {
-                            "error": "generate needs inputs.input_ids "
-                                     f"and parameters {missing or ''}"})
-                    eos = p.get("eos_token_id")
-                    top_k = int(p.get("top_k", 0))
-                    top_p = float(p.get("top_p", 1.0))
-                    temp = float(p.get("temperature", 0.0))
-                    num_beams = int(p.get("num_beams", 1))
-                    if not (0.0 < top_p <= 1.0) or top_k < 0 \
-                            or temp < 0.0 or num_beams < 1:
-                        return self._send(400, {
-                            "error": "need 0 < top_p <= 1, top_k >= 0, "
-                                     "temperature >= 0, num_beams >= 1"})
-                    pl = p["prompt_len"]
-                    out = sess.generate(
-                        inputs["input_ids"],
-                        prompt_len=(np.asarray(pl, np.int32)
-                                    if isinstance(pl, list) else int(pl)),
-                        max_new_tokens=int(p["max_new_tokens"]),
-                        temperature=temp,
-                        seed=int(p.get("seed", 0)),
-                        eos_token_id=None if eos is None else int(eos),
-                        top_k=top_k, top_p=top_p, num_beams=num_beams)
-                    return self._send(200, {"outputs": [{
-                        "name": "output_ids", "shape": list(out.shape),
-                        "data": np.asarray(out, np.int32)
-                        .ravel().tolist()}]})
-                sched = schedulers.get(name)
-                out = sched.infer(inputs) if sched is not None \
-                    else repo.get(name).infer(inputs)
-                self._send(200, {"outputs": [{
-                    "name": "output0", "shape": list(out.shape),
-                    "data": np.asarray(out, np.float32).ravel().tolist()}]})
-            except KeyError as e:
-                self._send(404, {"error": str(e)})
-            except QueueFullError as e:
-                # bounded-queue backpressure: shed load explicitly
-                self._send(503, {"error": str(e)})
-            except Exception as e:  # noqa: BLE001 — report, don't die
-                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                body = self.rfile.read(n)
+            except (ValueError, OSError) as e:
+                return self._send(400, {"error": f"bad request: {e}"})
+            self._send(*post_route(self.path, body, repo, schedulers))
 
     return Handler
 

@@ -190,12 +190,82 @@ class ModelRepository:
         (``triton/src/instance.cc``). A single value with
         ``instances=N`` compiles once and clones (instances sharing one
         program); a list compiles each instance separately."""
+        from ..frontends.torch_fx import PyTorchModel
+
+        def graph_build(ff):
+            ins = [ff.create_tensor(tuple(s), name=f"in{i}")
+                   for i, s in enumerate(input_shapes)]
+            outs = PyTorchModel.file_to_ff(path, ff, ins)
+            return outs[0]
+
+        return self._load_with_builder(
+            name, graph_build, batch_buckets=batch_buckets, config=config,
+            strategy_file=strategy_file, instances=instances,
+            checkpoint_dir=checkpoint_dir)
+
+    def load_onnx(self, name: str, path_or_model,
+                  input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                  checkpoint_dir: Optional[str] = None,
+                  batch_buckets: Sequence[int] = (1, 4, 16, 64),
+                  config=None, strategy_file=None, instances: int = 1):
+        """Serve an ONNX model torch-free (the reference Triton
+        backend's direct ONNX ingestion, ``triton/src/onnx_parser.cc``):
+        rebuild the graph through ``frontends.onnx_frontend.ONNXModel``,
+        transfer the initializer weights after compile, and register
+        sessions. ``input_shapes`` overrides/maps graph-input name ->
+        shape (required for inputs with symbolic batch dims);
+        ``strategy_file``/``instances`` behave as in
+        :meth:`load_graph`."""
+        from ..frontends.onnx_frontend import ONNXModel
+        model = ONNXModel(path_or_model)
+        graph = model.model.graph
+        fed = [vi for vi in graph.input
+               if vi.name not in model.initializers]
+        # elem_type -> framework dtype (TensorProto enum values)
+        from ..ffconst import DataType
+        dt_map = {1: DataType.DT_FLOAT, 6: DataType.DT_INT32,
+                  7: DataType.DT_INT64, 9: DataType.DT_BOOLEAN,
+                  10: DataType.DT_HALF, 16: DataType.DT_BFLOAT16}
+
+        def shape_of(vi):
+            if input_shapes and vi.name in input_shapes:
+                return tuple(int(d) for d in input_shapes[vi.name])
+            dims = []
+            for d in vi.type.tensor_type.shape.dim:
+                if d.dim_param or d.dim_value <= 0:
+                    raise ValueError(
+                        f"ONNX input {vi.name!r} has a symbolic dim "
+                        f"{d.dim_param or '?'} — pass input_shapes")
+                dims.append(int(d.dim_value))
+            return tuple(dims)
+
+        def onnx_build(ff):
+            ins = {vi.name: ff.create_tensor(
+                shape_of(vi), name=vi.name,
+                dtype=dt_map.get(vi.type.tensor_type.elem_type,
+                                 DataType.DT_FLOAT)) for vi in fed}
+            outs = model.apply(ff, ins)
+            return outs[0]
+
+        return self._load_with_builder(
+            name, onnx_build, batch_buckets=batch_buckets, config=config,
+            strategy_file=strategy_file, instances=instances,
+            checkpoint_dir=checkpoint_dir,
+            post_compile=model.copy_weights)
+
+    def _load_with_builder(self, name, graph_build, batch_buckets,
+                           config, strategy_file, instances,
+                           checkpoint_dir=None, post_compile=None):
+        """Shared per-instance loading: one compiled session per
+        strategy-file entry (None = plain DP), or one session cloned
+        ``instances`` times (replicas sharing the compiled program) —
+        the reference Triton backend's per-instance strategy files
+        (``triton/src/instance.cc``)."""
         import copy
 
         from ..config import FFConfig
         from ..model import FFModel
         from ..runtime.optimizers import SGDOptimizer
-        from ..frontends.torch_fx import PyTorchModel
 
         per_instance = isinstance(strategy_file, (list, tuple))
         files = (list(strategy_file) if per_instance
@@ -219,11 +289,11 @@ class ModelRepository:
                 # instance would silently adopt that strategy instead
                 cfg.import_strategy_file = ""
             ff = FFModel(cfg)
-            ins = [ff.create_tensor(tuple(s), name=f"in{i}")
-                   for i, s in enumerate(input_shapes)]
-            outs = PyTorchModel.file_to_ff(path, ff, ins)
+            out = graph_build(ff)
             ff.compile(SGDOptimizer(0.0), "identity", [],
-                       output_tensor=outs[0])
+                       output_tensor=out)
+            if post_compile is not None:
+                post_compile(ff)
             if checkpoint_dir:
                 from ..runtime.checkpoint import restore_model_checkpoint
                 restore_model_checkpoint(ff, checkpoint_dir)

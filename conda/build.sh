@@ -1,0 +1,8 @@
+#!/bin/bash
+# Conda build script: install the package, then compile the native
+# runtime library in place (flexflow_tpu/native/ensure_built would do
+# this lazily at first use; building here front-loads it).
+set -euo pipefail
+$PYTHON -m pip install . --no-deps --no-build-isolation -vv
+make -C native || echo "native build skipped (no toolchain); the ctypes \
+layer falls back to pure Python"

@@ -1,0 +1,29 @@
+# Spack package (analog of the reference's spack/package.py). Place in
+# a spack repo as packages/py-flexflow-tpu/package.py, or:
+#   spack dev-build py-flexflow-tpu@0.1.0
+from spack.package import PythonPackage, depends_on, version
+
+
+class PyFlexflowTpu(PythonPackage):
+    """TPU-native distributed DNN training framework with automatic
+    parallelization search (FlexFlow/Unity capabilities re-designed on
+    JAX/XLA/Pallas; C++ native runtime for the search/simulator/loader
+    hot paths)."""
+
+    homepage = "https://github.com/flexflow/flexflow-tpu"
+    # dev-build from a local checkout; no release tarball yet
+    version("0.1.0")
+
+    depends_on("python@3.10:", type=("build", "run"))
+    depends_on("py-setuptools@61:", type="build")
+    depends_on("py-pip", type="build")
+    depends_on("py-jax", type=("build", "run"))
+    depends_on("py-numpy", type=("build", "run"))
+    # native runtime (libffruntime.so) builds lazily with the ambient
+    # C++ toolchain; gcc provides it under spack
+    depends_on("gcc@9:", type="run")
+
+    @property
+    def import_modules(self):
+        return ["flexflow_tpu", "flexflow_tpu.serving",
+                "flexflow_tpu.search", "flexflow_tpu.frontends"]

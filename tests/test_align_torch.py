@@ -280,3 +280,143 @@ def test_align_mse_loss_gradient():
     yt = torch.from_numpy(x) @ wt
     torch.nn.functional.mse_loss(yt, torch.from_numpy(label)).backward()
     np.testing.assert_allclose(gj, wt.grad.numpy(), atol=1e-3, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# op long tail (reference tests/align/test_all_operators.sh: 27 ops —
+# cos sin exp flat getitem identity reducesum scalar_* view_embedding
+# max min gather were the uncovered remainder)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("op,torch_fn", [
+    ("cos", torch.cos),
+    ("sin", torch.sin),
+    ("exp", torch.exp),
+    ("identity", lambda x: x),
+    ("rsqrt", lambda x: torch.rsqrt(torch.abs(x) + 1.5)),
+])
+def test_align_unary(op, torch_fn):
+    x = _gen((4, 17), 20)
+    if op == "rsqrt":
+        x = np.abs(x) + 1.5
+        torch_fn = torch.rsqrt
+    y = _forward(lambda ff: getattr(ff, op)(
+        ff.create_tensor((4, 17), name="x")), {"x": x})[1]
+    ref = torch_fn(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(y, ref, atol=ATOL, rtol=RTOL)
+
+
+@pytest.mark.parametrize("op,torch_fn", [
+    ("scalar_add", lambda x: x + 1.5),
+    ("scalar_sub", lambda x: x - 1.5),
+    ("scalar_multiply", lambda x: x * 1.5),
+    ("scalar_true_divide", lambda x: x / 1.5),
+])
+def test_align_scalar_ops(op, torch_fn):
+    x = _gen((3, 9), 21)
+    y = _forward(lambda ff: getattr(ff, op)(
+        ff.create_tensor((3, 9), name="x"), 1.5), {"x": x})[1]
+    np.testing.assert_allclose(y, torch_fn(torch.from_numpy(x)).numpy(),
+                               atol=ATOL, rtol=RTOL)
+
+
+def test_align_pow():
+    x = np.abs(_gen((3, 9), 22)) + 0.5
+    y = _forward(lambda ff: ff.pow(
+        ff.create_tensor((3, 9), name="x"), 2.5), {"x": x})[1]
+    np.testing.assert_allclose(
+        y, torch.pow(torch.from_numpy(x), 2.5).numpy(),
+        atol=ATOL, rtol=RTOL)
+
+
+def test_align_flat():
+    x = _gen((4, 3, 5, 2), 23)
+    y = _forward(lambda ff: ff.flat(
+        ff.create_tensor((4, 3, 5, 2), name="x")), {"x": x})[1]
+    ref = torch.flatten(torch.from_numpy(x), start_dim=1).numpy()
+    np.testing.assert_allclose(y, ref, atol=ATOL, rtol=RTOL)
+
+
+def test_align_reduce_sum_and_mean():
+    x = _gen((4, 6, 5), 24)
+    y = _forward(lambda ff: ff.reduce_sum(
+        ff.create_tensor((4, 6, 5), name="x"), axes=[1]), {"x": x})[1]
+    np.testing.assert_allclose(
+        y, torch.from_numpy(x).sum(dim=1).numpy(), atol=ATOL, rtol=RTOL)
+    m = _forward(lambda ff: ff.mean(
+        ff.create_tensor((4, 6, 5), name="x"), dims=[2]), {"x": x})[1]
+    np.testing.assert_allclose(
+        m, torch.from_numpy(x).mean(dim=2).numpy(), atol=ATOL, rtol=RTOL)
+
+
+@pytest.mark.parametrize("op,torch_fn", [
+    ("max", torch.maximum),
+    ("min", torch.minimum),
+])
+def test_align_binary_max_min(op, torch_fn):
+    a = _gen((5, 7), 25)
+    b = _gen((5, 7), 26)
+
+    def build(ff):
+        ta = ff.create_tensor((5, 7), name="a")
+        tb = ff.create_tensor((5, 7), name="b")
+        return getattr(ff, op)(ta, tb)
+
+    y = _forward(build, {"a": a, "b": b})[1]
+    ref = torch_fn(torch.from_numpy(a), torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(y, ref, atol=ATOL, rtol=RTOL)
+
+
+def test_align_gather():
+    """torch.gather semantics along dim=1."""
+    x = _gen((4, 6), 27)
+    idx = np.random.default_rng(28).integers(
+        0, 6, size=(4, 3)).astype(np.int32)
+
+    def build(ff):
+        tx = ff.create_tensor((4, 6), name="x")
+        ti = ff.create_tensor((4, 3), name="i", dtype="int32")
+        return ff.gather(tx, ti, dim=1)
+
+    y = _forward(build, {"x": x, "i": idx})[1]
+    ref = torch.gather(torch.from_numpy(x), 1,
+                       torch.from_numpy(idx.astype(np.int64))).numpy()
+    np.testing.assert_allclose(y, ref, atol=ATOL, rtol=RTOL)
+
+
+def test_align_view_embedding():
+    """The reference's view_embedding case: ids reshaped through a view
+    before the table lookup."""
+    vocab, dim = 30, 8
+    ids = np.random.default_rng(29).integers(
+        0, vocab, size=(4, 5)).astype(np.int32)
+    table = _gen((vocab, dim), 30)
+
+    def build(ff):
+        ti = ff.create_tensor((4, 5), name="ids", dtype="int32")
+        flat = ff.reshape(ti, (20,))
+        e = ff.embedding(flat, vocab, dim)
+        return ff.reshape(e, (4, 5 * dim))
+
+    ff, y = _forward(build, {"ids": ids})
+    emb_layer = [l for l in ff.layers
+                 if l.op_type.name == "OP_EMBEDDING"][0]
+    ff.set_weights(emb_layer.name, "kernel", table)
+    y = np.asarray(ff.executor.make_forward()(
+        ff.params, ff.state, {"ids": ids}))
+    ref = torch.nn.functional.embedding(
+        torch.from_numpy(ids.astype(np.int64)).reshape(-1),
+        torch.from_numpy(table)).reshape(4, 5 * dim).numpy()
+    np.testing.assert_allclose(y, ref, atol=ATOL, rtol=RTOL)
+
+
+def test_align_getitem_slice():
+    """The reference's getitem case: static slicing via split."""
+    x = _gen((4, 10), 31)
+
+    def build(ff):
+        tx = ff.create_tensor((4, 10), name="x")
+        parts = ff.split(tx, [3, 7], axis=1)
+        return parts[0]
+
+    y = _forward(build, {"x": x})[1]
+    np.testing.assert_allclose(y, x[:, :3], atol=ATOL, rtol=RTOL)

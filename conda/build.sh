@@ -4,5 +4,5 @@
 # this lazily at first use; building here front-loads it).
 set -euo pipefail
 $PYTHON -m pip install . --no-deps --no-build-isolation -vv
-make -C native || echo "native build skipped (no toolchain); the ctypes \
-layer falls back to pure Python"
+make -C $SP_DIR/flexflow_tpu/native || echo "native build skipped (no toolchain); lazy ensure_built() \
+or the pure-Python fallback covers it at first use"

@@ -11,7 +11,7 @@ The target machine is described by ``machine_configs/v5e-32.json`` (4x8
 ICI torus, 8 hosts) — the analog of the reference's
 ``--machine-model-file`` (``machine_config_example``) — and strategies
 are scored by the native link-level task-graph simulator (machine model
-v1, ``search/tasksim.py`` + ``native/src/ffruntime.cc``), the analog of
+v1, ``search/tasksim.py`` + ``flexflow_tpu/native/src/ffruntime.cc``), the analog of
 ``Simulator::simulate_runtime`` (``src/runtime/simulator.cc``). No
 multi-chip hardware is needed: a 32-virtual-device CPU mesh stands in
 for the pod (same mechanism as ``tests/conftest.py``), exactly how the

@@ -2,7 +2,7 @@
 
 The reference implements its runtime core in C++ (simulator, dataloader,
 graph machinery — SURVEY.md §2.1/§2.3); this package is the TPU rebuild's
-native layer: ``native/src/ffruntime.cc`` compiled to ``libffruntime.so``.
+native layer: ``flexflow_tpu/native/src/ffruntime.cc`` compiled to ``libffruntime.so``.
 
 ``ensure_built()`` compiles the library on first use (g++, no external
 deps); every entry point has a pure-Python fallback so the framework works
@@ -19,9 +19,10 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_REPO = os.path.dirname(os.path.dirname(_HERE))
 _SO = os.path.join(_HERE, "libffruntime.so")
-_SRC = os.path.join(_REPO, "native", "src", "ffruntime.cc")
+# the C++ source ships INSIDE the package (package-data), so a
+# pip-installed copy can rebuild the library on any host with g++
+_SRC = os.path.join(_HERE, "src", "ffruntime.cc")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -288,7 +289,7 @@ class TaskBuffer:
     def collective(self, route_off, route_procs, route_fac, rounds: int,
                    per_round_secs: float, n_seg: int, deps) -> list:
         """Ring-collective expansion (see ffb_collective in
-        native/src/ffruntime.cc for the dependency structure). Returns
+        src/ffruntime.cc for the dependency structure). Returns
         the final task id of each participant that produced tasks."""
         n_routes = len(route_off) - 1
         if n_routes <= 0 or rounds <= 0:

@@ -247,7 +247,7 @@ class Graph:
                 if e not in self.in_edges[e.dst]:
                     errs.append(f"dangling edge {e}")
         # acyclicity via the native reachability closure when built
-        # (bitset transitive closure, native/src/ffruntime.cc)
+        # (bitset transitive closure, flexflow_tpu/native/src/ffruntime.cc)
         try:
             from .. import native
             nodes = self.nodes

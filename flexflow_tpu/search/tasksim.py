@@ -6,7 +6,7 @@ Analog of the reference's full-graph simulation path
 per-shard forward/backward compute tasks and per-device communication tasks
 (links modeled as extra processors, exactly like the reference models
 inter-device connections as schedulable devices), then play the DAG through
-the native event-driven simulator (``native/src/ffruntime.cc``). This
+the native event-driven simulator (``flexflow_tpu/native/src/ffruntime.cc``). This
 captures queueing and compute/comm overlap that the additive
 ``GraphCostEvaluator`` cannot; it is selected with
 ``machine_model_version >= 1`` (the reference's ``--machine-model-version``).

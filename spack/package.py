@@ -11,16 +11,20 @@ class PyFlexflowTpu(PythonPackage):
     hot paths)."""
 
     homepage = "https://github.com/flexflow/flexflow-tpu"
-    # dev-build from a local checkout; no release tarball yet
-    version("0.1.0")
+    git = "https://github.com/flexflow/flexflow-tpu.git"
+    # no release tarball yet: fetch from git main, or use
+    # `spack dev-build py-flexflow-tpu@0.1.0` from a local checkout
+    version("0.1.0", branch="main")
 
     depends_on("python@3.10:", type=("build", "run"))
     depends_on("py-setuptools@61:", type="build")
     depends_on("py-pip", type="build")
     depends_on("py-jax", type=("build", "run"))
     depends_on("py-numpy", type=("build", "run"))
-    # native runtime (libffruntime.so) builds lazily with the ambient
-    # C++ toolchain; gcc provides it under spack
+    # native runtime (libffruntime.so) builds lazily at first use from
+    # the C++ source shipped as package data
+    # (flexflow_tpu/native/src/ffruntime.cc); gcc provides the
+    # toolchain under spack
     depends_on("gcc@9:", type="run")
 
     @property

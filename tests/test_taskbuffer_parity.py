@@ -1,7 +1,7 @@
 """Native TaskBuffer == Python fallback parity (VERDICT r4 item 3).
 
 The search's task-graph expansion moved into C++
-(``native/src/ffruntime.cc::ffb_*``; 309.7 s -> ~27 s on the BERT-large
+(``flexflow_tpu/native/src/ffruntime.cc::ffb_*``; 309.7 s -> ~27 s on the BERT-large
 budget-8 north-star compile). These tests pin (a) that both backends
 produce identical task graphs and makespans, and (b) that the searched
 winner on the north-star machine is unchanged by the port.

@@ -87,19 +87,26 @@ class GraphProgram:
         # only its own members, concurrently (reference MachineView
         # placement, machine_view.h:14-62)
         bank_out: Dict[str, Any] = {}
-        banked_names = set()
-        if strategy is not None and getattr(strategy, "banks", None):
+        # name -> (group, emit_fn) for BOTH subset-placement kinds
+        # (stacked banks and heterogeneous place groups): member layers
+        # are emitted together at the first member's position
+        grouped: Dict[str, Tuple[Any, Any]] = {}
+        if strategy is not None:
             present = {l.name for l in layers}
-            for bk in strategy.banks:
+            for bk in getattr(strategy, "banks", None) or ():
                 if set(bk.members) <= present:
-                    banked_names |= set(bk.members)
+                    for m in bk.members:
+                        grouped[m] = (bk, self._emit_bank)
+            for pg in getattr(strategy, "place_groups", None) or ():
+                if set(pg.members) <= present:
+                    for m in pg.members:
+                        grouped[m] = (pg, self._emit_place_group)
         for layer in layers:
-            if layer.name in banked_names:
+            if layer.name in grouped:
                 if layer.name not in bank_out:
-                    bk = next(b for b in strategy.banks
-                              if layer.name in b.members)
-                    self._emit_bank(bk, layers, env, params, ctx,
-                                    strategy, bank_out)
+                    grp, emit_fn = grouped[layer.name]
+                    emit_fn(grp, layers, env, params, ctx, strategy,
+                            bank_out)
                 o = bank_out[layer.name]
                 if bf16_act and hasattr(o, "dtype") \
                         and o.dtype == jnp.float32:
@@ -187,6 +194,85 @@ class GraphProgram:
             out, NamedSharding(mesh, out_sp))
         for k, m in enumerate(members):
             bank_out[m.name] = out[k]
+
+    def _emit_place_group(self, pg, layers, env, params, ctx,
+                          strategy: ShardingStrategy,
+                          bank_out: Dict[str, Any]) -> None:
+        """Emit one heterogeneous placement region (PlaceGroup): a
+        shard_map over the place axis whose body ``lax.switch``es on
+        the member block coordinate — each device EXECUTES only its
+        member's op (MPMD-inside-SPMD), so mixed-type independent ops
+        run concurrently on disjoint subsets; outputs rejoin by an
+        exact masked psum (only the first coordinate of each owning
+        block contributes). Weights stay replicated — for distributed
+        weights use a (padded) bank; this region is the
+        compute-placement half of the reference's arbitrary MachineView
+        (machine_view.h:14-62)."""
+        from jax.sharding import PartitionSpec as P
+        by_name = {l.name: l for l in layers}
+        members = [by_name[n] for n in pg.members]
+        mesh = strategy.dmesh.mesh
+        axis = pg.axis
+        P_ = strategy.dmesh.axis_sizes[axis]
+        K = len(members)
+        assert P_ % K == 0, \
+            f"place axis {axis} size {P_} must divide into {K} members"
+        per = P_ // K
+        for m in members:
+            assert len(m.inputs) == 1 and len(m.outputs) == 1, \
+                f"place-group member {m.name} must be 1-in/1-out"
+            assert not _needs_rng(m), \
+                f"place-group member {m.name} uses rng (not supported)"
+        ops = [get_op_def(m.op_type) for m in members]
+        for m, op in zip(members, ops):
+            ss = getattr(op, "state_spec", None)
+            assert ss is None or not ss(
+                m.params, [t.shape for t in m.inputs],
+                [t.dtype for t in m.inputs]), \
+                f"stateful op {m.name} cannot join a place group"
+        xs = [env[m.inputs[0].guid] for m in members]
+        ws = [params.get(m.name, {}) for m in members]
+        out_sds = [jax.eval_shape(
+            lambda x, w, i=i: ops[i].emit(members[i].params, [x], w,
+                                          ctx, members[i].name)[0],
+            xs[i], ws[i]) for i in range(K)]
+
+        def body(xs_l, ws_l):
+            k = jax.lax.axis_index(axis)
+            owner = k // per
+            first = (k % per) == 0
+
+            def branch(i):
+                def go(_):
+                    out = ops[i].emit(members[i].params, [xs_l[i]],
+                                      ws_l[i], ctx, members[i].name)[0]
+                    outs = [jnp.zeros(s.shape, s.dtype)
+                            for s in out_sds]
+                    # zeros_like keeps integer/bool outputs in their
+                    # own dtype (a weak-float 0.0 would promote and
+                    # desync the branch signatures)
+                    outs[i] = jnp.where(first, out, jnp.zeros_like(out))
+                    return tuple(outs)
+                return go
+
+            outs = jax.lax.switch(owner, [branch(i) for i in range(K)],
+                                  None)
+            return tuple(jax.lax.psum(o, axis) for o in outs)
+
+        # replicated in/out specs: shard_map's transpose of replicated
+        # operands yields EXACT gradients even on meshes with extra
+        # (non-place) axes — pinned by
+        # tests/test_place_groups.py::test_place_group_grads_exact
+        region = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(tuple(P() for _ in xs),
+                      tuple(jax.tree.map(lambda _: P(), w)
+                            for w in ws)),
+            out_specs=tuple(P() for _ in range(K)),
+            check_vma=False)
+        outs = region(tuple(xs), tuple(ws))
+        for m, o in zip(members, outs):
+            bank_out[m.name] = o
 
     def emit(self, params: Dict[str, Dict[str, Any]], inputs: Dict[str, Any],
              ctx: EmitCtx, strategy: Optional[ShardingStrategy] = None,

@@ -53,6 +53,16 @@ class MachineView:
                      for i in range(self.num_parts))
 
 
+def _ids_to_view(ids: "np.ndarray") -> MachineView:
+    """Compress a sorted flat-id array to start/num/stride when it is
+    an arithmetic progression, else keep the exact ids."""
+    stride = int(ids[1] - ids[0]) if len(ids) > 1 else 1
+    if len(ids) > 2 and not np.all(np.diff(ids) == stride):
+        return MachineView(int(ids[0]), len(ids), 1,
+                           explicit_ids=tuple(int(i) for i in ids))
+    return MachineView(int(ids[0]), len(ids), stride)
+
+
 @dataclasses.dataclass
 class BankSpec:
     """K independent ops placed on disjoint device subsets. ``members``
@@ -107,14 +117,7 @@ class BankSpec:
         per = len(self.members) // B
         for k, m in enumerate(self.members):
             ids = np.sort(grid[coord == (k // per)].ravel())
-            stride = int(ids[1] - ids[0]) if len(ids) > 1 else 1
-            if len(ids) > 2 and not np.all(np.diff(ids) == stride):
-                # not an arithmetic progression: keep the exact ids
-                out[m] = MachineView(int(ids[0]), len(ids), 1,
-                                     explicit_ids=tuple(int(i)
-                                                        for i in ids))
-            else:
-                out[m] = MachineView(int(ids[0]), len(ids), stride)
+            out[m] = _ids_to_view(ids)
         return out
 
 
@@ -181,6 +184,42 @@ def group_is_padded(group: Sequence) -> bool:
     """True when the group's members differ in exact signature (weight
     shapes) and need pad-stacking."""
     return len({_signature(l) for l in group}) > 1
+
+
+@dataclasses.dataclass
+class PlaceGroup:
+    """K mutually-independent ops of ARBITRARY (mixed) types, each
+    placed on its own contiguous block of the ``axis`` coordinates —
+    member k owns coords [k*P/K, (k+1)*P/K). The executor lowers the
+    group as one shard_map region that ``lax.switch``es on the block
+    coordinate, so each device EXECUTES only its member's branch
+    (MPMD-inside-SPMD) and the members run concurrently; outputs rejoin
+    by an exact masked psum over the axis.
+
+    Complements :class:`BankSpec`: banks distribute both compute AND
+    weights for signature-family groups (stacking); a PlaceGroup
+    handles heterogeneous op types, trading replicated weights for
+    generality — the compute-placement half of the reference's
+    arbitrary per-op MachineView (machine_view.h:14-62)."""
+    members: List[str]
+    axis: str
+
+    def machine_views(self, dmesh) -> Dict[str, MachineView]:
+        names = list(dmesh.axis_sizes.keys())
+        sizes = [dmesh.axis_sizes[a] for a in names]
+        P_ = dmesh.axis_sizes[self.axis]
+        K = len(self.members)
+        assert P_ % K == 0, (self.axis, P_, K)
+        grid = np.arange(int(np.prod(sizes))).reshape(sizes)
+        ax = names.index(self.axis)
+        coord = np.indices(grid.shape)[ax]
+        out: Dict[str, MachineView] = {}
+        per = P_ // K
+        for k, m in enumerate(self.members):
+            ids = np.sort(grid[(coord >= k * per)
+                               & (coord < (k + 1) * per)].ravel())
+            out[m] = _ids_to_view(ids)
+        return out
 
 
 def choose_bank_axes(dmesh, k_members: int,

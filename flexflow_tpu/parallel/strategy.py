@@ -60,6 +60,10 @@ class ShardingStrategy:
         # BankSpec list) — the reference's MachineView concept
         # (machine_view.h:14-62); member ops run on disjoint subsets
         self.banks: List = []
+        # heterogeneous-op placement regions (parallel/banks.py
+        # PlaceGroup list): mixed op types on disjoint axis blocks,
+        # lowered as a lax.switch shard_map region (MPMD-inside-SPMD)
+        self.place_groups: List = []
 
     # ------------------------------------------------------------------
     def set_op(self, layer_name: str, outputs: Sequence[Optional[P]],

@@ -53,6 +53,14 @@ def save_strategy(path: str, strategy: ShardingStrategy,
     banks_doc = banks_to_json(strategy)
     if banks_doc:
         doc["banks"] = banks_doc
+    pgs = getattr(strategy, "place_groups", None) or []
+    if pgs:
+        doc["place_groups"] = [
+            {"members": list(g.members), "axis": g.axis,
+             "machine_views": {
+                 m: dataclasses.asdict(v)
+                 for m, v in g.machine_views(strategy.dmesh).items()}}
+            for g in pgs]
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
 
@@ -431,4 +439,8 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
                              param_name=b.get("param_name", "__bank__"),
                              padded=bool(b.get("padded", False)))
                     for b in doc["banks"]]
+    if doc.get("place_groups"):
+        from ..parallel.banks import PlaceGroup
+        st.place_groups = [PlaceGroup(list(g["members"]), g["axis"])
+                           for g in doc["place_groups"]]
     return st

@@ -28,7 +28,11 @@ case "${1:-fast}" in
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
     ;;
   ab)
-    python examples/osdi22ae/run_all.py
+    # the osdi22ae A/B methodology runs on the CPU-sim mesh: an ambient
+    # TPU platform (JAX_PLATFORMS=axon on tunnel hosts) must not leak
+    # into the sweep's subprocesses — a dead tunnel would burn the full
+    # per-model timeout 9 times over
+    JAX_PLATFORMS=cpu python examples/osdi22ae/run_all.py
     ;;
   bench)
     python bench.py

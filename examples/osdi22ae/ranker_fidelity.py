@@ -149,7 +149,9 @@ def main() -> int:
             out["spearman"][ranker] = round(
                 _spearman([p for p, _ in pairs], [m for _, m in pairs]), 4)
             out["n_" + ranker] = len(pairs)
-    path = os.path.join(REPO, "bench_results", "r04_ranker_fidelity.json")
+    path = os.environ.get(
+        "FF_FIDELITY_OUT",
+        os.path.join(REPO, "bench_results", "cpu_ranker_fidelity.json"))
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out["spearman"]))

@@ -64,7 +64,7 @@ def main():
         t0 = time.time()
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,
-                               timeout=1800, cwd=EXAMPLES)
+                               timeout=3600, cwd=EXAMPLES)
             out = r.stdout
             entry = {"rc": r.returncode,
                      "wall_s": round(time.time() - t0, 1)}

@@ -2,7 +2,7 @@
 generation. The KV path's per-token cost must be independent of how
 many tokens have been generated; the re-forward oracle is O(context)
 per token. Writes one JSON record per (path, new_tokens) plus a
-summary to bench_results/r03_decode_scaling.json.
+summary to bench_results/decode_scaling.json.
 
   python examples/decode_bench.py [--seq 256] [--layers 4]
 """
@@ -27,7 +27,7 @@ def main():
     ap.add_argument("--hidden", type=int, default=128)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--out", default=os.path.join(
-        REPO, "bench_results", "r03_decode_scaling.json"))
+        REPO, "bench_results", "decode_scaling.json"))
     a = ap.parse_args()
 
     g = GPTConfig(vocab_size=512, hidden_size=a.hidden,

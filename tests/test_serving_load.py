@@ -123,7 +123,7 @@ def test_metrics_and_unload_endpoints():
 def test_concurrent_load_p50_p99_artifact():
     """Sustained concurrent load through the HTTP stack; writes the
     p50/p99 artifact the judge asked for
-    (bench_results/r04_serving_load.json)."""
+    (bench_results/serving_load_http.json)."""
     import time
     repo = ModelRepository()
     repo.register("mlp", _mlp_session(buckets=(1, 4, 16, 64)),
@@ -179,7 +179,7 @@ def test_concurrent_load_p50_p99_artifact():
             "server_metrics": m,
         }
         with open(os.path.join(REPO, "bench_results",
-                               "r04_serving_load.json"), "w") as f:
+                               "serving_load_http.json"), "w") as f:
             json.dump(rec, f, indent=1)
         # sanity: batching must actually aggregate under load
         assert m["mean_batch_rows"] > 2.0, m

@@ -215,6 +215,150 @@ def stage_bert(flash: str, searched: bool, budget: int, steps: int,
            "generation": spec.generation})
 
 
+def stage_virtual(budget: int, steps: int):
+    """Searched-vs-DP A/B + ranker fidelity on an 8-virtual-device CPU
+    mesh (parent sets ``--xla_force_host_platform_device_count=8`` and
+    ``FF_CALIBRATION_V2=1``).
+
+    The headline bench runs on however many devices the platform
+    exposes — 1 on the CPU fallback, where a search win is unobservable
+    (VERDICT r5 weak #3). This leg makes the searched-vs-DP ratio and
+    the ranker fidelity driver-visible regardless of hardware:
+
+      - ``virtual_searched_vs_dp``: measured searched/DP throughput
+        ratio (task-sim ranker's adoption) on the DLRM workload — the
+        attribute-parallel case the search is supposed to win;
+      - ``fidelity_spearman``: rank correlation of predicted vs
+        MEASURED searched/DP ratios over (workload x ranker) rows,
+        where each ranker's OWN adopted strategy is the one measured —
+        closing the r05 methodology caveat that additive-ranker
+        predictions described programs never run
+        (examples/osdi22ae/ranker_fidelity.py docstring).
+    """
+    _apply_platform_env()
+    os.environ.setdefault("FF_CALIBRATION_V2", "1")
+    import numpy as np
+    import jax
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import (CandleConfig, DLRMConfig, XDLConfig,
+                                     build_candle_uno, build_dlrm,
+                                     build_mlp, build_xdl)
+    from flexflow_tpu.search.optimizer import _synth_batch
+    sys.path.insert(0, os.path.join(HERE, "examples"))
+    from _stats import spearman
+
+    n = len(jax.devices())
+
+    # embedding tables big enough (4 x 20000 x 64 = 20 MB) that pure DP
+    # pays a real gradient all-reduce every step — the attribute-
+    # parallel win the search must find, large enough to clear the
+    # host-timing noise floor
+    dlrm_cfg = DLRMConfig(embedding_size=(20000,) * 4,
+                          sparse_feature_size=64,
+                          mlp_bot=(4, 64, 64), mlp_top=(64, 32, 2))
+    xdl_cfg = XDLConfig(embedding_size=(20000,) * 4,
+                        sparse_feature_size=64, mlp=(128, 64, 2))
+    candle_cfg = CandleConfig(
+        dense_layers=(64, 64), dense_feature_layers=(64, 64),
+        feature_shapes={"dose": 1, "cell.rnaseq": 128,
+                        "drug.descriptors": 256,
+                        "drug.fingerprints": 128})
+    workloads = [
+        ("mlp", "sparse_categorical_crossentropy",
+         lambda ff: build_mlp(ff, 32, in_dim=64, hidden=(128, 128),
+                              num_classes=10)),
+        ("dlrm", "sparse_categorical_crossentropy",
+         lambda ff: build_dlrm(ff, 32, dlrm_cfg)),
+        ("xdl", "sparse_categorical_crossentropy",
+         lambda ff: build_xdl(ff, 32, xdl_cfg)),
+        ("candle_uno", "mse",
+         lambda ff: build_candle_uno(ff, 16, candle_cfg)),
+    ]
+
+    def compile_one(loss, builder, searched, ranker=None):
+        if ranker is not None:
+            os.environ["FF_FINAL_RANKER"] = ranker
+        cfg = FFConfig()
+        cfg.only_data_parallel = not searched
+        if searched:
+            cfg.search_budget = max(budget, 8)
+            cfg.search_floor_guard = "false"   # score the ADOPTION
+        ff = FFModel(cfg)
+        out_t = builder(ff)
+        ff.compile(SGDOptimizer(0.01), loss, [], output_tensor=out_t)
+        return ff
+
+    def time_one(ff):
+        """MIN of per-step (synced) wall times: host-load noise is
+        one-sided (contention only ever adds time), so the minimum over
+        N steps estimates the true step cost far more stably than a
+        mean or median on a loaded 2-core host, where individual 10 ms
+        steps stall by multiples."""
+        batch = _synth_batch(ff)
+        step = ff.executor.make_train_step()
+        for _ in range(3):
+            bm = ff._run_train_step(step, batch)
+        _sync_fetch(bm["loss"])
+        ts = []
+        for _ in range(max(steps, 2)):
+            t0 = time.perf_counter()
+            bm = ff._run_train_step(step, batch)
+            _sync_fetch(bm["loss"])
+            ts.append(time.perf_counter() - t0)
+        return float(min(ts))
+
+    rows = []
+    dlrm_ratio = None
+    for name, loss, builder in workloads:
+        try:
+            ff_dp = compile_one(loss, builder, searched=False)
+            t_dp = time_one(ff_dp)
+        except Exception as e:  # noqa: BLE001 — drop workload, keep leg
+            rows.append({"workload": name, "error": repr(e)[:200]})
+            continue
+        wrows = []
+        for ranker in ("tasksim", "additive"):
+            try:
+                ff = compile_one(loss, builder, searched=True,
+                                 ranker=ranker)
+                pred = getattr(ff, "_search_predicted", None)
+                ratio_pred = (pred["dp_cost_s"]
+                              / max(pred["searched_cost_s"], 1e-12)
+                              if pred else None)
+                t_s = time_one(ff)
+                wrows.append(({"workload": name, "ranker": ranker,
+                               "predicted": round(ratio_pred, 4)
+                               if ratio_pred else None}, t_s))
+            except Exception as e:  # noqa: BLE001
+                rows.append({"workload": name, "ranker": ranker,
+                             "error": repr(e)[:200]})
+        # second DP timing round AFTER the searched legs: both legs'
+        # minima then bracket the same stretch of host load, so a
+        # transient stall during the single DP phase cannot skew every
+        # ratio of this workload
+        try:
+            t_dp = min(t_dp, time_one(ff_dp))
+        except Exception:  # noqa: BLE001
+            pass
+        for row, t_s in wrows:
+            row["measured"] = round(t_dp / t_s, 4)
+            rows.append(row)
+            if name == "dlrm" and row["ranker"] == "tasksim":
+                dlrm_ratio = row["measured"]
+
+    scored = [r for r in rows
+              if r.get("predicted") is not None
+              and r.get("measured") is not None]
+    fid = spearman([r["predicted"] for r in scored],
+                   [r["measured"] for r in scored]) \
+        if len(scored) >= 3 else None
+    _emit({"n": n,
+           "virtual_searched_vs_dp": dlrm_ratio,
+           "fidelity_spearman": round(fid, 4) if fid is not None else None,
+           "fidelity_rows": len(scored),
+           "rows": rows})
+
+
 # ======================================================================
 # parent orchestration
 # ======================================================================
@@ -398,6 +542,27 @@ def main():
         else:
             errors.append(f"bert(searched): {err}")
 
+    # -- stage 5.3: virtual-mesh searched-vs-DP + ranker fidelity -----
+    # platform-independent (forces an 8-virtual-device CPU mesh), so
+    # the driver-visible metric carries a searched-vs-DP ratio and a
+    # measured-own-adoption fidelity number even when the TPU tunnel
+    # never opens (the r03-r05 state)
+    if remaining() > 180:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            xf = (xf + " --xla_force_host_platform_device_count=8").strip()
+        venv = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": xf,
+                "FF_CALIBRATION_V2": "1"}
+        virt, err = stage(["--stage", "virtual", "--budget", "8",
+                           "--steps", "10"], 420, venv)
+        if virt is not None:
+            out["virtual_searched_vs_dp"] = virt["virtual_searched_vs_dp"]
+            out["virtual_fidelity_spearman"] = virt["fidelity_spearman"]
+            out["virtual_fidelity_rows"] = virt["fidelity_rows"]
+            out["virtual_n_devices"] = virt["n"]
+        else:
+            errors.append(f"virtual: {err}")
+
     # -- stage 5.5: flash-off point on the recovered platform ---------
     if out.get("reprobe") == "recovered" and remaining() > 420:
         foff, err = stage(bert_args + ["--flash", "false"], 420, env)
@@ -495,5 +660,7 @@ if __name__ == "__main__":
         stage_smoke()
     elif a.stage == "bert":
         stage_bert(a.flash, a.searched, a.budget, a.steps, a.batch, a.seq)
+    elif a.stage == "virtual":
+        stage_virtual(a.budget, a.steps)
     else:
         raise SystemExit(f"unknown stage {a.stage!r}")

@@ -42,10 +42,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# `python examples/northstar_bert_large.py` puts examples/ (not the
+# repo root) on sys.path; make the import work without an installed
+# package or PYTHONPATH (same idiom as tpu_fidelity.py)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
 from flexflow_tpu import FFConfig, FFModel, SGDOptimizer  # noqa: E402
 from flexflow_tpu.models import BertConfig, build_bert  # noqa: E402
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main():

@@ -55,6 +55,10 @@ class FFConfig:
     machine_model_file: str = ""
     simulator_segment_size: int = 16777216
     simulator_max_num_segments: int = 1
+    # measurement-grounded cost-model calibration v2 (host dispatch/
+    # memory-bandwidth/parallel-efficiency terms + persisted collective
+    # tables, search/calibration.py). "auto" honors FF_CALIBRATION_V2.
+    calibration_v2: str = "auto"  # "auto" | "true" | "false"
     # -------- execution --------
     perform_fusion: bool = False
     allow_tensor_op_math_conversion: bool = True   # = allow bf16 matmul accum
@@ -228,6 +232,8 @@ class FFConfig:
                 cfg.simulator_segment_size = int(take())
             elif a == "--simulator-max-num-segments":
                 cfg.simulator_max_num_segments = int(take())
+            elif a == "--calibration-v2":
+                cfg.calibration_v2 = take().lower()
             elif a == "--fusion":
                 cfg.perform_fusion = True
             elif a == "--profiling":

@@ -37,6 +37,7 @@ from .runtime import losses as losses_mod
 from .runtime import metrics as metrics_mod
 from .runtime.initializers import initialize, initialize_host  # noqa: F401
 from .runtime.optimizers import Optimizer
+from .utils.jax_compat import shard_map
 
 
 def _npdt(dtype) -> "np.dtype":
@@ -263,7 +264,7 @@ class GraphProgram:
         # operands yields EXACT gradients even on meshes with extra
         # (non-place) axes — pinned by
         # tests/test_place_groups.py::test_place_group_grads_exact
-        region = jax.shard_map(
+        region = shard_map(
             body, mesh=mesh,
             in_specs=(tuple(P() for _ in xs),
                       tuple(jax.tree.map(lambda _: P(), w)
@@ -765,7 +766,7 @@ class Executor:
         hid_spec = P(dp, *([None] * (hidden_example.ndim - 1)))
         out_spec = P(dp, *([None] * (out_example.ndim - 1)))
         ys_spec = P(None, dp, *([None] * (out_example.ndim - 1)))
-        fn = jax.shard_map(
+        fn = shard_map(
             engine, mesh=self.dmesh.mesh,
             in_specs=(param_specs, pro_specs, epi_specs, raw_specs,
                       hid_spec, out_spec),
@@ -875,7 +876,7 @@ class Executor:
         dp = pipe.dp_axes if pipe.dp_axes else None
         dp = dp[0] if dp is not None and len(dp) == 1 else dp
         xs_spec = P(None, dp, *([None] * (xs.ndim - 2)))
-        fn = jax.shard_map(engine, mesh=self.dmesh.mesh,
+        fn = shard_map(engine, mesh=self.dmesh.mesh,
                            in_specs=(param_specs, xs_spec),
                            out_specs=xs_spec, check_vma=False)
         ys = fn(stacked, xs)

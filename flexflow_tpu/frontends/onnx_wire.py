@@ -43,17 +43,28 @@ _DT_OF = {np.dtype(v): k for k, v in _NP_OF.items()}
 # ----------------------------------------------------------------------
 def _rvarint(buf: bytes, pos: int) -> Tuple[int, int]:
     out = shift = 0
+    n = len(buf)
     while True:
+        if pos >= n:
+            raise ValueError(
+                "truncated/unsupported ONNX: varint runs past end of "
+                f"buffer (offset {pos} of {n})")
         b = buf[pos]
         pos += 1
         out |= (b & 0x7F) << shift
         if not b & 0x80:
             return out, pos
         shift += 7
+        if shift > 63:
+            raise ValueError(
+                "truncated/unsupported ONNX: varint longer than 64 bits")
 
 
 def _fields(buf: bytes):
-    """Yield (field_number, wire_type, value) over one message."""
+    """Yield (field_number, wire_type, value) over one message. Every
+    read is bounds-checked against ``len(buf)`` so truncated or
+    garbage input raises ``ValueError`` instead of silently decoding
+    short slices into wrong tensors."""
     pos = 0
     n = len(buf)
     while pos < n:
@@ -62,13 +73,26 @@ def _fields(buf: bytes):
         if wt == 0:                      # varint
             v, pos = _rvarint(buf, pos)
         elif wt == 1:                    # fixed64
+            if pos + 8 > n:
+                raise ValueError(
+                    "truncated/unsupported ONNX: fixed64 field "
+                    f"{field} runs past end of buffer")
             v = buf[pos:pos + 8]
             pos += 8
         elif wt == 2:                    # length-delimited
             ln, pos = _rvarint(buf, pos)
+            if ln < 0 or pos + ln > n:
+                raise ValueError(
+                    "truncated/unsupported ONNX: length-delimited "
+                    f"field {field} claims {ln} bytes, "
+                    f"{n - pos} remain")
             v = buf[pos:pos + ln]
             pos += ln
         elif wt == 5:                    # fixed32
+            if pos + 4 > n:
+                raise ValueError(
+                    "truncated/unsupported ONNX: fixed32 field "
+                    f"{field} runs past end of buffer")
             v = buf[pos:pos + 4]
             pos += 4
         else:
@@ -230,9 +254,26 @@ def load_model(data: bytes):
     return m
 
 
+# TensorProto.DataType ids the codec knows about but cannot decode to a
+# numpy array (no stable numpy dtype): name them in the error instead of
+# a bare KeyError
+_UNSUPPORTED_DT = {8: "string", 14: "complex64", 15: "complex128",
+                   16: "bfloat16", 17: "float8e4m3fn", 18: "float8e4m3fnuz",
+                   19: "float8e5m2", 20: "float8e5m2fnuz", 21: "uint4",
+                   22: "int4", 23: "float4e2m1"}
+
+
 def to_array(t) -> np.ndarray:
     """``onnx.numpy_helper.to_array`` for decoded TensorProtos."""
     dtype_id = int(t.data_type)
+    if dtype_id not in _NP_OF:
+        name = _UNSUPPORTED_DT.get(dtype_id, f"data_type={dtype_id}")
+        raise ValueError(
+            f"truncated/unsupported ONNX: tensor {t.name!r} has "
+            f"unsupported dtype {name} (data_type={dtype_id}); "
+            "bfloat16/float8 initializers are not decodable without the "
+            "onnx package — re-export the model with float32/float16 "
+            "weights")
     dt = np.dtype(_NP_OF[dtype_id])
     shape = tuple(int(d) for d in t.dims)
     n = 1

@@ -95,14 +95,19 @@ class MachineSpec:
         None. Memoized per spec: the topology carries route/distance
         caches that must persist across the search's thousands of
         task-graph builds (rebuilding it per build cost ~35 s of Dijkstra
-        on the 64-device two-slice north-star)."""
+        on the 64-device two-slice north-star). The memo is keyed on
+        every field the fabric derives from, so mutating the spec after
+        construction (dataclass fields are writable) invalidates it
+        instead of silently pinning the stale fabric into search costs."""
         if self.topology_override is not None:
             return self.topology_override
         if self.ici_shape is None:
             return None
+        key = (tuple(self.ici_shape), self.num_slices, self.num_hosts,
+               self.ici_bandwidth, self.dcn_bandwidth)
         cached = self.__dict__.get("_topology_cache")
-        if cached is not None:
-            return cached
+        if cached is not None and cached[0] == key:
+            return cached[1]
         if self.num_slices > 1:
             from .topology import GraphTopology
             topo = GraphTopology.multi_slice_torus(
@@ -113,7 +118,7 @@ class MachineSpec:
         else:
             from .topology import TorusTopology
             topo = TorusTopology(tuple(self.ici_shape))
-        object.__setattr__(self, "_topology_cache", topo)
+        object.__setattr__(self, "_topology_cache", (key, topo))
         return topo
 
     @classmethod

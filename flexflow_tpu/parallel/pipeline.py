@@ -24,8 +24,11 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.jax_compat import shard_map
 
 
 def _squeeze_stage(params):
@@ -187,7 +190,7 @@ class PipelinedBlocks:
         dp = self.dp_axis if self.dp_axis in self.mesh.axis_names else None
         xs_spec = P(None, dp, *([None] * (xs.ndim - 2)))
 
-        fn = jax.shard_map(
+        fn = shard_map(
             engine, mesh=self.mesh,
             in_specs=(in_param_spec, xs_spec),
             out_specs=xs_spec,

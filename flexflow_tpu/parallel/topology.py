@@ -32,6 +32,49 @@ _EPS = 1e-9
 
 Link = Tuple[int, int, int]  # (device, dim, direction ±1) — outgoing port
 
+# flat_ring_links cache bound: device tuples repeat thousands of times
+# per search, but a long-lived topology (MachineSpec memo) must not
+# accumulate routes without limit across searches
+_RING_ROUTE_CACHE_CAP = 4096
+
+
+def flat_ring_links(topo, devices: Tuple[int, ...]):
+    """Flattened ring-collective routes over ``devices``, cached on the
+    topology: ``(offsets, links, factors-or-None)`` where ``links`` is
+    the concatenated per-participant hop list and ``offsets[i]`` its
+    start. Only builder-independent data (raw link tuples, bandwidth
+    factors) is cached here — processor-id mapping is per consumer
+    (search/tasksim.py), so one shared topology can never serve another
+    builder's ids. The cache is bounded at ``_RING_ROUTE_CACHE_CAP``
+    entries (cleared wholesale when full; hot tuples repopulate).
+
+    A module function rather than a method so any duck-typed topology
+    (``MachineSpec.topology_override`` accepts arbitrary objects with
+    ``ring_links``/``link_index``) gets the same caching."""
+    cache = topo.__dict__.get("_ring_route_cache")
+    if cache is None:
+        cache = {}
+        topo.__dict__["_ring_route_cache"] = cache
+    hit = cache.get(devices)
+    if hit is None:
+        routes = topo.ring_links(list(devices))
+        factor = getattr(topo, "link_factor", None)
+        off = [0]
+        links: List[Link] = []
+        fac: Optional[List[float]] = [] if factor else None
+        for hops in routes:
+            for link in hops:
+                links.append(link)
+                if fac is not None:
+                    fac.append(float(factor(link)))
+            off.append(len(links))
+        if len(cache) >= _RING_ROUTE_CACHE_CAP:
+            cache.clear()
+        hit = (tuple(off), tuple(links),
+               tuple(fac) if fac is not None else None)
+        cache[devices] = hit
+    return hit
+
 
 @dataclasses.dataclass
 class TorusTopology:

@@ -79,6 +79,11 @@ class OpCostModel:
         # segment-wise across their route in tasksim.py.
         self.segment_size: int = 16777216
         self.max_segments: int = 1
+        # measurement-grounded calibration v2 (search/calibration.py):
+        # host dispatch overhead, memory bandwidth, parallel efficiency
+        # and per-collective tables measured on the live backend. None =
+        # analytic terms only (unchanged legacy behavior).
+        self.calib = None
         # on-device measurement (reference measure_operator_cost analog)
         self.measure_on_device = False
         self.measure_budget_s = 120.0   # total wall budget for microbenches
@@ -117,6 +122,16 @@ class OpCostModel:
             os.replace(tmp, self._disk_path)
         except Exception:
             pass
+
+    # ------------------------------------------------------------------
+    def attach_calibration(self, calib) -> None:
+        """Attach a ``calibration.MeshCalibration``: measured host
+        dispatch overhead + memory bandwidth + parallel efficiency enter
+        ``op_cost`` and the persisted collective tables take precedence
+        in ``xfer_cost``. Invalidates the in-memory op cache — costs
+        priced under the old terms must not survive."""
+        self.calib = calib
+        self.cache.clear()
 
     # ------------------------------------------------------------------
     def calibrate(self):
@@ -176,6 +191,8 @@ class OpCostModel:
         try:
             import jax.numpy as jnp
             from jax.sharding import PartitionSpec as P
+
+            from ..utils.jax_compat import shard_map
             mesh = dmesh.mesh
             axes = tuple(mesh.axis_names)
 
@@ -185,7 +202,7 @@ class OpCostModel:
 
                 @jax.jit
                 def f(x):
-                    return jax.shard_map(
+                    return shard_map(
                         lambda xl: jax.lax.psum(xl, axes), mesh=mesh,
                         in_specs=P(None), out_specs=P(None))(x)
 
@@ -379,8 +396,28 @@ class OpCostModel:
                       for w in layer.weights) // max(weight_shard_degree, 1)
         bytes_moved = in_bytes + out_bytes + w_bytes
         t_compute = flops / (self.spec.peak_flops * self.mxu_eff)
-        t_mem = bytes_moved / self.spec.hbm_bandwidth
-        fwd = max(t_compute, t_mem) + self.overhead_s
+        # calibration v2: measured memory bandwidth replaces the
+        # datasheet HBM constant; measured host dispatch overhead
+        # replaces the fixed 2us guess; measured parallel efficiency
+        # stretches per-shard time when concurrent shards oversubscribe
+        # the host (N virtual devices on C < N cores) — the host terms
+        # the r05 fidelity study showed the blind model lacks
+        mem_bw = self.spec.hbm_bandwidth
+        dispatch = self.overhead_s
+        par_eff = 1.0
+        if self.calib is not None:
+            if self.calib.mem_bw:
+                mem_bw = self.calib.mem_bw
+            if self.calib.dispatch_s:
+                dispatch = self.calib.dispatch_s
+            # SPMD executes EVERY op on every device simultaneously —
+            # replicated ops run N full copies, sharded ops N shards —
+            # so the whole mesh's concurrency applies regardless of the
+            # op's own shard degrees (a replicated op escaping the
+            # stretch would under-price replication vs sharding)
+            par_eff = self.calib.efficiency(max(self.spec.num_devices, 1))
+        t_mem = bytes_moved / mem_bw
+        fwd = max(t_compute, t_mem) / max(par_eff, 1e-6) + dispatch
         bwd = fwd * op.backward_flops_factor() \
             if layer.op_type != OperatorType.OP_INPUT else 0.0
         if (self.measure_on_device and flops >= self._MEASURE_MIN_FLOPS
@@ -409,7 +446,22 @@ class OpCostModel:
         standard hierarchical decomposition — intra-slice leg over ICI
         plus an inter-slice leg on the slice-reduced volume over DCN
         (reference analog: per-link-type simulation in
-        ``src/runtime/network.cc`` / ``simulator.h:381-499``)."""
+        ``src/runtime/network.cc`` / ``simulator.h:381-499``).
+
+        Calibration v2: a persisted measured table for this
+        (backend, collective, degree) answers first — real XLA
+        collective timings at import-time shapes interpolated across
+        shape classes; degrees never measured fall through to the
+        fitted/analytic ring model."""
+        floor = 0.0
+        if self.calib is not None:
+            kind = "all_to_all" if collective == "permute" else collective
+            t = self.calib.collective_time(kind, degree, volume_bytes)
+            if t is not None:
+                return float(t)
+            # even off-table, no collective is cheaper than one measured
+            # host dispatch — the floor the host-blind model lacked
+            floor = self.calib.dispatch_s or 0.0
         ici_bw = self.coll_bw or self.spec.ici_bandwidth
         ici_lat = self.coll_lat if self.coll_lat is not None \
             else self.spec.ici_latency_us * 1e-6
@@ -417,14 +469,18 @@ class OpCostModel:
         if self.spec.num_slices > 1 and degree > per_slice:
             d_in = math.gcd(degree, per_slice) or 1
             d_out = degree // d_in
-            return (self._ring_cost(volume_bytes, collective, d_in,
-                                    ici_bw, ici_lat)
-                    + self._ring_cost(volume_bytes / max(d_in, 1),
-                                      collective, d_out,
-                                      self.spec.dcn_bandwidth,
-                                      self.spec.dcn_latency_us * 1e-6))
-        return self._ring_cost(volume_bytes, collective, degree,
-                               ici_bw, ici_lat)
+            t = (self._ring_cost(volume_bytes, collective, d_in,
+                                 ici_bw, ici_lat)
+                 + self._ring_cost(volume_bytes / max(d_in, 1),
+                                   collective, d_out,
+                                   self.spec.dcn_bandwidth,
+                                   self.spec.dcn_latency_us * 1e-6))
+        else:
+            t = self._ring_cost(volume_bytes, collective, degree,
+                                ici_bw, ici_lat)
+        # zero-cost (elided) collectives stay free; everything real is
+        # floored at one measured host dispatch
+        return max(floor, t) if t > 0 else t
 
     @staticmethod
     def _ring_cost(volume_bytes: float, collective: str, degree: int,
@@ -459,5 +515,16 @@ class OpCostModel:
                               max(src_total, dst_total))
 
     def weight_sync_cost(self, weight_bytes: float, dp_degree: int) -> float:
-        """Per-step gradient all-reduce (reference NCCL optimizer path)."""
+        """Per-step gradient all-reduce (reference NCCL optimizer path).
+
+        Calibrated: priced at the measured curve's MARGINAL (per-byte)
+        cost — XLA's all-reduce combiner coalesces per-layer gradient
+        reductions into a few large collectives, so the fixed dispatch
+        floor is paid once per step, not once per op
+        (calibration.MeshCalibration.collective_marginal)."""
+        if self.calib is not None and dp_degree > 1 and weight_bytes > 0:
+            t = self.calib.collective_marginal("all_reduce", dp_degree,
+                                               weight_bytes)
+            if t is not None:
+                return float(t)
         return self.xfer_cost(weight_bytes, "all_reduce", dp_degree)

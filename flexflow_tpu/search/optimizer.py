@@ -54,6 +54,17 @@ def optimize_strategy(ff):
     # the host fabric would corrupt the simulation.
     if not cfg.machine_model_file:
         cost_model.calibrate_collectives(dmesh)
+        # calibration v2 (opt-in): measured host dispatch/memory-
+        # bandwidth/parallel-efficiency terms + persisted per-collective
+        # tables, reused across processes (search/calibration.py). Same
+        # exclusion as above: a described machine's constants are ground
+        # truth, so never overwrite them with live-host measurements.
+        from .calibration import calibrate_mesh, calibration_enabled
+        if calibration_enabled(cfg):
+            try:
+                cost_model.attach_calibration(calibrate_mesh(dmesh))
+            except Exception:  # noqa: BLE001 — calibration is best-effort
+                pass
     t0 = time.perf_counter()
     if cfg.search_algo == "unity":
         return _apply_floor_guard(

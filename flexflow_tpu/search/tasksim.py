@@ -66,6 +66,10 @@ class TaskGraphBuilder:
         self.link_idx = self.topo.link_index() if self.topo else None
         self.segment_size = getattr(cost, "segment_size", 16777216)
         self.max_segments = getattr(cost, "max_segments", 1)
+        # per-BUILDER processor-id arrays for ring routes; the raw link
+        # tuples underneath are cached on the (shared) topology object
+        # — see _flat_routes
+        self._route_procs: Dict[Tuple[int, ...], Tuple] = {}
 
     @property
     def num_procs(self) -> int:
@@ -107,31 +111,31 @@ class TaskGraphBuilder:
                "all_to_all": (lambda d: d - 1)}
 
     def _flat_routes(self, devices: Tuple[int, ...]):
-        """Flattened ring routes for one participant tuple, cached on
-        the topology object (device tuples repeat thousands of times
-        per search): (offsets, hop link-processor ids, per-hop duration
-        factors or None, any_hops)."""
-        cache = self.topo.__dict__.setdefault("_flat_routes", {})
-        hit = cache.get(devices)
+        """Flattened ring routes for one participant tuple: (offsets,
+        hop link-processor ids, per-hop duration factors or None,
+        any_hops).
+
+        Two-level cache: the topology caches only builder-INDEPENDENT
+        data — raw link tuples + bandwidth factors, bounded
+        (``parallel/topology.py:flat_ring_links``) — and each builder
+        maps links to ITS processor ids here. The old single-level
+        scheme stored ``self.n_dev + self.link_idx[link]`` on the shared
+        topology object, so the first builder to touch a device tuple
+        poisoned every later builder with its own processor numbering
+        (and the cache grew without bound across searches)."""
+        hit = self._route_procs.get(devices)
         if hit is None:
             import numpy as np
-            routes = self.topo.ring_links(list(devices))
-            factor = getattr(self.topo, "link_factor", None)
-            off = [0]
-            procs: List[int] = []
-            fac: Optional[List[float]] = [] if factor else None
-            for hops in routes:
-                for link in hops:
-                    procs.append(self.n_dev + self.link_idx[link])
-                    if fac is not None:
-                        fac.append(float(factor(link)))
-                off.append(len(procs))
+
+            from ..parallel.topology import flat_ring_links
+            off, links, fac = flat_ring_links(self.topo, devices)
+            procs = [self.n_dev + self.link_idx[l] for l in links]
             hit = (np.asarray(off, np.int32),
                    np.asarray(procs, np.int32),
                    np.asarray(fac, np.float64) if fac is not None
                    else None,
                    len(procs) > 0)
-            cache[devices] = hit
+            self._route_procs[devices] = hit
         return hit
 
     def collective_tasks(self, devices: List[int], coll: str,

@@ -118,7 +118,11 @@ def _make_client_handler(repo, schedulers, pool):
                 keep = headers.get("connection", "keep-alive").lower() \
                     != "close"
                 if method == "bad":
+                    # the body was never read (unparseable/oversized
+                    # Content-Length), so keep-alive framing on this
+                    # socket is unrecoverable: respond and close
                     code, obj = 400, {"error": "malformed request"}
+                    keep = False
                 elif method == "GET":
                     code, obj = get_route(path, repo, schedulers)
                 elif method == "POST":

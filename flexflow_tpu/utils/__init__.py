@@ -1,2 +1,3 @@
+from .jax_compat import shard_map
 from .logger import RecursiveLogger
 from .profiling import Profiler, profile_region

@@ -1,0 +1,25 @@
+"""JAX API compatibility shims.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (kwarg
+``check_rep``) to ``jax.shard_map`` (kwarg ``check_vma``) across the JAX
+versions this package supports. Every in-package caller goes through
+:func:`shard_map` so the per-site ``hasattr`` dance lives in one place;
+on older JAX the silent failure mode was worse than an error — e.g.
+``OpCostModel.calibrate_collectives`` wraps its measurement in a
+best-effort try/except, so a missing ``jax.shard_map`` disabled
+collective calibration entirely without a trace.
+"""
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable ``shard_map``; ``check_vma`` maps onto the old
+    API's ``check_rep`` (None = library default on both)."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

@@ -44,6 +44,13 @@ def _scripted(default_probe_results):
             return {"sps": 950.0 if searched else 900.0, "mfu": 0.31,
                     "flops_per_step": 1.0, "n_chips": 1,
                     "search_time_s": 30.0, "generation": "v5e"}, None
+        if stage == "virtual":
+            assert env.get("FF_CALIBRATION_V2") == "1"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"n": 8, "virtual_searched_vs_dp": 2.5,
+                    "fidelity_spearman": 0.7, "fidelity_rows": 8,
+                    "rows": []}, None
         raise AssertionError(f"unexpected stage {args}")
 
     return fake_run_stage, calls
@@ -91,3 +98,16 @@ def test_tpu_first_try_skips_reprobe(monkeypatch, capsys):
     assert "reprobe" not in out
     probes = [a for a, _ in calls if a[1] == "probe"]
     assert len(probes) == 1
+
+
+def test_virtual_leg_fields_always_present(monkeypatch, capsys):
+    """The 8-virtual-device searched-vs-DP + fidelity leg runs whatever
+    the headline platform is, and its fields reach the driver JSON."""
+    for probes in ([{"platform": "tpu", "n": 1, "device_kind": "v5e"}],
+                   [None, None]):
+        out, calls = _run_main(monkeypatch, capsys, probes)
+        assert out["virtual_searched_vs_dp"] == 2.5
+        assert out["virtual_fidelity_spearman"] == 0.7
+        assert out["virtual_fidelity_rows"] == 8
+        assert out["virtual_n_devices"] == 8
+        assert any(a[1] == "virtual" for a, _ in calls)

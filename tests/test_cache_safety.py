@@ -1,0 +1,82 @@
+"""Regression tests for the two r05 cache-poisoning bugs feeding the
+search ranker:
+
+  - ``MachineSpec.topology`` memoized unconditionally, so mutating a
+    field after the first access (dataclass fields are writable) pinned
+    the STALE fabric into every later search cost;
+  - ``TaskGraphBuilder._flat_routes`` cached builder-specific
+    link-PROCESSOR ids on the shared topology object, so the first
+    builder's processor numbering leaked into any consumer with a
+    different numbering (and the cache grew without bound).
+"""
+from flexflow_tpu.parallel import topology as topo_mod
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.parallel.topology import GraphTopology, TorusTopology
+from flexflow_tpu.search.costmodel import OpCostModel
+from flexflow_tpu.search.tasksim import TaskGraphBuilder
+
+
+def test_topology_memo_invalidated_on_field_mutation():
+    spec = MachineSpec(num_devices=4, generation="cpu-sim",
+                       ici_shape=(2, 2))
+    t1 = spec.topology
+    assert t1.shape == (2, 2)
+    assert spec.topology is t1            # memo hit while unchanged
+    spec.ici_shape = (4, 2)               # mutate after construction
+    spec.num_devices = 8
+    t2 = spec.topology
+    assert t2.shape == (4, 2), "stale topology served after mutation"
+    assert spec.topology is t2            # re-memoized under the new key
+
+
+def test_topology_memo_invalidated_on_slice_mutation():
+    spec = MachineSpec(num_devices=4, generation="cpu-sim",
+                       ici_shape=(2, 2))
+    t1 = spec.topology
+    assert isinstance(t1, TorusTopology)
+    spec.num_slices = 2                   # now a 2-slice ICI+DCN fabric
+    spec.num_devices = 8
+    t2 = spec.topology
+    assert isinstance(t2, GraphTopology)
+    assert t2.num_devices == 8
+
+
+def _builder(n_dev):
+    spec = MachineSpec(num_devices=n_dev, generation="cpu-sim",
+                       ici_shape=(2, 2) if n_dev == 4 else (n_dev,))
+    return TaskGraphBuilder(OpCostModel(spec), n_dev)
+
+
+def test_flat_routes_not_poisoned_across_builders():
+    b1 = _builder(4)
+    topo = b1.topo
+    assert topo is not None
+    devs = (0, 1, 2, 3)
+    off1, procs1, _, any1 = b1._flat_routes(devs)
+    assert any1 and procs1.min() >= b1.n_dev
+    # a second consumer sharing the SAME topology but using a different
+    # processor numbering (e.g. a sub-mesh builder reserving more
+    # compute processors) must get ids in ITS numbering, not b1's
+    b2 = TaskGraphBuilder(b1.cost, 4)
+    b2.n_dev = 8
+    off2, procs2, _, _ = b2._flat_routes(devs)
+    assert (off2 == off1).all()
+    assert procs2.min() >= 8, \
+        "builder-specific processor ids served from the shared topology"
+    assert (procs2 - 8 == procs1 - 4).all()   # same links, own offset
+    # the shared cache holds raw link tuples only
+    shared = topo.__dict__["_ring_route_cache"]
+    for off, links, fac in shared.values():
+        assert all(isinstance(l, tuple) and len(l) == 3 for l in links)
+
+
+def test_flat_route_cache_bounded(monkeypatch):
+    monkeypatch.setattr(topo_mod, "_RING_ROUTE_CACHE_CAP", 3)
+    topo = TorusTopology((4, 2))
+    tuples = [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (5, 6)]
+    for t in tuples:
+        topo_mod.flat_ring_links(topo, t)
+        assert len(topo.__dict__["_ring_route_cache"]) <= 3
+    # entries remain correct after the wholesale eviction
+    off, links, fac = topo_mod.flat_ring_links(topo, (0, 1))
+    assert off[-1] == len(links)

@@ -1,0 +1,58 @@
+"""onnx_wire robustness: truncated/garbage wire input must raise a
+clear ``ValueError`` instead of decoding short slices into wrong
+tensors (or dying on IndexError/KeyError deep in numpy)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu.frontends import onnx_wire as w
+
+
+def _mlp_model_bytes():
+    x = w.make_value_info("x", 1, (4, 8))
+    y = w.make_value_info("y", 1, (4, 2))
+    wt = w.make_tensor("w0", np.zeros((8, 2), np.float32))
+    node = w.make_node("MatMul", ["x", "w0"], ["y"])
+    return w.make_model([node], [x], [y], [wt])
+
+
+def test_truncated_model_raises_value_error():
+    data = _mlp_model_bytes()
+    assert w.load_model(data).graph.node[0].op_type == "MatMul"
+    for cut in (1, len(data) // 3, len(data) - 1):
+        with pytest.raises(ValueError, match="truncated/unsupported"):
+            w.load_model(data[:cut])
+
+
+def test_unterminated_varint_raises():
+    with pytest.raises(ValueError, match="truncated/unsupported"):
+        list(w._fields(b"\x80\x80\x80"))      # continuation bit forever
+
+
+def test_oversized_length_delimited_raises():
+    # field 1, wire type 2, claims 100 bytes with only 2 present
+    with pytest.raises(ValueError, match="length-delimited"):
+        list(w._fields(b"\x0a\x64ab"))
+
+
+def test_truncated_fixed_width_raises():
+    with pytest.raises(ValueError, match="fixed64"):
+        list(w._fields(b"\x09\x01\x02"))      # wt=1 needs 8 bytes
+    with pytest.raises(ValueError, match="fixed32"):
+        list(w._fields(b"\x0d\x01"))          # wt=5 needs 4 bytes
+
+
+def test_bfloat16_initializer_gets_explicit_error():
+    from types import SimpleNamespace
+    t = SimpleNamespace(name="emb", data_type=16, dims=[2, 2],
+                        raw_data=b"\x00" * 8, float_data=[],
+                        int32_data=[], int64_data=[], double_data=[],
+                        uint64_data=[])
+    with pytest.raises(ValueError, match="bfloat16"):
+        w.to_array(t)
+    t.data_type = 17
+    with pytest.raises(ValueError, match="float8"):
+        w.to_array(t)
+    # the message names the tensor so the user can find it
+    t.data_type = 16
+    with pytest.raises(ValueError, match="emb"):
+        w.to_array(t)

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flexflow_tpu.parallel.pipeline import gpipe_ragged
+from flexflow_tpu.utils.jax_compat import shard_map
 
 S = 4           # stages
 COUNTS = (2, 2, 1, 1)   # ragged: 6 blocks over 4 stages
@@ -69,7 +70,7 @@ def _pipelined(table, stacked, head, ids, mesh):
     hidden_ex = jnp.zeros((MB, H), jnp.float32)
     out_ex = jnp.zeros((MB, V), jnp.float32)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         engine, mesh=mesh,
         in_specs=(P("pp"), P(), P(), P(), P(), P()),
         out_specs=P(),

@@ -150,6 +150,36 @@ def test_async_server_endpoints():
         srv.stop()
 
 
+def test_malformed_content_length_closes_connection():
+    """A request whose Content-Length cannot be parsed (or exceeds the
+    body cap) leaves an unread body on the socket, so keep-alive
+    framing is unrecoverable: the server must answer 400 with
+    ``Connection: close`` and actually close, instead of misparsing
+    the stale bytes as the next request."""
+    model, _ = _onnx_mlp()
+    repo = ModelRepository()
+    repo.load_onnx("m", model)
+    srv = serve_async(repo, port=_free_port(), block=False)
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        s.sendall(b"POST /v2/models/m/infer HTTP/1.1\r\n"
+                  b"Host: x\r\nContent-Length: banana\r\n\r\n"
+                  b"{}garbage-that-was-never-read")
+        s.settimeout(10)
+        data = b""
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                break                 # server closed — required
+            data += chunk
+        head = data.split(b"\r\n\r\n", 1)[0].decode("latin1").lower()
+        assert "400" in head.split("\r\n")[0]
+        assert "connection: close" in head
+        s.close()
+    finally:
+        srv.stop()
+
+
 def _load_once(serve, repo_factory, n_clients, per_client):
     """Drive one front under concurrent load; returns the record."""
     import time

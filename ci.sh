@@ -20,6 +20,12 @@ fi
 case "${1:-fast}" in
   fast)
     python -m pytest tests/ -x -q
+    # tier-1 smoke under FF_TRACE=1: the default run above exercises the
+    # disabled (near-zero-cost) telemetry paths; this pass exercises the
+    # ENABLED instrumentation — spans, counters, audit records — on
+    # every push so a broken span can't hide behind the off switch
+    FF_TRACE=1 python -m pytest tests/test_obs.py tests/test_e2e_mlp.py \
+      tests/test_serving_async.py -x -q -m 'not slow'
     ;;
   slow)
     python -m pytest tests/ -q -m slow

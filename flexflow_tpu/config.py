@@ -59,6 +59,17 @@ class FFConfig:
     # memory-bandwidth/parallel-efficiency terms + persisted collective
     # tables, search/calibration.py). "auto" honors FF_CALIBRATION_V2.
     calibration_v2: str = "auto"  # "auto" | "true" | "false"
+    # -------- observability (obs/) --------
+    # span/counter tracing (obs/events.py): "true"/"false" force the
+    # PROCESS-WIDE recorder on/off at compile (one recorder per
+    # process — "false" also stops tracing of other models/servers in
+    # it); "auto" (default) honors the FF_TRACE env var so recorded
+    # benchmarks are unchanged unless asked. Near-zero-cost when
+    # disabled (bench's obs-overhead leg pins it at <= 3%).
+    trace: str = "auto"           # "auto" | "true" | "false"
+    # write a Chrome trace-event JSON (Perfetto/TensorBoard-viewable)
+    # of the recorded spans here when fit() completes; "" = off
+    trace_export_file: str = ""
     # -------- execution --------
     perform_fusion: bool = False
     allow_tensor_op_math_conversion: bool = True   # = allow bf16 matmul accum
@@ -234,6 +245,13 @@ class FFConfig:
                 cfg.simulator_max_num_segments = int(take())
             elif a == "--calibration-v2":
                 cfg.calibration_v2 = take().lower()
+            elif a == "--trace":
+                cfg.trace = "true"
+            elif a == "--no-trace":
+                cfg.trace = "false"
+            elif a == "--trace-export":
+                cfg.trace_export_file = take()
+                cfg.trace = "true"
             elif a == "--fusion":
                 cfg.perform_fusion = True
             elif a == "--profiling":

@@ -20,6 +20,7 @@ per-op backward tasks driven in reverse topo order (``model.cc:2438``).
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -30,6 +31,7 @@ from .ffconst import (CompMode, DataType, LossType, MetricsType, OperatorType)
 from .core.layer import Layer
 from .core.tensor import Tensor
 from .dtypes import to_jnp
+from .obs import events as obs_events
 from .ops import EmitCtx, get_op_def
 from .parallel.machine import DeviceMesh
 from .parallel.strategy import ShardingStrategy
@@ -43,6 +45,40 @@ from .utils.jax_compat import shard_map
 def _npdt(dtype) -> "np.dtype":
     """numpy dtype for a framework DataType (bfloat16 via ml_dtypes)."""
     return np.dtype(to_jnp(dtype))
+
+
+def _instrument_step(fn, name: str):
+    """Wrap a jitted step with per-step telemetry: a span per call with
+    the compile-vs-steady split (the FIRST call of a fresh jit traces +
+    compiles; later calls replay the executable) and a step counter.
+
+    Disabled-mode cost is one flag check plus an int increment — the
+    bench's obs-overhead leg pins this at <= 3% of a train step, and the
+    raw jitted callable stays reachable as ``wrapped.__wrapped__`` so
+    the leg can time both sides of exactly this wrapper. The jit
+    inspection surface callers rely on (``lower`` for HLO dumps —
+    utils/debug.py — plus ``trace``/``eval_shape``) is re-exposed on the
+    wrapper."""
+    # itertools.count: serving instance clones share one compiled
+    # forward across N scheduler workers, and next() is atomic under
+    # the GIL — a read-modify-write int would double-label "compile"
+    calls = itertools.count()
+
+    def wrapped(*args, **kwargs):
+        n = next(calls)
+        if not obs_events.enabled():
+            return fn(*args, **kwargs)
+        obs_events.counter(f"executor.{name}_steps")
+        with obs_events.span(f"executor.{name}_step",
+                             phase="compile" if n == 0 else "steady",
+                             step=n):
+            return fn(*args, **kwargs)
+
+    wrapped.__wrapped__ = fn
+    for attr in ("lower", "trace", "eval_shape", "clear_cache"):
+        if hasattr(fn, attr):
+            setattr(wrapped, attr, getattr(fn, attr))
+    return wrapped
 
 
 def _needs_rng(layer: Layer) -> bool:
@@ -1053,7 +1089,8 @@ class Executor:
                     new_opt_state, self.opt_state_constraints)
             return new_params, new_opt_state, new_state, bm
 
-        self._train_step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        self._train_step = _instrument_step(
+            jax.jit(step_fn, donate_argnums=(0, 1, 2)), "train")
         return self._train_step
 
     def make_eval_step(self):
@@ -1067,7 +1104,7 @@ class Executor:
                                               aux)
             return outs[0], bm
 
-        self._eval_step = jax.jit(step_fn)
+        self._eval_step = _instrument_step(jax.jit(step_fn), "eval")
         return self._eval_step
 
     def make_forward(self):
@@ -1080,7 +1117,7 @@ class Executor:
                                           jnp.int32(0))
             return outs[0] if len(outs) == 1 else outs
 
-        self._forward_fn = jax.jit(fwd)
+        self._forward_fn = _instrument_step(jax.jit(fwd), "forward")
         return self._forward_fn
 
     # ------------------------------------------------------------------

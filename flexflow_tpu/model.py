@@ -490,6 +490,9 @@ class FFModel:
         """Lower graph → (strategy, jitted step). Reference call stack:
         ``FFModel::compile`` → graph_optimize → convert_graph_to_operators
         → NCCL setup (``model.cc:2803-3168``)."""
+        from .obs import events as obs_events
+        obs_events.configure(self.config)
+        _compile_t0 = time.perf_counter()
         if self.config.compilation_cache_dir \
                 or os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             from .utils.compilation_cache import enable_compilation_cache
@@ -700,6 +703,10 @@ class FFModel:
             self.executor.opt_state_constraints = \
                 state_constraints(self.opt_state)
         self._step = 0
+        obs_events.record_span("model.compile", _compile_t0,
+                               time.perf_counter() - _compile_t0,
+                               n_devices=self.dmesh.num_devices,
+                               n_layers=len(self.layers))
 
     def _optimize_strategy(self):
         """Strategy selection: search unless --only-data-parallel.
@@ -795,6 +802,14 @@ class FFModel:
             rep = pm.report()
             rep["epoch_time_s"] = dt
             rep["samples_per_sec"] = pm.train_all / dt if dt > 0 else 0.0
+            from .obs import events as obs_events
+            from .obs.metrics_registry import REGISTRY
+            obs_events.record_span("fit.epoch", t0, dt, epoch=epoch,
+                                   batches=nb)
+            REGISTRY.gauge(
+                "ff_train_samples_per_sec",
+                "Training throughput of the last completed epoch"
+            ).set(rep["samples_per_sec"])
             history.append(rep)
             if verbose:
                 msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
@@ -807,6 +822,11 @@ class FFModel:
                 if stop:
                     break
         self._current_metrics = history[-1] if history else {}
+        if self.config.trace_export_file:
+            from .obs import events as obs_events
+            from .obs.trace_export import export_chrome_trace
+            if obs_events.enabled():
+                export_chrome_trace(self.config.trace_export_file)
         return history
 
     def _run_train_step(self, step_fn, batch):

@@ -1,0 +1,29 @@
+"""Unified telemetry: span tracing, metrics, and strategy audit records.
+
+Three pieces, wired through all three execution layers (search,
+executor, serving):
+
+  - :mod:`.events` — thread-safe ring-buffered span/counter recorder,
+    near-zero-cost when disabled, enabled via ``FF_TRACE=1`` or
+    ``FFConfig.trace``;
+  - :mod:`.trace_export` — Chrome trace-event JSON export of the
+    recorded spans (Perfetto / TensorBoard-viewable, composable with
+    the ``jax.profiler`` regions in ``utils/profiling.py``);
+  - :mod:`.metrics_registry` — counters/gauges/histograms with
+    Prometheus text exposition (served at ``GET /metrics`` by both
+    HTTP front-ends);
+  - :mod:`.audit` — per-op predicted-cost breakdowns of each search
+    adoption (searched vs DP baseline), persisted to
+    ``.ffcache/strategy_audit_<hash>.json``.
+
+See docs/observability.md.
+"""
+from . import events
+from .audit import load_strategy_audit, workload_key
+from .events import counter, instant, span
+from .metrics_registry import REGISTRY, MetricsRegistry, get_registry
+from .trace_export import export_chrome_trace, to_chrome_trace
+
+__all__ = ["events", "span", "counter", "instant", "REGISTRY",
+           "MetricsRegistry", "get_registry", "to_chrome_trace",
+           "export_chrome_trace", "workload_key", "load_strategy_audit"]

@@ -1,0 +1,184 @@
+"""Span/counter event recorder — the host-side half of the telemetry
+layer (the device-side half is ``jax.profiler`` via
+``utils/profiling.profile_region``; the two compose — a ``span`` brackets
+host phases like "unity.dp", the XLA trace shows what the devices did
+inside it).
+
+Design constraints (ISSUE 2 tentpole):
+
+  - **near-zero cost when disabled**: every public entry point is one
+    module-global flag check; ``span`` is a ``__slots__`` class-based
+    context manager (no generator machinery), so a disabled span costs
+    two attribute reads and a branch — hot loops like
+    ``OpCostModel.op_cost`` (1e4–1e6 calls per search) can call
+    ``counter()`` unconditionally;
+  - **thread-safe**: search, executor, and serving record concurrently
+    (one lock around the ring + counters; the enabled check is a benign
+    race — an event straddling enable/disable may be dropped, never
+    corrupted);
+  - **bounded**: completed spans land in a ring buffer of ``capacity``
+    events — the newest N survive, wraparound drops the oldest (a
+    long-running server cannot grow without bound).
+
+Enabling: ``FF_TRACE=1`` in the environment (read at import), or
+``FFConfig.trace = "true"`` (applied by ``FFModel.compile`` via
+:func:`configure`), or :func:`enable` directly.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 65536
+
+_lock = threading.Lock()
+_enabled = False
+_capacity = DEFAULT_CAPACITY
+_ring: List[Dict[str, Any]] = []
+_head = 0                         # index of the OLDEST event once full
+_dropped = 0                      # events overwritten by wraparound
+_counters: Dict[str, float] = {}
+
+
+def _env_on(val: Optional[str]) -> bool:
+    return (val or "").lower() in ("1", "true", "yes", "on")
+
+
+def enabled() -> bool:
+    """Fast global check — the only cost telemetry pays when off."""
+    return _enabled
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    global _enabled, _capacity
+    with _lock:
+        if capacity is not None and capacity > 0 \
+                and capacity != _capacity:
+            _capacity = capacity
+            _reset_locked()
+        _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _reset_locked() -> None:
+    global _head, _dropped
+    _ring.clear()
+    _head = 0
+    _dropped = 0
+    _counters.clear()
+
+
+def clear() -> None:
+    """Drop every recorded event and counter (capacity/enabled kept)."""
+    with _lock:
+        _reset_locked()
+
+
+def configure(cfg) -> None:
+    """Apply an ``FFConfig``: ``trace`` "true"/"false" forces the
+    PROCESS-WIDE recorder state — there is one recorder per process, so
+    compiling a model with ``trace="false"`` switches tracing off for
+    everything else in the process too (that is what ``--no-trace``
+    means; use the default "auto" to leave other models' tracing alone);
+    "auto" (the default) leaves the FF_TRACE / explicit-enable decision
+    untouched — except that a non-empty ``trace_export_file`` implies
+    tracing (requesting an export of an empty trace is never what the
+    caller meant; the ``--trace-export`` flag applies the same rule)."""
+    mode = str(getattr(cfg, "trace", "auto") or "auto").lower()
+    if mode in ("false", "off", "0", "no"):
+        disable()
+    elif _env_on(mode) or mode == "true" \
+            or getattr(cfg, "trace_export_file", ""):
+        enable()
+
+
+def counter(name: str, n: float = 1) -> None:
+    """Increment a named counter (no-op when disabled)."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters() -> Dict[str, float]:
+    with _lock:
+        return dict(_counters)
+
+
+def _record(ev: Dict[str, Any]) -> None:
+    global _head, _dropped
+    with _lock:
+        if len(_ring) < _capacity:
+            _ring.append(ev)
+        else:
+            _ring[_head] = ev
+            _head = (_head + 1) % _capacity
+            _dropped += 1
+
+
+def record_span(name: str, t0: float, dur: float, **attrs) -> None:
+    """Record one completed span explicitly (``t0`` from
+    ``time.perf_counter()``). Used where a ``with`` block would force
+    reindenting a long phase — e.g. ``FFModel.compile``."""
+    if not _enabled:
+        return
+    _record({"name": name, "kind": "span", "ts": t0, "dur": dur,
+             "tid": threading.get_ident(),
+             "attrs": attrs or None})
+
+
+def instant(name: str, **attrs) -> None:
+    """Record a point-in-time event (e.g. a recompile trigger)."""
+    if not _enabled:
+        return
+    _record({"name": name, "kind": "instant",
+             "ts": time.perf_counter(), "dur": 0.0,
+             "tid": threading.get_ident(),
+             "attrs": attrs or None})
+
+
+class span:
+    """``with span("unity.dp", depth=2): ...`` — records one completed
+    span on exit. Nesting is recovered from timing containment (the
+    Chrome trace viewer does this natively for same-thread 'X' events).
+    Disabled cost: one flag check on enter and one on exit."""
+
+    __slots__ = ("name", "attrs", "_t0")
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self) -> "span":
+        self._t0 = time.perf_counter() if _enabled else None
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t0 = self._t0
+        if t0 is not None and _enabled:
+            record_span(self.name, t0, time.perf_counter() - t0,
+                        **self.attrs)
+        return False
+
+
+def events() -> List[Dict[str, Any]]:
+    """Snapshot of recorded events, oldest first."""
+    with _lock:
+        return _ring[_head:] + _ring[:_head]
+
+
+def dropped() -> int:
+    """Events lost to ring wraparound since the last clear()."""
+    return _dropped
+
+
+# FF_TRACE honored at import so serving entry points (which never see an
+# FFConfig) are covered too
+if _env_on(os.environ.get("FF_TRACE")):
+    _enabled = True

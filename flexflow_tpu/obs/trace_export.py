@@ -1,0 +1,69 @@
+"""Chrome trace-event JSON export of the recorded spans.
+
+The output loads in ``chrome://tracing``, Perfetto (ui.perfetto.dev),
+and TensorBoard's trace viewer — the same viewers that read the XPlane
+traces ``utils/profiling.profile_region`` produces via ``jax.profiler``,
+so a host-side span trace and a device-side XLA trace of the same run
+can be inspected side by side (they cannot be merged into one file —
+XPlane is a different container — but the shared wall-clock makes the
+phases line up).
+
+Format: the "JSON Array Format" of the Trace Event spec — one complete
+('X') event per span with microsecond timestamps, one instant ('i')
+event per point event, counters summarized in ``otherData``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from . import events as _events
+
+
+def to_chrome_trace(evts: Optional[Sequence[Dict[str, Any]]] = None,
+                    counters: Optional[Dict[str, float]] = None
+                    ) -> Dict[str, Any]:
+    """Convert recorded events (default: the live ring) to a Chrome
+    trace-event document. Timestamps are rebased to the earliest event
+    so the viewer opens at t=0."""
+    if evts is None:
+        evts = _events.events()
+    if counters is None:
+        counters = _events.counters()
+    base = min((e["ts"] for e in evts), default=0.0)
+    pid = os.getpid()
+    out: List[Dict[str, Any]] = []
+    for e in evts:
+        rec: Dict[str, Any] = {
+            "name": e["name"],
+            "ph": "X" if e["kind"] == "span" else "i",
+            "ts": round((e["ts"] - base) * 1e6, 3),
+            "pid": pid,
+            "tid": e["tid"],
+        }
+        if e["kind"] == "span":
+            rec["dur"] = round(e["dur"] * 1e6, 3)
+        else:
+            rec["s"] = "t"          # instant scoped to its thread
+        if e.get("attrs"):
+            rec["args"] = e["attrs"]
+        out.append(rec)
+    return {"traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {"counters": dict(counters),
+                          "dropped_events": _events.dropped()}}
+
+
+def export_chrome_trace(path: str,
+                        evts: Optional[Sequence[Dict[str, Any]]] = None
+                        ) -> str:
+    """Write the Chrome trace JSON to ``path``; returns the path."""
+    doc = to_chrome_trace(evts)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path

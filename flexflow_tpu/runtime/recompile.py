@@ -12,6 +12,9 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from ..obs import events as obs_events
+from ..obs.metrics_registry import REGISTRY
+
 
 class RecompileState:
     """trigger() -> bool evaluated once per training iteration; when true,
@@ -34,6 +37,14 @@ class RecompileState:
             return False
         self.alter(self)
         self.recompilations += 1
+        # recompile events: an instant in the trace (the next step's
+        # span shows phase="compile" again) + a scrapeable counter
+        obs_events.instant("runtime.recompile", iteration=self.iteration,
+                           recompilations=self.recompilations)
+        obs_events.counter("executor.recompiles")
+        REGISTRY.counter(
+            "ff_recompiles_total",
+            "Dynamic recompilations (recompile_on_condition)").inc()
         # invalidate jitted steps; params/opt state survive (the graph may
         # have changed shape-compatibly — the user's responsibility, as in
         # the reference)

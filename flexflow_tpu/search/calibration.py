@@ -43,6 +43,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import events as obs_events
+
 _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), ".ffcache")
 
@@ -121,9 +123,13 @@ class CalibrationTable:
         genuine miss, recording the result for future processes."""
         hit = self.get(backend, kind, dtype, sclass, axis_size)
         if hit is not None:
+            obs_events.counter("calibration.cache_hits")
             return hit
+        obs_events.counter("calibration.cache_misses")
         try:
-            v = float(fn())
+            with obs_events.span("calibration.measure", kind=kind,
+                                 axis_size=axis_size, sclass=sclass):
+                v = float(fn())
         except Exception:  # noqa: BLE001 — calibration is best-effort
             return None
         self.measured += 1
@@ -423,7 +429,13 @@ def calibrate_mesh(dmesh=None, cache_dir: Optional[str] = None,
     the given mesh. Persisted measurements are reused across processes;
     a warm table makes this call measurement-free."""
     import jax
-    backend = jax.default_backend()
+    with obs_events.span("search.calibrate_mesh"):
+        return _calibrate_mesh(jax.default_backend(), dmesh, cache_dir,
+                               collectives, sizes, table)
+
+
+def _calibrate_mesh(backend, dmesh, cache_dir, collectives, sizes,
+                    table) -> MeshCalibration:
     tab = table if table is not None else CalibrationTable(cache_dir)
     calib = MeshCalibration(backend=backend, table=tab)
     calib.dispatch_s = tab.get_or_measure(

@@ -29,6 +29,7 @@ import numpy as np
 from ..core.layer import Layer
 from ..dtypes import itemsize
 from ..ffconst import OperatorType, PARALLEL_OPS
+from ..obs import events as obs_events
 from ..ops import get_op_def
 from ..parallel.machine import DeviceMesh, MachineSpec
 
@@ -355,13 +356,16 @@ class OpCostModel:
         dkey = repr(key)
         cached = self._disk_cache().get(dkey)
         if cached is not None:
+            obs_events.counter("costmodel.measure_cache_hits")
             return CostMetrics(forward_time=cached[0],
                                backward_time=cached[1])
         if key in self._unmeasurable:
             return None
         if self._measure_spent_s >= self.measure_budget_s:
             return None
-        cm = self.measure(layer, shard_degrees, weight_shard_degree)
+        obs_events.counter("costmodel.measure_cache_misses")
+        with obs_events.span("costmodel.measure", op=layer.name):
+            cm = self.measure(layer, shard_degrees, weight_shard_degree)
         if cm is None:
             # in-memory only: a failure may be transient (device busy,
             # flaky compile) and must not poison future processes
@@ -378,8 +382,10 @@ class OpCostModel:
         memory likewise."""
         key = (layer.param_key(), tuple(sorted(shard_degrees.items())),
                weight_shard_degree)
+        obs_events.counter("costmodel.queries")
         hit = self.cache.get(key)
         if hit is not None:
+            obs_events.counter("costmodel.cache_hits")
             return hit
         op = get_op_def(layer.op_type)
         in_shapes = [t.shape for t in layer.inputs]
@@ -453,6 +459,7 @@ class OpCostModel:
         collective timings at import-time shapes interpolated across
         shape classes; degrees never measured fall through to the
         fitted/analytic ring model."""
+        obs_events.counter("costmodel.xfer_queries")
         floor = 0.0
         if self.calib is not None:
             kind = "all_to_all" if collective == "permute" else collective

@@ -20,6 +20,7 @@ import numpy as np
 from ..core.layer import Layer
 from ..dtypes import itemsize
 from ..ffconst import OperatorType
+from ..obs import events as obs_events
 from ..parallel.machine import DeviceMesh
 from ..parallel.strategy import ShardingStrategy
 from .costmodel import CostMetrics, OpCostModel
@@ -57,8 +58,21 @@ class StrategySimulator:
         return degs
 
     def evaluate(self, assign: Dict[str, Tuple[int, ...]]) -> GraphCost:
+        gc, _ = self._evaluate(assign, breakdown=False)
+        return gc
+
+    def evaluate_breakdown(self, assign: Dict[str, Tuple[int, ...]]
+                           ) -> Tuple[GraphCost, List[Dict]]:
+        """(GraphCost, per-op entries) — the strategy-audit breakdown;
+        entry component sums equal the GraphCost components (before the
+        infeasibility penalty, flagged per entry set by the caller)."""
+        return self._evaluate(assign, breakdown=True)
+
+    def _evaluate(self, assign: Dict[str, Tuple[int, ...]],
+                  breakdown: bool) -> Tuple[GraphCost, List[Dict]]:
         compute = xfer = sync = 0.0
         mem = 0
+        entries: List[Dict] = []
         out_degrees: Dict[int, Dict[int, int]] = {}  # tensor guid -> degrees
         for layer in self.layers:
             opts = self.options[layer.name]
@@ -69,8 +83,10 @@ class StrategySimulator:
                     wdeg *= d
             cm = self.cost.op_cost(layer, degs, wdeg)
             compute += cm.forward_time + cm.backward_time
-            mem += cm.weights_memory + cm.outputs_memory
+            l_mem = cm.weights_memory + cm.outputs_memory
+            mem += l_mem
             # input resharding: producer layout vs this op's batch layout
+            l_xfer = 0.0
             for t in layer.inputs:
                 src = out_degrees.get(t.guid, {})
                 dst = {d: v for d, v in degs.items()
@@ -78,9 +94,10 @@ class StrategySimulator:
                     if t.shape else {}
                 tb = int(np.prod(t.shape)) * itemsize(t.dtype) \
                     if t.shape else 0
-                xfer += self.cost.resharding_cost(tb, src, dst)
+                l_xfer += self.cost.resharding_cost(tb, src, dst)
                 # backward: cotangent moves the other way
-                xfer += self.cost.resharding_cost(tb, dst, src)
+                l_xfer += self.cost.resharding_cost(tb, dst, src)
+            xfer += l_xfer
             for o in layer.outputs:
                 out_degrees[o.guid] = degs
             # gradient sync: weights replicated across the dp degree
@@ -88,15 +105,27 @@ class StrategySimulator:
             for opt, d in zip(opts, assign.get(layer.name, ())):
                 if opt.weight_dims and d > 1:
                     dp_deg //= d
+            l_sync = 0.0
             if layer.weights:
                 wbytes = sum(int(np.prod(w.shape)) * itemsize(w.dtype)
                              for w in layer.weights) // max(wdeg, 1)
-                sync += self.cost.weight_sync_cost(wbytes, dp_deg)
+                l_sync = self.cost.weight_sync_cost(wbytes, dp_deg)
+            sync += l_sync
+            if breakdown:
+                entries.append({
+                    "name": layer.name,
+                    "op_type": getattr(layer.op_type, "name",
+                                       str(layer.op_type)),
+                    "fwd_s": cm.forward_time, "bwd_s": cm.backward_time,
+                    "xfer_s": l_xfer, "sync_s": l_sync,
+                    "mem_bytes": l_mem,
+                    "total_s": cm.forward_time + cm.backward_time
+                    + l_xfer + l_sync})
         total = compute + xfer + sync
         # memory feasibility: ~4x weights (param + grad + 2 Adam moments)
         if mem * 4 > self.cost.spec.hbm_bytes:
             total *= 100.0  # infeasible penalty (memory-aware search refines)
-        return GraphCost(total, compute, xfer, sync, mem)
+        return GraphCost(total, compute, xfer, sync, mem), entries
 
 
 def data_parallel_assignment(layers: Sequence[Layer], dmesh: DeviceMesh,
@@ -186,40 +215,45 @@ def mcmc_search(layers: Sequence[Layer], dmesh: DeviceMesh,
     for l in layers:
         for t in l.inputs:
             consumers.setdefault(t.guid, []).append(l)
-    for it in range(budget):
-        layer = rng.choice(shardable)
-        opts = sim.options[layer.name]
-        oi = rng.randrange(len(opts))
-        old = current[layer.name]
-        # propose a new degree for this option; keep product ≤ num devices
-        choices = [d for d in valid_degrees
-                   if d * math.prod(old[:oi] + old[oi + 1:])
-                   <= dmesh.num_devices]
-        if not choices:
-            continue
-        new_deg = rng.choice(choices)
-        cand = old[:oi] + (new_deg,) + old[oi + 1:]
-        # realizability check (divisibility + axis allocation)
-        if assignment_to_sharding(layer, opts, cand, dmesh) is None:
-            continue
-        if propagate:
-            moves = _propagate_neighbors(layer, cand, sim, consumers,
-                                         dmesh, rng)
-        else:
-            moves = {layer.name: cand}
-        olds = {n: current[n] for n in moves}
-        current.update(moves)
-        new_cost = sim.evaluate(current).total
-        delta = new_cost - cur_cost
-        if delta < 0 or rng.random() < math.exp(-delta / max(
-                alpha * cur_cost, 1e-12)):
-            cur_cost = new_cost
-            if new_cost < best_cost:
-                best, best_cost = dict(current), new_cost
-                if verbose:
-                    print(f"  mcmc iter {it}: best {best_cost * 1e3:.3f} ms")
-        else:
-            current.update(olds)
+    with obs_events.span("mcmc.search", budget=budget):
+        for it in range(budget):
+            layer = rng.choice(shardable)
+            opts = sim.options[layer.name]
+            oi = rng.randrange(len(opts))
+            old = current[layer.name]
+            # propose a new degree for this option; keep product ≤
+            # num devices
+            choices = [d for d in valid_degrees
+                       if d * math.prod(old[:oi] + old[oi + 1:])
+                       <= dmesh.num_devices]
+            if not choices:
+                continue
+            new_deg = rng.choice(choices)
+            cand = old[:oi] + (new_deg,) + old[oi + 1:]
+            # realizability check (divisibility + axis allocation)
+            if assignment_to_sharding(layer, opts, cand, dmesh) is None:
+                continue
+            if propagate:
+                moves = _propagate_neighbors(layer, cand, sim, consumers,
+                                             dmesh, rng)
+            else:
+                moves = {layer.name: cand}
+            olds = {n: current[n] for n in moves}
+            current.update(moves)
+            obs_events.counter("mcmc.proposals")
+            new_cost = sim.evaluate(current).total
+            delta = new_cost - cur_cost
+            if delta < 0 or rng.random() < math.exp(-delta / max(
+                    alpha * cur_cost, 1e-12)):
+                obs_events.counter("mcmc.accepts")
+                cur_cost = new_cost
+                if new_cost < best_cost:
+                    best, best_cost = dict(current), new_cost
+                    if verbose:
+                        print(f"  mcmc iter {it}: best "
+                              f"{best_cost * 1e3:.3f} ms")
+            else:
+                current.update(olds)
     return best, best_cost, sim
 
 

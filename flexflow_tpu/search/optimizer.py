@@ -12,6 +12,8 @@ import json
 import time
 from typing import Optional
 
+from ..obs import audit as obs_audit
+from ..obs import events as obs_events
 from ..parallel.machine import DeviceMesh, MachineSpec
 from ..parallel.strategy import ShardingStrategy
 from .costmodel import OpCostModel
@@ -30,6 +32,10 @@ def optimize_strategy(ff):
     """
     cfg = ff.config
     dmesh = ff.dmesh
+    # stale-path guard: if THIS search's audit write is skipped (tracing
+    # off) or fails, the floor guard below must not annotate a previous
+    # compile's record with this compile's measured timings
+    ff._strategy_audit_path = None
     if cfg.import_strategy_file:
         return _import_strategy(ff, cfg.import_strategy_file, dmesh)
     spec = dmesh.spec
@@ -37,34 +43,38 @@ def optimize_strategy(ff):
     cost_model.segment_size = max(1, cfg.simulator_segment_size)
     cost_model.max_segments = max(1, cfg.simulator_max_num_segments)
     import jax
-    if jax.devices()[0].platform != "cpu":
-        # real chip: refine MXU efficiency with a matmul microbenchmark
-        # AND enable per-op on-device measurement (the analog of
-        # measure_operator_cost, simulator.cc:537 — every heavy op is
-        # timed at shard-local shape and disk-cached). On the CPU sim
-        # the analytic constants already match the cpu-sim MachineSpec.
-        cost_model.calibrate()
-        cost_model.measure_on_device = True
-    # fit the collective constants from a real ring all-reduce on the
-    # live mesh (disk-cached; the round-2 A/B showed machine-model ICI
-    # constants mispredicting CPU-sim collectives by orders of
-    # magnitude, adopting strategies that lost to DP). ONLY when the
-    # search targets the live platform: under --machine-model-file the
-    # described machine's constants are the ground truth, and measuring
-    # the host fabric would corrupt the simulation.
-    if not cfg.machine_model_file:
-        cost_model.calibrate_collectives(dmesh)
-        # calibration v2 (opt-in): measured host dispatch/memory-
-        # bandwidth/parallel-efficiency terms + persisted per-collective
-        # tables, reused across processes (search/calibration.py). Same
-        # exclusion as above: a described machine's constants are ground
-        # truth, so never overwrite them with live-host measurements.
-        from .calibration import calibrate_mesh, calibration_enabled
-        if calibration_enabled(cfg):
-            try:
-                cost_model.attach_calibration(calibrate_mesh(dmesh))
-            except Exception:  # noqa: BLE001 — calibration is best-effort
-                pass
+    with obs_events.span("search.calibrate"):
+        if jax.devices()[0].platform != "cpu":
+            # real chip: refine MXU efficiency with a matmul
+            # microbenchmark AND enable per-op on-device measurement
+            # (the analog of measure_operator_cost, simulator.cc:537 —
+            # every heavy op is timed at shard-local shape and
+            # disk-cached). On the CPU sim the analytic constants
+            # already match the cpu-sim MachineSpec.
+            cost_model.calibrate()
+            cost_model.measure_on_device = True
+        # fit the collective constants from a real ring all-reduce on
+        # the live mesh (disk-cached; the round-2 A/B showed machine-
+        # model ICI constants mispredicting CPU-sim collectives by
+        # orders of magnitude, adopting strategies that lost to DP).
+        # ONLY when the search targets the live platform: under
+        # --machine-model-file the described machine's constants are the
+        # ground truth, and measuring the host fabric would corrupt the
+        # simulation.
+        if not cfg.machine_model_file:
+            cost_model.calibrate_collectives(dmesh)
+            # calibration v2 (opt-in): measured host dispatch/memory-
+            # bandwidth/parallel-efficiency terms + persisted per-
+            # collective tables, reused across processes
+            # (search/calibration.py). Same exclusion as above: a
+            # described machine's constants are ground truth, so never
+            # overwrite them with live-host measurements.
+            from .calibration import calibrate_mesh, calibration_enabled
+            if calibration_enabled(cfg):
+                try:
+                    cost_model.attach_calibration(calibrate_mesh(dmesh))
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
     t0 = time.perf_counter()
     if cfg.search_algo == "unity":
         return _apply_floor_guard(
@@ -76,6 +86,7 @@ def optimize_strategy(ff):
         verbose=cfg.profiling)
     dp = data_parallel_assignment(ff.layers, dmesh, sim.options)
     dp_cost = sim.evaluate(dp).total
+    _write_mcmc_audit(ff, sim, best, dp)
     strategy = assignment_to_strategy(ff.layers, ff.graph_inputs, best,
                                       dmesh, sim)
     if cfg.profiling:
@@ -90,6 +101,73 @@ def optimize_strategy(ff):
     return _apply_floor_guard(
         ff, _maybe_banks(ff, cost_model, _maybe_pipeline(
             ff, cost_model, best_cost, (strategy, None))))
+
+
+def _write_unity_audit(ff, cost_model, graph, gc, info):
+    """Strategy audit record (obs/audit.py): per-op predicted cost
+    breakdown of the adopted PCG vs the canonical DP baseline, both
+    priced by the additive evaluator so the per-op entries sum exactly
+    to each side's recorded total. Written only when tracing is on
+    (``FF_TRACE`` / ``FFConfig.trace``); best-effort."""
+    if not obs_events.enabled():
+        return
+    try:
+        from .unity import GraphCostEvaluator, data_parallel_graph
+        dmesh = ff.dmesh
+        inputs = ff.graph_inputs + getattr(ff, "const_inputs", [])
+        ev = GraphCostEvaluator(cost_model, dmesh)
+        with obs_events.span("search.audit"):
+            a_gc, a_entries = ev.graph_cost_breakdown(graph)
+            dp_g = data_parallel_graph(ff.layers, inputs,
+                                       [ff._output_tensor], dmesh)
+            d_gc, d_entries = ev.graph_cost_breakdown(dp_g)
+        key = obs_audit.workload_key(ff.layers, dmesh.num_devices)
+        path = obs_audit.write_strategy_audit({
+            "search_algo": "unity",
+            "ranker": getattr(info, "final_ranker", "additive"),
+            "ranker_total_s": gc.total,
+            "n_devices": dmesh.num_devices,
+            "adopted": obs_audit.side_record(a_entries, a_gc.total),
+            "dp_baseline": obs_audit.side_record(d_entries, d_gc.total),
+            "predicted_dp_over_searched":
+                d_gc.total / max(a_gc.total, 1e-12),
+        }, key)
+        if path:
+            ff._strategy_audit_path = path
+            obs_events.counter("search.audit_records")
+    except Exception:  # noqa: BLE001 — audit must never kill compile
+        pass
+
+
+def _write_mcmc_audit(ff, sim, best, dp):
+    """MCMC-path strategy audit record: per-op breakdown of the best
+    assignment vs the DP assignment from the same simulator."""
+    if not obs_events.enabled():
+        return
+    try:
+        with obs_events.span("search.audit"):
+            b_gc, b_entries = sim.evaluate_breakdown(best)
+            d_gc, d_entries = sim.evaluate_breakdown(dp)
+        key = obs_audit.workload_key(ff.layers, ff.dmesh.num_devices)
+        # side totals are the pre-penalty component sums, so per_op
+        # entries always sum to them; ranker_total_s keeps the
+        # simulator's (possibly memory-penalized) objective
+        b_tot = b_gc.compute + b_gc.xfer + b_gc.sync
+        d_tot = d_gc.compute + d_gc.xfer + d_gc.sync
+        path = obs_audit.write_strategy_audit({
+            "search_algo": "mcmc",
+            "ranker": "additive",
+            "ranker_total_s": b_gc.total,
+            "n_devices": ff.dmesh.num_devices,
+            "adopted": obs_audit.side_record(b_entries, b_tot),
+            "dp_baseline": obs_audit.side_record(d_entries, d_tot),
+            "predicted_dp_over_searched": d_tot / max(b_tot, 1e-12),
+        }, key)
+        if path:
+            ff._strategy_audit_path = path
+            obs_events.counter("search.audit_records")
+    except Exception:  # noqa: BLE001 — audit must never kill compile
+        pass
 
 
 def _synth_batch(ff):
@@ -210,6 +288,7 @@ def _apply_floor_guard(ff, result):
     strategy, info = result
     dp = ShardingStrategy.data_parallel(ff.layers, ff.graph_inputs,
                                         ff.dmesh)
+    _guard_t0 = time.perf_counter()
     try:
         t_s, ex_s, times_s, carry_s = _time_strategy(ff, strategy, info)
         t_dp, ex_dp, times_dp, carry_dp = _time_strategy(ff, dp, None)
@@ -244,6 +323,15 @@ def _apply_floor_guard(ff, result):
               "searched_std": sd_s, "dp_std": sd_dp,
               "n_steps": len(times_s), "adopted": adopted}
     ff._floor_guard_record = record
+    obs_events.record_span("search.floor_guard", _guard_t0,
+                           time.perf_counter() - _guard_t0,
+                           adopted=adopted)
+    # measured timings join the predicted per-op breakdown in the audit
+    # record — both sides of one adoption decision in one file
+    _audit_path = getattr(ff, "_strategy_audit_path", None)
+    if _audit_path:
+        obs_audit.annotate_strategy_audit(_audit_path,
+                                          {"floor_guard": record})
     # hand the winning side's compiled executor to FFModel.compile so
     # the adopted program is not re-jitted a third time (params are
     # re-initialized there — the guard's few synthetic steps must not
@@ -394,13 +482,15 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
         # (reference --machine-model-version / EnhancedMachineModel)
         from .tasksim import TaskGraphEvaluator
         evaluator_cls = TaskGraphEvaluator
-    info, strategy, gc, graph = unity_search(
-        ff.layers, ff.graph_inputs + getattr(ff, "const_inputs", []),
-        [ff._output_tensor], dmesh, cost_model,
-        budget=budget, alpha=max(cfg.search_alpha, 1.0 + 1e-6),
-        mem_budget_bytes=mem_budget,
-        base_optimize_threshold=max(cfg.base_optimize_threshold, 2),
-        xfers=xfers, evaluator_cls=evaluator_cls)
+    with obs_events.span("search.unity", budget=budget):
+        info, strategy, gc, graph = unity_search(
+            ff.layers, ff.graph_inputs + getattr(ff, "const_inputs", []),
+            [ff._output_tensor], dmesh, cost_model,
+            budget=budget, alpha=max(cfg.search_alpha, 1.0 + 1e-6),
+            mem_budget_bytes=mem_budget,
+            base_optimize_threshold=max(cfg.base_optimize_threshold, 2),
+            xfers=xfers, evaluator_cls=evaluator_cls)
+    _write_unity_audit(ff, cost_model, graph, gc, info)
     try:
         # predicted searched-vs-DP ratio, recorded so A/B harnesses can
         # correlate the cost model's prediction with measurement; the

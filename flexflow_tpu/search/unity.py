@@ -36,6 +36,7 @@ from ..core.layer import Layer
 from ..core.tensor import Tensor
 from ..dtypes import itemsize
 from ..ffconst import OperatorType, PARALLEL_OPS
+from ..obs import events as obs_events
 from ..parallel.machine import DeviceMesh
 from ..parallel.strategy import ShardingStrategy
 from ..pcg.graph import Graph, GraphProgramInfo, ParAnn, PNode
@@ -158,11 +159,44 @@ class GraphCostEvaluator:
                out_pin, self.mem_lambda)
         hit = self._cache.get(key)
         if hit is not None:
+            obs_events.counter("unity.graph_cost_cache_hits")
             return hit
+        obs_events.counter("unity.graph_cost_evals")
+        gc, _ = self._evaluate(graph, in_pins, out_pin, breakdown=False)
+        self._cache[key] = gc
+        return gc
+
+    def graph_cost_breakdown(self, graph: Graph,
+                             in_pins: Optional[Dict[int, Layout]] = None,
+                             out_pin: Optional[Layout] = None
+                             ) -> Tuple[GraphCost, List[Dict]]:
+        """(GraphCost, per-op entries) — uncached; the entries' component
+        sums equal the GraphCost components BY CONSTRUCTION (the
+        aggregate is accumulated from the same per-node terms), which is
+        what makes the strategy audit record diffable against the
+        search's reported cost."""
+        return self._evaluate(graph, in_pins, out_pin, breakdown=True)
+
+    def _evaluate(self, graph: Graph, in_pins: Optional[Dict[int, Layout]],
+                  out_pin: Optional[Layout], breakdown: bool
+                  ) -> Tuple[GraphCost, List[Dict]]:
         lay = propagate_layouts(graph, in_pins)
         compute = xfer = sync = 0.0
         mem = 0
+        entries: List[Dict] = []
         n_dev = self.dmesh.num_devices
+
+        def note(node, fwd=0.0, bwd=0.0, nx=0.0, ns=0.0, nmem=0):
+            if breakdown:
+                entries.append({
+                    "name": node.layer.name,
+                    "op_type": getattr(node.op_type, "name",
+                                       str(node.op_type)),
+                    "fwd_s": fwd, "bwd_s": bwd, "xfer_s": nx,
+                    "sync_s": ns, "mem_bytes": nmem,
+                    "total_s": fwd + bwd + nx + ns
+                    + self.mem_lambda * nmem})
+
         for n in graph.topo_order():
             t = n.op_type
             in_bytes = 0
@@ -186,26 +220,34 @@ class GraphCostEvaluator:
                 # SPMD; bwd: the cotangent re-gathers within the group.
                 # Charged on the per-existing-shard region so composed
                 # (2D) views aren't overpriced by the co-partition factor.
-                xfer += self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
-                                            "all_to_all", deg)
+                nx = self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
+                                         "all_to_all", deg)
+                xfer += nx
+                note(n, nx=nx)
                 continue
             if t == OperatorType.OP_COMBINE:
                 deg = n.layer.params["degree"]
                 eff = _coll_bytes(in_bytes, in_lay, deg)
-                xfer += self.cost.xfer_cost(eff, "all_gather", deg)
-                xfer += self.cost.xfer_cost(eff, "all_to_all", deg)
+                nx = self.cost.xfer_cost(eff, "all_gather", deg) \
+                    + self.cost.xfer_cost(eff, "all_to_all", deg)
+                xfer += nx
+                note(n, nx=nx)
                 continue
             if t == OperatorType.OP_REPLICATE:
                 deg = n.layer.params["degree"]
                 # fwd free under SPMD when input already replicated;
                 # bwd: all-reduce of input cotangent across the group
-                xfer += self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
-                                            "all_reduce", deg)
+                nx = self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
+                                         "all_reduce", deg)
+                xfer += nx
+                note(n, nx=nx)
                 continue
             if t == OperatorType.OP_REDUCTION:
                 deg = n.layer.params["degree"]
-                xfer += self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
-                                            "all_reduce", deg)
+                nx = self.cost.xfer_cost(_coll_bytes(in_bytes, in_lay),
+                                         "all_reduce", deg)
+                xfer += nx
+                note(n, nx=nx)
                 continue
             if t in (OperatorType.OP_PIPELINE,
                      OperatorType.OP_FUSED_PARALLEL):
@@ -221,35 +263,46 @@ class GraphCostEvaluator:
             degs = {0: scale} if scale > 1 else {}
             cm = self.cost.op_cost(n.layer, degs, ann.weight_degree())
             compute += cm.forward_time + cm.backward_time
-            mem += cm.weights_memory * 4 + cm.outputs_memory
+            n_mem = cm.weights_memory * 4 + cm.outputs_memory
+            mem += n_mem
             # input mismatch safety net
+            n_xfer = 0.0
             for e in graph.in_edges[n]:
                 src_lay = lay[(e.src.guid, e.src_idx)]
                 src_t = e.src.layer.outputs[e.src_idx]
                 want = self._expected_input(n, e.dst_idx, src_t.shape)
                 if src_lay != want:
-                    xfer += self.cost.resharding_cost(
+                    n_xfer += self.cost.resharding_cost(
                         _bytes_of(src_t), dict(src_lay), dict(want))
+            xfer += n_xfer
             # gradient sync for weights: all-reduce over the mesh part not
             # sharding the weight
+            n_sync = 0.0
             wdeg = ann.weight_degree()
             wbytes = sum(_bytes_of_spec(w) for w in n.layer.weights)
             if wbytes:
                 dp_deg = max(1, n_dev // max(wdeg, 1))
-                sync += self.cost.weight_sync_cost(wbytes // max(wdeg, 1),
-                                                   dp_deg)
+                n_sync = self.cost.weight_sync_cost(
+                    wbytes // max(wdeg, 1), dp_deg)
+            sync += n_sync
+            note(n, fwd=cm.forward_time, bwd=cm.backward_time,
+                 nx=n_xfer, ns=n_sync, nmem=n_mem)
         # output pin: resharding from final layout to the pinned layout
         if out_pin is not None and graph.outputs:
             n0, i0 = graph.outputs[0]
             fin = lay.get((n0.guid, i0), ())
             if fin != out_pin:
-                xfer += self.cost.resharding_cost(
+                nx = self.cost.resharding_cost(
                     _bytes_of(n0.layer.outputs[i0]), dict(fin),
                     dict(out_pin))
+                xfer += nx
+                if breakdown:
+                    entries.append({
+                        "name": "__out_pin__", "op_type": "RESHARD",
+                        "fwd_s": 0.0, "bwd_s": 0.0, "xfer_s": nx,
+                        "sync_s": 0.0, "mem_bytes": 0, "total_s": nx})
         total = compute + xfer + sync + self.mem_lambda * mem
-        gc = GraphCost(total, compute, xfer, sync, mem)
-        self._cache[key] = gc
-        return gc
+        return GraphCost(total, compute, xfer, sync, mem), entries
 
 
 def _bytes_of_spec(w) -> int:
@@ -953,15 +1006,17 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
     dp_predicted_total = None
     final_ranker = "additive"
     if mem_budget_bytes is not None:
-        g, gc = graph_optimize_with_memory(
-            graph, xfers, cost_model, dmesh, mem_budget_bytes, budget,
-            alpha, base_optimize_threshold=base_optimize_threshold,
-            evaluator_cls=evaluator_cls)
+        with obs_events.span("unity.memory_search", budget=budget):
+            g, gc = graph_optimize_with_memory(
+                graph, xfers, cost_model, dmesh, mem_budget_bytes, budget,
+                alpha, base_optimize_threshold=base_optimize_threshold,
+                evaluator_cls=evaluator_cls)
     else:
         ev = evaluator_cls(cost_model, dmesh)
         search = UnitySearch(ev, xfers, budget=budget, alpha=alpha,
                              base_optimize_threshold=base_optimize_threshold)
-        g, _ = search.optimize(graph)
+        with obs_events.span("unity.dp", budget=budget):
+            g, _ = search.optimize(graph)
         gc = ev.graph_cost(g)
         # DP floor: never return a strategy predicted worse than the
         # canonical data-parallel view (the reference search starts FROM
@@ -992,7 +1047,11 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
             try:
                 from .tasksim import TaskGraphEvaluator
                 tev = TaskGraphEvaluator(cost_model, dmesh)
-                ranked = [(cg, tev.graph_cost(cg)) for cg, _ in finalists]
+                with obs_events.span("unity.final_rank",
+                                     ranker="tasksim",
+                                     finalists=len(finalists)):
+                    ranked = [(cg, tev.graph_cost(cg))
+                              for cg, _ in finalists]
                 g, gc = min(ranked, key=lambda p: p[1].total)
                 dp_predicted_total = next(
                     tgc.total for cg, tgc in ranked if cg is dp_g)

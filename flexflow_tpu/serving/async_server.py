@@ -24,12 +24,11 @@ Usage::
 from __future__ import annotations
 
 import asyncio
-import json
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from .http_server import get_route, post_route
+from .http_server import get_route, post_route, render_body
 
 _MAX_BODY = 256 << 20   # sanity bound, matches big dense batches
 
@@ -66,7 +65,9 @@ class AsyncServerHandle:
 
 async def _read_request(reader):
     """Parse one HTTP/1.1 request; returns (method, path, headers,
-    body) or None on EOF/malformed input."""
+    body) or None on EOF. An unparseable request line yields the "bad"
+    marker — the client gets a 400 response instead of a silent
+    connection drop (same contract as the bad-Content-Length path)."""
     try:
         line = await reader.readline()
     except (ConnectionError, asyncio.IncompleteReadError):
@@ -76,7 +77,9 @@ async def _read_request(reader):
     try:
         method, path, _ = line.decode("latin1").split(" ", 2)
     except ValueError:
-        return None
+        # garbage request line: nothing after it is framable, so the
+        # response must close the socket — but it IS a response
+        return "bad", "", {}, b""
     headers = {}
     while True:
         h = await reader.readline()
@@ -95,12 +98,12 @@ async def _read_request(reader):
 
 
 def _response(code: int, obj, keep_alive: bool) -> bytes:
-    body = json.dumps(obj).encode()
+    body, ctype = render_body(obj)
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
               503: "Service Unavailable"}.get(code, "OK")
     conn = "keep-alive" if keep_alive else "close"
     head = (f"HTTP/1.1 {code} {reason}\r\n"
-            f"Content-Type: application/json\r\n"
+            f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"
             f"Connection: {conn}\r\n\r\n")
     return head.encode("latin1") + body
@@ -118,9 +121,10 @@ def _make_client_handler(repo, schedulers, pool):
                 keep = headers.get("connection", "keep-alive").lower() \
                     != "close"
                 if method == "bad":
-                    # the body was never read (unparseable/oversized
-                    # Content-Length), so keep-alive framing on this
-                    # socket is unrecoverable: respond and close
+                    # the body was never read (unparseable request line
+                    # or unparseable/oversized Content-Length), so
+                    # keep-alive framing on this socket is
+                    # unrecoverable: respond and close
                     code, obj = 400, {"error": "malformed request"}
                     keep = False
                 elif method == "GET":
@@ -130,6 +134,9 @@ def _make_client_handler(repo, schedulers, pool):
                     code, obj = await loop.run_in_executor(
                         pool, post_route, path, body, repo, schedulers)
                 else:
+                    # unknown method/route: a framed 404 on a live
+                    # connection (the body was consumed above), never
+                    # a silent drop
                     code, obj = 404, {"error": f"method {method}"}
                 writer.write(_response(code, obj, keep))
                 await writer.drain()
@@ -162,7 +169,8 @@ def serve_async(repo, host: str = "127.0.0.1", port: int = 8000,
         for name in repo.names():
             schedulers[name] = BatchScheduler(
                 repo.get_instances(name), max_batch=max_batch,
-                max_delay_ms=max_delay_ms, max_queue=max_queue)
+                max_delay_ms=max_delay_ms, max_queue=max_queue,
+                name=name)
     pool = ThreadPoolExecutor(max_workers=pool_workers,
                               thread_name_prefix="ffserve")
     loop = asyncio.new_event_loop()

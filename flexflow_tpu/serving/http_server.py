@@ -2,10 +2,15 @@
 
 Endpoints (JSON bodies, shapes row-major):
   - ``GET  /v2/health/ready``            -> 200 when serving
+  - ``GET  /healthz``                    -> 200 {"status": "ok"} (probe
+    alias — what k8s-style liveness checks expect)
   - ``GET  /v2/models``                  -> {"models": [names]}
   - ``GET  /v2/metrics``                 -> per-model scheduler counters
     (requests/completed/rejected, queue depth, mean batch rows,
     latency p50/p99 ms, instances)
+  - ``GET  /metrics``                    -> Prometheus text exposition
+    (request-latency histograms, queue-depth gauges, request counters —
+    the ``obs/metrics_registry.py`` registry; scrape-ready)
   - ``POST /v2/models/<name>/infer``     -> {"outputs": [{"data", "shape"}]}
     body: {"inputs": [{"name": ..., "shape": [...], "data": [flat]}]};
     bounded-queue overflow -> 503
@@ -28,14 +33,55 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import events as obs_events
+from ..obs.metrics_registry import REGISTRY
 from .scheduler import QueueFullError
 
 
+def render_body(obj):
+    """Encode a route result body: dict -> JSON, str -> pre-rendered
+    plain text (the Prometheus exposition). Returns ``(bytes, ctype)``;
+    shared by the threading and asyncio front-ends so the content-type
+    policy cannot drift between them."""
+    if isinstance(obj, str):
+        return obj.encode(), "text/plain; version=0.0.4; charset=utf-8"
+    return json.dumps(obj).encode(), "application/json"
+
+
+def render_prometheus(schedulers) -> str:
+    """Prometheus text for ``GET /metrics``: the process-wide registry
+    plus point-in-time gauges (queue depth, instances) sampled at
+    scrape time from the live schedulers.
+
+    The registry is process-wide by design (all fronts' request
+    counters/histograms merge into one namespace); the point-in-time
+    gauges reflect the schedulers of the server front that was scraped,
+    so a process running MULTIPLE fronts should scrape one of them —
+    the standard one-server-per-process deployment is unaffected."""
+    live = list(schedulers.items())
+    # atomic re-sample from live state: rows for models unloaded since
+    # the last scrape disappear, and a concurrent scrape never observes
+    # a half-populated row set
+    REGISTRY.gauge("ff_queue_depth",
+                   "Requests waiting in the bounded queue").set_all(
+        ({"model": name}, sched._q.qsize()) for name, sched in live)
+    REGISTRY.gauge("ff_scheduler_instances",
+                   "Model instances draining the queue").set_all(
+        ({"model": name}, sched.num_instances) for name, sched in live)
+    return REGISTRY.render()
+
+
 def get_route(path: str, repo, schedulers):
-    """Route one GET; returns ``(status, json_obj)``. Shared by the
-    threading and asyncio front-ends."""
-    if path == "/v2/health/ready":
-        return 200, {"ready": True}
+    """Route one GET; returns ``(status, obj)`` where ``obj`` is a JSON
+    document (dict) or pre-rendered plain text (str — the Prometheus
+    exposition). Shared by the threading and asyncio front-ends (the
+    request counter lives here for the same reason: one counting
+    policy, both fronts)."""
+    obs_events.counter("serving.http_requests")
+    if path in ("/v2/health/ready", "/healthz"):
+        return 200, {"status": "ok", "ready": True}
+    if path == "/metrics":
+        return 200, render_prometheus(schedulers)
     if path == "/v2/models":
         return 200, {"models": repo.names()}
     if path == "/v2/metrics":
@@ -54,6 +100,7 @@ def post_route(path: str, body: bytes, repo, schedulers):
     """Route one POST (BLOCKING — the batching scheduler's ``infer``
     waits for the result; the asyncio front runs this in a thread
     pool). Returns ``(status, json_obj)``."""
+    obs_events.counter("serving.http_requests")
     parts = path.strip("/").split("/")
     # v2/repository/models/<name>/unload (Triton repository API)
     if len(parts) == 5 and parts[:3] == ["v2", "repository", "models"] \
@@ -132,9 +179,9 @@ def _make_handler(repo, schedulers):
             pass
 
         def _send(self, code: int, obj):
-            body = json.dumps(obj).encode()
+            body, ctype = render_body(obj)
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -167,7 +214,8 @@ def serve_http(repo, host: str = "127.0.0.1", port: int = 8000,
         for name in repo.names():
             schedulers[name] = BatchScheduler(
                 repo.get_instances(name), max_batch=max_batch,
-                max_delay_ms=max_delay_ms, max_queue=max_queue)
+                max_delay_ms=max_delay_ms, max_queue=max_queue,
+                name=name)
     srv = ThreadingHTTPServer((host, port), _make_handler(repo, schedulers))
     if block:
         try:

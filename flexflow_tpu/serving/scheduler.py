@@ -29,6 +29,12 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.metrics_registry import DEFAULT_BUCKETS, REGISTRY
+
+#: request-latency histogram buckets (seconds): the registry default
+#: extended upward for slow generate calls
+LATENCY_BUCKETS = DEFAULT_BUCKETS + (30.0,)
+
 
 class QueueFullError(RuntimeError):
     """Raised by ``infer`` when the bounded request queue is full —
@@ -36,10 +42,16 @@ class QueueFullError(RuntimeError):
 
 
 class SchedulerMetrics:
-    """Thread-safe counters + latency reservoir for one scheduler."""
+    """Thread-safe counters + latency reservoir for one scheduler.
 
-    def __init__(self, window: int = 2048):
+    Doubles as the bridge into the process-wide Prometheus registry
+    (``obs/metrics_registry.py``): every completion lands in the
+    ``ff_request_latency_seconds`` histogram and the per-model request
+    counters, labeled by model name — what ``GET /metrics`` serves."""
+
+    def __init__(self, window: int = 2048, name: str = ""):
         self._lock = threading.Lock()
+        self.name = name or "default"
         self.requests = 0
         self.completed = 0
         self.failed = 0
@@ -47,12 +59,40 @@ class SchedulerMetrics:
         self.batches = 0
         self.batched_rows = 0
         self._lat = collections.deque(maxlen=window)
+        # registry handles resolved ONCE — the hot path below must not
+        # take the registry lock for a name lookup per request
+        self._m_requests = REGISTRY.counter(
+            "ff_requests_total",
+            "Inference requests accepted into the queue")
+        self._m_rejected = REGISTRY.counter(
+            "ff_requests_rejected_total",
+            "Requests shed by bounded-queue backpressure")
+        self._m_failed = REGISTRY.counter(
+            "ff_requests_failed_total",
+            "Requests completed with an error")
+        self._m_latency = REGISTRY.histogram(
+            "ff_request_latency_seconds",
+            "End-to-end request latency (queue + batch assembly + "
+            "device step)", buckets=LATENCY_BUCKETS)
+
+    def record_submitted(self):
+        with self._lock:
+            self.requests += 1
+        self._m_requests.inc(model=self.name)
+
+    def record_rejected(self):
+        with self._lock:
+            self.rejected += 1
+        self._m_rejected.inc(model=self.name)
 
     def record_done(self, latency_s: float, ok: bool):
         with self._lock:
             self.completed += ok
             self.failed += (not ok)
             self._lat.append(latency_s)
+        self._m_latency.observe(latency_s, model=self.name)
+        if not ok:
+            self._m_failed.inc(model=self.name)
 
     def snapshot(self, queue_depth: int) -> Dict:
         with self._lock:
@@ -94,7 +134,8 @@ class BatchScheduler:
     """
 
     def __init__(self, sessions, max_batch: int = 64,
-                 max_delay_ms: float = 2.0, max_queue: int = 256):
+                 max_delay_ms: float = 2.0, max_queue: int = 256,
+                 name: str = ""):
         if not isinstance(sessions, (list, tuple)):
             sessions = [sessions]
         assert sessions, "need at least one session instance"
@@ -102,7 +143,7 @@ class BatchScheduler:
         self.session = self.sessions[0]    # back-compat alias
         self.max_batch = max_batch
         self.max_delay_s = max_delay_ms / 1e3
-        self.metrics = SchedulerMetrics()
+        self.metrics = SchedulerMetrics(name=name)
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._workers = [
@@ -124,12 +165,10 @@ class BatchScheduler:
         try:
             self._q.put_nowait(r)
         except queue.Full:
-            with self.metrics._lock:
-                self.metrics.rejected += 1
+            self.metrics.record_rejected()
             raise QueueFullError(
                 f"request queue full ({self._q.maxsize}); retry later")
-        with self.metrics._lock:
-            self.metrics.requests += 1
+        self.metrics.record_submitted()
         if not r.event.wait(timeout):
             raise TimeoutError("inference request timed out")
         if r.error is not None:

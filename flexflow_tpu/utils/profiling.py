@@ -42,10 +42,16 @@ def profile_region(name: str, trace_dir: Optional[str] = None):
 
 
 class Profiler:
-    """Per-step timing accumulator used by fit() under --profiling."""
+    """Per-step timing accumulator used by fit() under --profiling.
 
-    def __init__(self, trace_dir: Optional[str] = None):
+    ``name`` labels this profiler's gauges in the metrics registry so
+    two profilers in one process (train + eval loops) don't overwrite
+    each other's ``ff_profiler_*`` rows."""
+
+    def __init__(self, trace_dir: Optional[str] = None,
+                 name: str = "default"):
         self.trace_dir = trace_dir
+        self.name = name
         self.step_times: List[float] = []
         self.compile_time: float = 0.0
         self._trace_active = False
@@ -74,11 +80,28 @@ class Profiler:
         self.step_times.append(dt)
 
     def summary(self) -> Dict[str, float]:
-        steady = self.step_times[1:] or self.step_times
-        return {
+        # steady-state excludes the first (jit-compiling) step; with a
+        # SINGLE recorded step there is no steady-state sample at all —
+        # reporting the compile step as mean/p50 overstated step time by
+        # the whole compile, so the steady stats are 0.0 there and
+        # compile_s carries the one measurement
+        steady = self.step_times[1:]
+        out = {
             "steps": len(self.step_times),
             "compile_s": self.compile_time,
             "mean_step_s": float(np.mean(steady)) if steady else 0.0,
             "p50_step_s": float(np.median(steady)) if steady else 0.0,
+            "p90_step_s": float(np.percentile(steady, 90))
+            if steady else 0.0,
+            "max_step_s": float(np.max(steady)) if steady else 0.0,
             "total_s": float(np.sum(self.step_times)),
         }
+        # route the summary into the metrics registry so a serving /
+        # training process exposes its step timings at GET /metrics
+        from ..obs.metrics_registry import REGISTRY
+        for k in ("compile_s", "mean_step_s", "p50_step_s",
+                  "p90_step_s", "max_step_s"):
+            REGISTRY.gauge(f"ff_profiler_{k}",
+                           f"Profiler.summary() {k}").set(
+                out[k], profiler=self.name)
+        return out

@@ -51,6 +51,12 @@ def _scripted(default_probe_results):
             return {"n": 8, "virtual_searched_vs_dp": 2.5,
                     "fidelity_spearman": 0.7, "fidelity_rows": 8,
                     "rows": []}, None
+        if stage == "obs_overhead":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"wrapped_step_s": 0.001, "raw_step_s": 0.001,
+                    "overhead_pct": 0.1, "ok": True}, None
         raise AssertionError(f"unexpected stage {args}")
 
     return fake_run_stage, calls
@@ -111,3 +117,7 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         assert out["virtual_fidelity_rows"] == 8
         assert out["virtual_n_devices"] == 8
         assert any(a[1] == "virtual" for a, _ in calls)
+        # the telemetry disabled-mode overhead leg rides along and its
+        # measured percentage reaches the driver JSON
+        assert out["obs_overhead_pct"] == 0.1
+        assert any(a[1] == "obs_overhead" for a, _ in calls)

@@ -180,6 +180,56 @@ def test_malformed_content_length_closes_connection():
         srv.stop()
 
 
+def test_unknown_route_404_keeps_connection_alive():
+    """An unknown route (or method) must get a correctly framed 404
+    with keep-alive preserved — the same socket serves further requests
+    — and a garbage request line gets a framed 400-close, not a silent
+    connection drop (the 400-path contract from PR 1)."""
+    model, _ = _onnx_mlp()
+    repo = ModelRepository()
+    repo.load_onnx("m", model)
+    srv = serve_async(repo, port=_free_port(), block=False)
+
+    def read_response(s):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            data += s.recv(4096)
+        head, rest = data.split(b"\r\n\r\n", 1)
+        n = int([ln.split(b":")[1] for ln in head.split(b"\r\n")
+                 if ln.lower().startswith(b"content-length")][0])
+        while len(rest) < n:
+            rest += s.recv(4096)
+        return head.decode("latin1").lower(), rest[:n]
+
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        s.settimeout(10)
+        # two unknown-route GETs + an unknown method on ONE socket:
+        # each gets a framed 404, the connection survives all three
+        for req in (b"GET /no/such/route HTTP/1.1\r\nHost: x\r\n\r\n",
+                    b"GET /also/missing HTTP/1.1\r\nHost: x\r\n\r\n",
+                    b"DELETE /v2/models/m HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: 2\r\n\r\n{}"):
+            s.sendall(req)
+            head, body = read_response(s)
+            assert "404" in head.split("\r\n")[0]
+            assert "connection: keep-alive" in head
+            assert b"error" in body
+        # the connection is still usable for a real route
+        s.sendall(b"GET /v2/health/ready HTTP/1.1\r\nHost: x\r\n\r\n")
+        head, body = read_response(s)
+        assert "200" in head.split("\r\n")[0]
+        # garbage request line: framed 400 + close (never a bare drop)
+        s.sendall(b"NONSENSE\r\n")
+        head, _ = read_response(s)
+        assert "400" in head.split("\r\n")[0]
+        assert "connection: close" in head
+        assert s.recv(4096) == b""     # server closed after responding
+        s.close()
+    finally:
+        srv.stop()
+
+
 def _load_once(serve, repo_factory, n_clients, per_client):
     """Drive one front under concurrent load; returns the record."""
     import time

@@ -34,13 +34,27 @@ SECTIONS = [
     ("flexflow_tpu.kernels", "Pallas TPU kernels (flash/ring attention)"),
     ("flexflow_tpu.frontends", "Keras / torch.fx / ONNX importers"),
     ("flexflow_tpu.serving", "inference serving (sessions/batcher/HTTP)"),
+    ("flexflow_tpu.obs",
+     "telemetry (spans, Prometheus metrics, strategy audit records)"),
     ("flexflow_tpu.utils", "profiling, logging, compilation cache"),
 ]
 
 
+# stdlib-default docstrings (EnumMeta injects one per Python version):
+# their wording changes across interpreters and churned every docs
+# regeneration, so they document as empty, deterministically
+_STDLIB_DEFAULT_DOCS = {
+    "An enumeration.",
+    "Enum where members are also (and must be) ints",
+    "Enum where members are also (and must be) strings",
+}
+
+
 def _clean_doc(obj) -> str:
-    doc = inspect.getdoc(obj) or ""
-    return doc.strip()
+    if inspect.isclass(obj) and "__doc__" not in vars(obj):
+        return ""       # inherited docstring — not this class's own
+    doc = (inspect.getdoc(obj) or "").strip()
+    return "" if doc in _STDLIB_DEFAULT_DOCS else doc
 
 
 def _sig(obj) -> str:

@@ -417,6 +417,119 @@ def stage_obs_overhead(steps: int):
            "ok": pct <= 3.0})
 
 
+def stage_recovery(steps: int):
+    """Resilience leg (ISSUE 3 acceptance): checkpoint overhead and
+    time-to-recover, measured on the virtual mesh.
+
+      - baseline: plain train steps, no checkpointing;
+      - sync: an atomic verified save every CKPT_EVERY steps, blocking;
+      - async: same cadence, file writes on the background thread —
+        steady-state overhead must stay <= 5% of baseline;
+      - time-to-recover: wall time from "process lost" to "restored
+        from the newest valid checkpoint and one step completed" on a
+        fresh model (restore + reshard + recompile-free replay step).
+    """
+    _apply_platform_env()
+    import tempfile
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    from flexflow_tpu.runtime.checkpoint import (
+        CheckpointManager, restore_model_checkpoint, save_model_checkpoint)
+
+    CKPT_EVERY = 10
+
+    def build():
+        cfg = FFConfig()
+        cfg.batch_size = 256
+        cfg.only_data_parallel = True
+        ff = FFModel(cfg)
+        out = build_mlp(ff, cfg.batch_size, in_dim=256,
+                        hidden=(1024, 1024), num_classes=10)
+        ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+                   [], output_tensor=out)
+        return ff
+
+    ff = build()
+    rng = np.random.default_rng(0)
+    batch = {"input": rng.normal(size=(256, 256)).astype(np.float32),
+             "label": rng.integers(0, 10, size=(256, 1)).astype(np.int32)}
+    step = ff.executor.make_train_step()
+    for _ in range(3):
+        bm = ff._run_train_step(step, batch)
+    _sync_fetch(bm["loss"])  # compile + sync
+    import statistics
+    chunk = CKPT_EVERY
+    # median-of-ratios converges ~1/sqrt(rounds); this host's chunk
+    # noise is +-10%, so <10 rounds leaves the 5% gate flaky
+    rounds = max(10, steps // chunk)
+
+    def leg_chunk(mgr):
+        """Seconds for one `chunk`-step slice, with one checkpoint
+        through `mgr` (None = baseline) mid-chunk — not on the boundary,
+        so an async write always has following steps to overlap (the
+        steady-state shape); the closing wait() then charges only the
+        un-overlapped tail."""
+        t0 = time.perf_counter()
+        for i in range(chunk):
+            bm = ff._run_train_step(step, batch)
+            if mgr is not None and i == chunk // 2:
+                save_model_checkpoint(ff, mgr.directory, manager=mgr,
+                                      blocking=not mgr.async_save)
+        _sync_fetch(bm["loss"])
+        if mgr is not None:
+            mgr.wait()
+        return time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as d:
+        sync_mgr = CheckpointManager(os.path.join(d, "sync"))
+        async_mgr = CheckpointManager(os.path.join(d, "async"),
+                                      async_save=True)
+        # paired median-of-ratios: on a small shared host the load
+        # drifts on a multi-second scale (chunk times vary 2x), so each
+        # checkpointed chunk is ratioed against the MEAN OF ITS ADJACENT
+        # baseline chunks (drift cancels within a bracket) and the
+        # median ratio across rounds is the reported steady state —
+        # min-of-chunks across modes was still +-10% noisy here. Round
+        # order b1 a b2 s b3: b1/b2 bracket the async chunk, b2/b3 the
+        # sync chunk, so BOTH ratios use baselines measured immediately
+        # around their numerator
+        aratio, sratio, base_s = [], [], []
+        for _ in range(rounds):
+            b1 = leg_chunk(None)
+            a = leg_chunk(async_mgr)
+            b2 = leg_chunk(None)
+            s = leg_chunk(sync_mgr)
+            b3 = leg_chunk(None)
+            base_s += [b1, b2, b3]
+            aratio.append(a / ((b1 + b2) / 2))
+            sratio.append(s / ((b2 + b3) / 2))
+        base = min(base_s)
+        sync_pct = (statistics.median(sratio) - 1.0) * 100.0
+        async_pct = (statistics.median(aratio) - 1.0) * 100.0
+        # time-to-recover: restore newest valid step + one step back in
+        # training — the supervisor's in-process recovery critical path
+        # (minus the backoff sleep), whose jitted step is already warm.
+        # The fresh model's step is therefore warmed BEFORE timing so
+        # the number measures restore/reshard/replay, not an XLA
+        # compile; restore then overwrites the warmup's param changes.
+        ff2 = build()
+        step2 = ff2.executor.make_train_step()
+        bm = ff2._run_train_step(step2, batch)
+        _sync_fetch(bm["loss"])  # compile + sync
+        t0 = time.perf_counter()
+        restore_model_checkpoint(ff2, os.path.join(d, "async"))
+        bm = ff2._run_train_step(step2, batch)
+        _sync_fetch(bm["loss"])
+        recover_s = time.perf_counter() - t0
+    _emit({"baseline_step_s": round(base / chunk, 6),
+           "ckpt_sync_overhead_pct": round(sync_pct, 2),
+           "ckpt_async_overhead_pct": round(async_pct, 2),
+           "ckpt_every": CKPT_EVERY,
+           "time_to_recover_s": round(recover_s, 3),
+           "ok": async_pct <= 5.0})
+
+
 # ======================================================================
 # parent orchestration
 # ======================================================================
@@ -640,6 +753,27 @@ def main():
         else:
             errors.append(f"obs_overhead: {err}")
 
+    # -- stage 5.45: checkpoint overhead + time-to-recover ------------
+    # ISSUE 3 acceptance: async-save steady-state overhead <= 5% vs the
+    # no-checkpoint baseline; time-to-recover reported on every run
+    if remaining() > 120:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            xf = (xf + " --xla_force_host_platform_device_count=8").strip()
+        renv = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": xf}
+        rec, err = stage(["--stage", "recovery", "--steps", "100"],
+                         300, renv)
+        if rec is not None:
+            out["ckpt_sync_overhead_pct"] = rec["ckpt_sync_overhead_pct"]
+            out["ckpt_async_overhead_pct"] = rec["ckpt_async_overhead_pct"]
+            out["time_to_recover_s"] = rec["time_to_recover_s"]
+            if not rec["ok"]:
+                errors.append(
+                    f"recovery: async checkpoint overhead "
+                    f"{rec['ckpt_async_overhead_pct']}% > 5%")
+        else:
+            errors.append(f"recovery: {err}")
+
     # -- stage 5.5: flash-off point on the recovered platform ---------
     if out.get("reprobe") == "recovered" and remaining() > 420:
         foff, err = stage(bert_args + ["--flash", "false"], 420, env)
@@ -741,5 +875,7 @@ if __name__ == "__main__":
         stage_virtual(a.budget, a.steps)
     elif a.stage == "obs_overhead":
         stage_obs_overhead(a.steps)
+    elif a.stage == "recovery":
+        stage_recovery(a.steps)
     else:
         raise SystemExit(f"unknown stage {a.stage!r}")

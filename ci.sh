@@ -26,6 +26,10 @@ case "${1:-fast}" in
     # every push so a broken span can't hide behind the off switch
     FF_TRACE=1 python -m pytest tests/test_obs.py tests/test_e2e_mlp.py \
       tests/test_serving_async.py -x -q -m 'not slow'
+    # fault-injection smoke: a crash@2 training run must auto-resume
+    # from its checkpoints and complete — the resilience subsystem's
+    # recovery path exercised on every push, not just in unit tests
+    FF_FAULT_PLAN="crash@2" python tools/resilience_smoke.py
     ;;
   slow)
     python -m pytest tests/ -q -m slow

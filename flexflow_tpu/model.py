@@ -830,9 +830,25 @@ class FFModel:
         return history
 
     def _run_train_step(self, step_fn, batch):
+        # fault-injection sites (resilience/faults.py): crash/device-loss
+        # clauses fire BEFORE the step runs, NaN/Inf gradient-corruption
+        # clauses poison the state after; active() is one cached check,
+        # so fault-free runs pay nothing measurable
+        from .resilience import faults
+        if faults.active():
+            faults.raise_pending(self._step)
         self.params, self.opt_state, self.state, bm = step_fn(
             self.params, self.opt_state, self.state,
             jnp.int32(self._step), batch)
+        if faults.active():
+            bad = faults.poison_value(self._step)
+            if bad is not None:
+                poison = jnp.float32(bad)
+                self.params = jax.tree.map(
+                    lambda a: (a * poison).astype(a.dtype)
+                    if jnp.issubdtype(a.dtype, jnp.inexact) else a,
+                    self.params)
+                bm = dict(bm, loss=poison)
         self._step += 1
         return bm
 

@@ -1,0 +1,38 @@
+"""Resilience subsystem: fault injection, verified atomic checkpoints,
+auto-resume, and elastic re-plan on device loss.
+
+The reference has no fault-tolerance mechanism (SURVEY.md §5); TPU pods
+are preemptible by design, so this layer makes failure a normal input:
+
+  - :mod:`.faults` — deterministic fault injection
+    (``FF_FAULT_PLAN="crash@2;nan@5;lose_device@9:2"`` or
+    :func:`faults.install`): crash-at-step, NaN/Inf gradient
+    corruption, checkpoint corruption/truncation, virtual device loss;
+  - hardened checkpoints (``runtime/checkpoint.py``) — atomic
+    staging-dir + rename saves, a per-leaf shape/dtype/CRC32 manifest
+    verified on restore, async background saves, and restore that falls
+    back past corrupt or partial steps;
+  - :mod:`.supervisor` — a resilient training driver: auto-resume from
+    the newest valid checkpoint (exact dataloader rng/epoch/position
+    resume), bounded restarts with exponential backoff + jitter, and
+    NaN-loss rollback to the last good checkpoint;
+  - :mod:`.elastic` — on device loss, rebuild the machine spec for the
+    shrunken mesh, re-run the strategy search warm from the persistent
+    calibration tables, and reshard the restored state onto the new
+    strategy via the checkpoint replace path;
+  - :mod:`.status` — always-on restart/fault/checkpoint facts, merged
+    into both HTTP front-ends' ``/healthz``.
+
+See docs/resilience.md.
+"""
+from . import elastic, faults, status
+from .faults import (DeviceLoss, FaultError, FaultPlan, SimulatedCrash,
+                     install as install_fault_plan)
+from .supervisor import RestartBudgetExceeded, Supervisor, run_supervised
+
+__all__ = [
+    "faults", "status", "elastic",
+    "FaultPlan", "FaultError", "SimulatedCrash", "DeviceLoss",
+    "install_fault_plan",
+    "Supervisor", "run_supervised", "RestartBudgetExceeded",
+]

@@ -1,0 +1,111 @@
+"""Elastic re-plan: shrink the machine, re-search, reshard, continue.
+
+The search layer's whole premise is that the parallelization adapts to
+the machine it has (PAPER.md); losing a device mid-run just means the
+machine changed. The arrays-redistribution line of work (PAPERS.md,
+arxiv 2112.01075 + 2004.13336) treats resharding a live state onto a
+different device layout as a first-class operation — here it rides the
+existing ``restore_model_checkpoint`` replace path, which device_puts
+host numpy leaves against the CURRENT template shardings, whatever mesh
+those live on.
+
+Flow on (injected) device loss:
+
+  1. rebuild the :class:`MachineSpec` for the shrunken mesh — the
+     adopted device count is the largest count <= the surviving devices
+     that divides the global batch (batch divisibility is the same
+     constraint the search itself obeys);
+  2. recompile: ``FFModel.compile`` with the new spec re-runs the
+     strategy search **warm** from the persistent calibration tables
+     (PR 1: zero re-measurement on warm load) — or the DP preset under
+     ``--only-data-parallel`` — on the new mesh;
+  3. the caller restores the last checkpoint, which reshards the saved
+     host state onto the new strategy's placements;
+  4. the adoption is recorded: obs counters/instants, the always-on
+     :mod:`.status` block, and an ``elastic_replan`` annotation on the
+     search's strategy audit record when one was written.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Optional
+
+from ..obs import events as obs_events
+from ..obs.metrics_registry import REGISTRY
+from . import status
+
+log = logging.getLogger("flexflow_tpu")
+
+
+def surviving_device_count(n_alive: int, batch_size: int) -> int:
+    """Largest usable device count <= ``n_alive``: the global batch must
+    divide over the data-parallel shards (the constraint every strategy
+    the search emits already satisfies)."""
+    for n in range(max(1, n_alive), 0, -1):
+        if batch_size % n == 0:
+            return n
+    return 1
+
+
+def shrunken_spec(spec, n_devices: int):
+    """A :class:`MachineSpec` for the post-loss machine: same hardware
+    generation/constants, fewer devices. The physical ICI shape and any
+    explicit fabric no longer describe the surviving set — drop them so
+    the mesh refactorizes from the device count (the detect() path)."""
+    return dataclasses.replace(
+        spec, num_devices=n_devices, ici_shape=None,
+        topology_override=None, num_slices=1, num_hosts=1)
+
+
+def replan_on_device_loss(ff, n_lost: int,
+                          batch_size: Optional[int] = None) -> int:
+    """Re-plan ``ff`` for a mesh that lost ``n_lost`` devices; returns
+    the adopted device count. Leaves params freshly initialized on the
+    new mesh — the caller restores the checkpoint to reshard the real
+    state onto it (``Supervisor._recover_device_loss`` does both)."""
+    t0 = time.perf_counter()
+    old_n = ff.dmesh.num_devices
+    alive = max(1, old_n - max(1, n_lost))
+    bs = int(batch_size or ff.config.batch_size)
+    new_n = surviving_device_count(alive, bs)
+    log.warning(
+        "elastic re-plan: %d -> %d devices (%d lost, batch %d divides "
+        "over %d); re-running strategy search on the shrunken mesh",
+        old_n, new_n, n_lost, bs, new_n)
+    spec = shrunken_spec(ff.dmesh.spec, new_n)
+    # the old mesh's explicit layout cannot describe the survivor set
+    ff.config.mesh_shape = None
+    out_t = ff._output_tensor
+    ff.strategy = None
+    ff.executor = None
+    ff._prebuilt_executor = None
+    with obs_events.span("resilience.replan", old_devices=old_n,
+                         new_devices=new_n):
+        ff.compile(optimizer=ff.optimizer, loss_type=ff.loss_type,
+                   metrics=list(ff.metrics), machine_spec=spec,
+                   output_tensor=out_t)
+    dt = time.perf_counter() - t0
+    status.record("elastic_replans")
+    REGISTRY.counter("ff_elastic_replans_total",
+                     "Strategy re-plans after device loss").inc()
+    REGISTRY.gauge("ff_mesh_devices",
+                   "Devices in the active execution mesh"
+                   ).set(float(ff.dmesh.num_devices))
+    obs_events.counter("resilience.elastic_replan")
+    obs_events.instant("resilience.elastic_replan", old_devices=old_n,
+                       new_devices=ff.dmesh.num_devices, n_lost=n_lost,
+                       replan_s=round(dt, 3))
+    # the searched path wrote a fresh audit record for the new adoption;
+    # stamp it as an elastic re-plan so the decision trail shows WHY the
+    # strategy changed mid-run
+    audit_path = getattr(ff, "_strategy_audit_path", None)
+    if audit_path:
+        from ..obs.audit import annotate_strategy_audit
+        annotate_strategy_audit(audit_path, {
+            "elastic_replan": {"old_devices": old_n,
+                               "new_devices": ff.dmesh.num_devices,
+                               "n_lost": n_lost, "step": ff._step,
+                               "replan_s": round(dt, 3)}})
+    return ff.dmesh.num_devices

@@ -1,0 +1,248 @@
+"""Deterministic fault injection for resilience testing.
+
+The reference has no fault-tolerance story (SURVEY.md §5: "failure
+detection / elastic recovery: absent"); this harness makes failures a
+*first-class, reproducible input* so the recovery paths (supervisor
+restarts, checkpoint fallback, elastic re-plan) are exercised by normal
+tests and CI instead of waiting for a real preemption.
+
+Fault plan grammar (``FF_FAULT_PLAN`` env var or :func:`install`)::
+
+    plan   := clause (';' clause)*          # ',' also accepted
+    clause := kind '@' step [':' arg]
+    kind   := crash | nan | inf | corrupt_ckpt | truncate_ckpt
+              | lose_device                  # aliases: nan_grad, corrupt,
+                                             # truncate, lose
+
+Examples::
+
+    FF_FAULT_PLAN="crash@2"                  # raise SimulatedCrash before
+                                             # global step 2 executes
+    FF_FAULT_PLAN="nan@5"                    # poison params + loss with NaN
+                                             # after step 5 runs
+    FF_FAULT_PLAN="corrupt_ckpt@3"           # flip bytes in the step-3
+                                             # checkpoint right after its save
+    FF_FAULT_PLAN="truncate_ckpt@3"          # truncate its meta.json instead
+    FF_FAULT_PLAN="lose_device@4:2"          # virtual loss of 2 devices
+                                             # before step 4
+    FF_FAULT_PLAN="crash@2;nan@6;lose@9"     # compose freely
+
+Semantics:
+
+  - steps are the **global** train-step counter (``FFModel._step``:
+    number of completed optimizer steps, so "``crash@k``" fires before
+    the k-th step runs and after checkpoint ``k`` — if any — was saved);
+  - every clause fires **exactly once per process**: an in-process
+    restart (the supervisor's recovery loop) does not re-fire it, which
+    is what makes crash-and-resume runs terminate deterministically;
+  - injection sites are the train-step driver (``FFModel.
+    _run_train_step``) and the checkpoint writer
+    (``CheckpointManager``); both check :func:`active` first, so a run
+    with no plan pays one cached attribute read per step.
+
+Every firing is counted in :mod:`.status` (always on) and as an
+``obs.events`` instant + counter (when tracing is enabled).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import List, Optional
+
+from ..obs import events as obs_events
+from . import status
+
+ENV_VAR = "FF_FAULT_PLAN"
+
+#: alias -> canonical kind
+_KINDS = {
+    "crash": "crash",
+    "nan": "nan", "nan_grad": "nan",
+    "inf": "inf",
+    "corrupt_ckpt": "corrupt_ckpt", "corrupt": "corrupt_ckpt",
+    "truncate_ckpt": "truncate_ckpt", "truncate": "truncate_ckpt",
+    "lose_device": "lose_device", "lose": "lose_device",
+}
+
+_CLAUSE_RE = re.compile(r"^([a-z_]+)@(\d+)(?::([A-Za-z0-9_]+))?$")
+
+
+class FaultError(RuntimeError):
+    """Base of all injected failures."""
+
+
+class SimulatedCrash(FaultError):
+    """Injected process crash (``crash@N``)."""
+
+    def __init__(self, step: int):
+        super().__init__(f"injected crash before step {step}")
+        self.step = step
+
+
+class DeviceLoss(FaultError):
+    """Injected loss of ``n_lost`` devices (``lose_device@N:k``) — the
+    supervisor's elastic path catches this and re-plans for the
+    shrunken mesh."""
+
+    def __init__(self, step: int, n_lost: int = 1):
+        super().__init__(
+            f"injected loss of {n_lost} device(s) before step {step}")
+        self.step = step
+        self.n_lost = n_lost
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    step: int
+    arg: Optional[str] = None
+    fired: bool = False
+
+
+class FaultPlan:
+    """An ordered list of one-shot fault clauses."""
+
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults = list(faults or [])
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        faults = []
+        for raw in re.split(r"[;,]", text or ""):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _CLAUSE_RE.match(raw)
+            if m is None:
+                raise ValueError(
+                    f"bad fault clause {raw!r} (grammar: kind@step[:arg], "
+                    f"kinds: {sorted(set(_KINDS.values()))})")
+            kind = _KINDS.get(m.group(1))
+            if kind is None:
+                raise ValueError(
+                    f"unknown fault kind {m.group(1)!r} in {raw!r} "
+                    f"(known: {sorted(_KINDS)})")
+            faults.append(Fault(kind, int(m.group(2)), m.group(3)))
+        return cls(faults)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls.parse(os.environ.get(ENV_VAR, ""))
+
+    # ------------------------------------------------------------------
+    def unfired(self) -> int:
+        return sum(1 for f in self.faults if not f.fired)
+
+    def fire(self, kind: str, step: int) -> Optional[Fault]:
+        """Consume and return the first unfired clause of ``kind`` due
+        at ``step``; None otherwise."""
+        for f in self.faults:
+            if not f.fired and f.kind == kind and f.step == step:
+                f.fired = True
+                status.record_fault(kind, step)
+                obs_events.counter(f"resilience.fault.{kind}")
+                obs_events.instant("resilience.fault_injected",
+                                   kind=kind, step=step, arg=f.arg)
+                return f
+        return None
+
+
+# ---------------------------------------------------------------------------
+# process-wide plan
+# ---------------------------------------------------------------------------
+_plan: Optional[FaultPlan] = None
+
+
+def get_plan() -> FaultPlan:
+    global _plan
+    if _plan is None:
+        _plan = FaultPlan.from_env()
+    return _plan
+
+
+def install(plan) -> FaultPlan:
+    """Set the process-wide plan (a :class:`FaultPlan` or a grammar
+    string); the API analog of the ``FF_FAULT_PLAN`` env var."""
+    global _plan
+    _plan = FaultPlan.parse(plan) if isinstance(plan, str) else plan
+    return _plan
+
+
+def clear() -> None:
+    """Drop the installed plan; the env var is re-read on next use."""
+    global _plan
+    _plan = None
+
+
+def active() -> bool:
+    """Cheap per-step check: does any unfired clause remain?"""
+    return get_plan().unfired() > 0
+
+
+# ---------------------------------------------------------------------------
+# injection sites
+# ---------------------------------------------------------------------------
+def raise_pending(step: int) -> None:
+    """Crash / device-loss clauses due before ``step`` executes."""
+    plan = get_plan()
+    if plan.fire("crash", step) is not None:
+        raise SimulatedCrash(step)
+    f = plan.fire("lose_device", step)
+    if f is not None:
+        raise DeviceLoss(step, n_lost=int(f.arg or 1))
+
+
+def poison_value(step: int) -> Optional[float]:
+    """NaN/Inf gradient-corruption clauses due after ``step`` ran:
+    returns the poison value, or None."""
+    plan = get_plan()
+    if plan.fire("nan", step) is not None:
+        return float("nan")
+    if plan.fire("inf", step) is not None:
+        return float("inf")
+    return None
+
+
+def _pick_state_file(step_dir: str) -> Optional[str]:
+    """The checkpoint payload file to corrupt: the pickle when present,
+    else the largest file under the orbax state dir."""
+    pkl = os.path.join(step_dir, "state.pkl")
+    if os.path.exists(pkl):
+        return pkl
+    sdir = os.path.join(step_dir, "state")
+    best, best_sz = None, -1
+    for root, _, files in os.walk(sdir):
+        for fn in files:
+            p = os.path.join(root, fn)
+            sz = os.path.getsize(p)
+            if sz > best_sz:
+                best, best_sz = p, sz
+    return best
+
+
+def _flip_bytes(path: str) -> None:
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        off = size // 2
+        n = min(64, max(1, size - off))
+        f.seek(off)
+        chunk = f.read(n)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def maybe_corrupt_checkpoint(step: int, step_dir: str) -> None:
+    """Checkpoint-corruption clauses, applied right after the save of
+    ``step`` lands (called by ``CheckpointManager``)."""
+    plan = get_plan()
+    if plan.fire("corrupt_ckpt", step) is not None:
+        target = _pick_state_file(step_dir)
+        if target is not None:
+            _flip_bytes(target)
+    if plan.fire("truncate_ckpt", step) is not None:
+        meta = os.path.join(step_dir, "meta.json")
+        if os.path.exists(meta):
+            with open(meta, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(meta) // 2))

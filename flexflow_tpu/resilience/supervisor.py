@@ -1,0 +1,338 @@
+"""Resilient training driver: bounded restarts, exact resume, rollback.
+
+``FFModel.fit`` assumes the process, the data pipeline, and the machine
+survive the whole run; :class:`Supervisor` drops that assumption. It
+drives the same per-step machinery (``ff._run_train_step`` over a
+``SingleDataLoader``) inside a recovery loop:
+
+  - **auto-resume**: on start, the newest *valid* checkpoint in the
+    directory is restored — model state via the re-placing
+    ``restore_model_checkpoint`` path, dataloader position (rng state,
+    epoch, batch index) from the checkpoint metadata — so a resumed run
+    replays the exact remaining batches;
+  - **bounded restarts**: any step failure (a real exception or an
+    injected :class:`~flexflow_tpu.resilience.faults.SimulatedCrash`)
+    consumes one unit of the restart budget, sleeps an exponential
+    backoff with jitter, restores, and continues; budget exhausted →
+    the last error propagates;
+  - **NaN rollback**: a non-finite loss never reaches a checkpoint —
+    the step is detected before the periodic save, the run rolls back
+    to the last good checkpoint, and the rollback is counted;
+  - **elastic re-plan**: an injected (or detected)
+    :class:`~flexflow_tpu.resilience.faults.DeviceLoss` triggers
+    :func:`~flexflow_tpu.resilience.elastic.replan_on_device_loss` —
+    re-search on the shrunken mesh, reshard the restored state, rebuild
+    the loader on the new strategy — and training continues.
+
+Checkpoints are the hardened atomic kind (``runtime/checkpoint.py``);
+``async_save=True`` overlaps the file writes with the next train steps.
+Everything reports into ``obs``: restart/rollback counters, a
+time-since-last-checkpoint gauge, save/restore spans, and the always-on
+:mod:`.status` block that ``/healthz`` serves.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import events as obs_events
+from ..obs.metrics_registry import REGISTRY
+from . import status
+from .faults import DeviceLoss, SimulatedCrash  # noqa: F401 (re-export)
+
+log = logging.getLogger("flexflow_tpu")
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """The supervisor ran out of restarts; the cause is ``__cause__``."""
+
+
+class _NonFiniteLoss(RuntimeError):
+    def __init__(self, step: int, value: float):
+        super().__init__(f"non-finite loss {value} at step {step}")
+        self.step = step
+        self.value = value
+
+
+class Supervisor:
+    """Wraps a compiled :class:`FFModel` in a crash/corruption/device-loss
+    tolerant train loop. See the module docstring for semantics.
+
+    ``checkpoint_every`` is in optimizer steps; ``max_restarts`` bounds
+    recoveries of EVERY kind (crash, NaN rollback, device loss) across
+    the whole run."""
+
+    def __init__(self, ff, directory: str, *,
+                 checkpoint_every: int = 1, max_to_keep: int = 3,
+                 max_restarts: int = 3, backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0, backoff_jitter: float = 0.25,
+                 async_save: bool = False, elastic: bool = True,
+                 verbose: bool = False):
+        from ..runtime.checkpoint import CheckpointManager
+        self.ff = ff
+        self.directory = directory
+        self.checkpoint_every = max(1, checkpoint_every)
+        self.max_restarts = max_restarts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.backoff_jitter = backoff_jitter
+        self.async_save = async_save
+        self.elastic = elastic
+        self.verbose = verbose
+        self.restarts = 0
+        self.nan_rollbacks = 0
+        self.elastic_replans = 0
+        self._mgr = CheckpointManager(directory, max_to_keep=max_to_keep,
+                                      async_save=async_save)
+        self._since_ckpt = 0
+        self._last_save_t: Optional[float] = None
+        self._run_args: Optional[tuple] = None
+        self._nan_steps: set = set()
+
+    # ------------------------------------------------------------------
+    def run(self, x=None, y=None, epochs: Optional[int] = None,
+            batch_size: Optional[int] = None, shuffle: bool = True,
+            callbacks=None) -> List[Dict[str, float]]:
+        """Train to completion (the resilient ``fit``); returns the
+        per-epoch history. Resumes automatically from the newest valid
+        checkpoint in ``directory`` when one exists. ``callbacks`` get
+        the same per-epoch ``on_epoch_end(epoch, logs, model)`` contract
+        as ``fit`` (a stop request ends the run after the epoch's
+        checkpoint)."""
+        ff = self.ff
+        assert ff.executor is not None, "call compile() first"
+        epochs = epochs or ff.config.epochs
+        self._run_args = (x, y, batch_size, shuffle)
+        loader = ff._combined_loader(x, y, batch_size, shuffle=shuffle)
+        if not self._try_resume(loader):
+            loader.reset()
+            loader.epoch = 0
+            self._save(loader)  # step-0 restore point: recovery always
+            #                     has somewhere to land, even pre-ckpt-1
+        history: List[Dict[str, float]] = []
+        while loader.epoch < epochs:
+            try:
+                rep = self._run_epoch(loader)
+                epoch_done = loader.epoch
+                loader.epoch += 1
+                if loader.epoch < epochs:
+                    loader.reset()
+                # epoch-boundary save so a later resume lands in the
+                # right epoch with the fresh shuffle order; history is
+                # appended only AFTER it succeeds — a failed save
+                # triggers recovery, which replays the tail and must
+                # not find the epoch already recorded
+                self._save(loader)
+                if rep is not None:
+                    history.append(rep)
+                    if callbacks:
+                        # same contract as fit(); runs after the
+                        # boundary save so a callback crash never
+                        # loses the epoch
+                        stop = False
+                        for cb in callbacks:
+                            cb.on_epoch_end(epoch_done, rep, ff)
+                            stop = stop or getattr(cb, "stop_requested",
+                                                   False)
+                        if stop:
+                            break
+            except _NonFiniteLoss as e:
+                if e.step in self._nan_steps:
+                    # the rollback replays the exact same batch into the
+                    # exact same params (that is what makes injected-
+                    # fault recovery deterministic) — so a GENUINE
+                    # divergence recurs identically; fail now instead of
+                    # burning the remaining budget on doomed replays
+                    raise RestartBudgetExceeded(
+                        f"non-finite loss at step {e.step} recurred "
+                        f"after rollback (deterministic divergence, not "
+                        f"a transient)") from e
+                self._nan_steps.add(e.step)
+                self.nan_rollbacks += 1
+                status.record("nan_rollbacks")
+                self._recover(loader, reason="nan_loss", err=e)
+            except DeviceLoss as e:
+                loader = self._recover_device_loss(loader, e)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — that's the job
+                self._recover(loader, reason=type(e).__name__, err=e)
+        self._mgr.wait()
+        ff._current_metrics = history[-1] if history else {}
+        if getattr(ff.config, "trace_export_file", ""):
+            # same end-of-training export hook as fit()
+            from ..obs.trace_export import export_chrome_trace
+            if obs_events.enabled():
+                export_chrome_trace(ff.config.trace_export_file)
+        return history
+
+    # ------------------------------------------------------------------
+    def _run_epoch(self, loader) -> Optional[Dict[str, float]]:
+        from ..runtime.metrics import PerfMetrics
+        ff = self.ff
+        step_fn = ff.executor.make_train_step()
+        pm = PerfMetrics()
+        t0 = time.perf_counter()
+        nb = 0
+        while True:
+            batch = loader.next_batch()
+            if batch is None:
+                break
+            bm = ff._run_train_step(step_fn, batch)
+            # the sync is load-bearing twice over: it surfaces async
+            # device errors at the step that caused them, and it is the
+            # NaN check that must run BEFORE the periodic save below
+            # (a poisoned state must never reach a checkpoint)
+            loss = float(np.asarray(bm["loss"]))
+            if not math.isfinite(loss):
+                raise _NonFiniteLoss(ff._step - 1, loss)
+            bsz = next(iter(batch.values())).shape[0]
+            pm.update({k: np.asarray(v) for k, v in bm.items()}, bsz)
+            nb += 1
+            # dynamic recompilation hook — same contract as fit()
+            # (model.py: reference RecompileState, model.cc:2422)
+            rs = getattr(ff, "_recompile_state", None)
+            if rs is not None and rs.step(ff):
+                step_fn = ff.executor.make_train_step()
+            self._since_ckpt += 1
+            if self._since_ckpt >= self.checkpoint_every:
+                self._save(loader)
+            self._update_ckpt_age_gauge()
+            if self.verbose and nb % ff.config.print_freq == 0:
+                rep = pm.report()
+                msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
+                print(f"epoch {loader.epoch} iter {nb}/"
+                      f"{loader.num_batches} {msg}")
+        if nb == 0:
+            # resumed from a checkpoint taken at the epoch's last batch
+            # (killed before the boundary save overwrote it): nothing
+            # left to run — report None so a metric-less {} never lands
+            # in history (consumers index history[-1]["loss"])
+            return None
+        dt = time.perf_counter() - t0
+        rep = pm.report()
+        rep["epoch_time_s"] = dt
+        rep["samples_per_sec"] = pm.train_all / dt if dt > 0 else 0.0
+        obs_events.record_span("supervisor.epoch", t0, dt,
+                               epoch=loader.epoch, batches=nb)
+        REGISTRY.gauge(
+            "ff_train_samples_per_sec",
+            "Training throughput of the last completed epoch"
+        ).set(rep["samples_per_sec"])
+        return rep
+
+    # ------------------------------------------------------------------
+    def _save(self, loader) -> None:
+        from ..runtime.checkpoint import save_model_checkpoint
+        t0 = time.perf_counter()
+        save_model_checkpoint(
+            self.ff, self.directory, manager=self._mgr,
+            extra_metadata={"loader": loader.state_dict(),
+                            "supervisor": {"restarts": self.restarts}},
+            blocking=not self.async_save)
+        self._since_ckpt = 0
+        self._last_save_t = time.monotonic()
+        self._update_ckpt_age_gauge()
+        obs_events.record_span("supervisor.save", t0,
+                               time.perf_counter() - t0,
+                               step=self.ff._step,
+                               blocking=not self.async_save)
+
+    def _try_resume(self, loader) -> bool:
+        if self._mgr.latest_step() is None:
+            return False
+        try:
+            self._restore(loader)
+        except FileNotFoundError:
+            return False  # every step corrupt: start fresh
+        log.info("supervisor: resumed from checkpoint step %d "
+                 "(epoch %d, batch %d)", self.ff._step, loader.epoch,
+                 loader.idx)
+        return True
+
+    def _restore(self, loader) -> None:
+        from ..runtime.checkpoint import restore_model_checkpoint
+        self._mgr.wait()
+        step, meta = restore_model_checkpoint(self.ff, self.directory,
+                                              with_meta=True)
+        ld = meta.get("loader")
+        if ld is not None:
+            loader.load_state_dict(ld)
+        else:
+            loader.reset()
+        self._since_ckpt = 0
+
+    # ------------------------------------------------------------------
+    def _consume_restart(self, reason: str, err: BaseException) -> None:
+        self.restarts += 1
+        status.record("restarts")
+        REGISTRY.counter("ff_resilience_restarts_total",
+                         "Supervisor recoveries, any cause"
+                         ).inc(reason=reason)
+        obs_events.counter("resilience.restart")
+        obs_events.instant("resilience.restart", reason=reason,
+                           step=self.ff._step, attempt=self.restarts)
+        if self.restarts > self.max_restarts:
+            raise RestartBudgetExceeded(
+                f"restart budget ({self.max_restarts}) exhausted; "
+                f"last failure: {reason}: {err}") from err
+        log.warning("supervisor: recovering from %s at step %d "
+                    "(restart %d/%d): %s", reason, self.ff._step,
+                    self.restarts, self.max_restarts, err)
+
+    def _backoff(self) -> None:
+        delay = min(self.backoff_max_s,
+                    self.backoff_base_s * (2 ** (self.restarts - 1)))
+        delay *= 1.0 + self.backoff_jitter * random.random()
+        time.sleep(delay)
+
+    def _recover(self, loader, reason: str, err: BaseException) -> None:
+        self._consume_restart(reason, err)
+        self._backoff()
+        self._restore(loader)
+
+    def _recover_device_loss(self, loader, err: DeviceLoss):
+        """Elastic path: re-plan the strategy for the shrunken mesh,
+        reshard the restored state onto it, rebuild the loader (its
+        shardings reference the dead mesh), and resume in place."""
+        if not self.elastic:
+            raise err
+        self._consume_restart("device_loss", err)
+        self._backoff()
+        from .elastic import replan_on_device_loss
+        self._mgr.wait()
+        replan_on_device_loss(self.ff, err.n_lost)
+        self.elastic_replans += 1
+        x, y, batch_size, shuffle = self._run_args
+        new_loader = self.ff._combined_loader(x, y, batch_size,
+                                              shuffle=shuffle)
+        new_loader.epoch = loader.epoch
+        self._restore(new_loader)
+        return new_loader
+
+    # ------------------------------------------------------------------
+    def _update_ckpt_age_gauge(self) -> None:
+        if self._last_save_t is not None:
+            REGISTRY.gauge(
+                "ff_time_since_last_checkpoint_seconds",
+                "Age of the newest completed checkpoint"
+            ).set(time.monotonic() - self._last_save_t)
+
+
+def run_supervised(ff, directory: str, x=None, y=None,
+                   epochs: Optional[int] = None,
+                   batch_size: Optional[int] = None,
+                   shuffle: bool = True, callbacks=None,
+                   **supervisor_kwargs) -> List[Dict[str, float]]:
+    """One-call resilient training: ``fit`` semantics under a
+    :class:`Supervisor` (auto-resume + bounded restarts + rollback +
+    elastic re-plan). ``run()``'s loop options are explicit parameters;
+    ``supervisor_kwargs`` configure the :class:`Supervisor` itself."""
+    sup = Supervisor(ff, directory, **supervisor_kwargs)
+    return sup.run(x=x, y=y, epochs=epochs, batch_size=batch_size,
+                   shuffle=shuffle, callbacks=callbacks)

@@ -33,7 +33,17 @@ class SingleDataLoader:
         self.rng = np.random.default_rng(seed)
         self.drop_remainder = drop_remainder
         self.idx = 0
+        # which epoch this loader position belongs to — maintained by
+        # the training driver (the supervisor persists/restores it);
+        # plain fit() leaves it at 0
+        self.epoch = 0
         self._order = np.arange(self.num_samples)
+        # rng state as of the start of the current epoch (BEFORE its
+        # shuffle) + whether that shuffle has been applied: together
+        # they re-derive `_order` exactly, so state_dict stays O(1)
+        # instead of serializing the full permutation
+        self._epoch_rng_state = self.rng.bit_generator.state
+        self._shuffled = False
         self._next_prefetched = None
 
     @property
@@ -45,8 +55,56 @@ class SingleDataLoader:
     def reset(self):
         self.idx = 0
         self._next_prefetched = None
+        # fresh permutation from arange (not an in-place reshuffle of
+        # the previous order): the order is then a pure function of
+        # (_epoch_rng_state, shuffle), which is what lets state_dict
+        # persist O(1) rng state instead of the permutation itself
+        self._epoch_rng_state = self.rng.bit_generator.state
+        self._order = np.arange(self.num_samples)
+        self._shuffled = False
         if self.shuffle:
             self.rng.shuffle(self._order)
+            self._shuffled = True
+
+    # ------------------------------------------------------------------
+    # resumable state (resilience supervisor: exact mid-epoch resume)
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        """JSON-serializable loader position: rng state (as of epoch
+        start), epoch, and batch position — O(1), never the sample
+        permutation. ``load_state_dict`` of this snapshot replays the
+        exact remaining batches, including every later epoch's shuffle,
+        by re-deriving the order from the saved rng state."""
+        return {
+            "idx": int(self.idx),
+            "epoch": int(self.epoch),
+            "num_samples": int(self.num_samples),
+            "batch_size": int(self.batch_size),
+            "rng_state": self._epoch_rng_state,
+            "shuffled": bool(self._shuffled),
+        }
+
+    def load_state_dict(self, sd) -> None:
+        assert sd.get("num_samples", self.num_samples) \
+            == self.num_samples, \
+            (f"loader state for {sd.get('num_samples')} samples restored "
+             f"into a {self.num_samples}-sample dataset")
+        # idx counts BATCHES: a different batch size would silently
+        # reposition the sample stream
+        assert sd.get("batch_size", self.batch_size) == self.batch_size, \
+            (f"loader state saved with batch_size "
+             f"{sd.get('batch_size')} restored into a loader with "
+             f"batch_size {self.batch_size}")
+        self.idx = int(sd["idx"])
+        self.epoch = int(sd.get("epoch", 0))
+        self.rng.bit_generator.state = sd["rng_state"]
+        self._epoch_rng_state = sd["rng_state"]
+        self._order = np.arange(self.num_samples)
+        self._shuffled = False
+        if sd.get("shuffled"):
+            self.rng.shuffle(self._order)  # rng lands post-shuffle
+            self._shuffled = True
+        self._next_prefetched = None  # re-prefetched on next next_batch
 
     def _device_put(self, batch: Dict[str, np.ndarray]):
         from ..parallel.distributed import put_global

@@ -331,11 +331,18 @@ def load_legacy_strategies(path: str, layers, dmesh: DeviceMesh,
         toks = f.read().split()
     pos = 0
     banked_names = set()
+    sidecar = path + ".banks.json"
+    sidecar_present = True
     try:
-        with open(path + ".banks.json") as f:
+        with open(sidecar) as f:
             banked_names = set(json.load(f).get("banked_ops", ()))
     except OSError:
-        pass
+        sidecar_present = False
+    # rows whose flat ids are a device-id prefix are ambiguous without
+    # the sidecar: a bank's true device subset and an axis assignment's
+    # representative-per-shard pattern can be byte-identical (see
+    # save_legacy_strategies); collected below to warn once per import
+    ambiguous_rows = []
 
     def take() -> str:
         nonlocal pos
@@ -371,6 +378,14 @@ def load_legacy_strategies(path: str, layers, dmesh: DeviceMesh,
             # id pattern — including prefix-shaped ids, which on a
             # multi-axis mesh may correspond to a LAST (stride-1) axis,
             # not the greedy first one
+            if not sidecar_present and ids == list(range(len(ids))) \
+                    and 1 < len(ids) < dmesh.num_devices:
+                # prefix-shaped ids on a proper device subset: exactly
+                # what an exported bank row looks like once the sidecar
+                # that would flag it is gone — checked BEFORE the axis
+                # reconstruction below, because a prefix can ALSO match
+                # a (stride-1) axis assignment and import cleanly
+                ambiguous_rows.append(name)
             entries = _axes_from_flat_ids(degs, ids, dmesh)
             if entries is not None:
                 st.ops[name] = OpSharding([P(*entries)], {})
@@ -411,6 +426,16 @@ def load_legacy_strategies(path: str, layers, dmesh: DeviceMesh,
                 del free[ax]
             entries.append(got[0] if len(got) == 1 else tuple(got))
         st.ops[name] = OpSharding([P(*entries)], {})
+    if ambiguous_rows:
+        import logging
+        logging.getLogger("flexflow_tpu").warning(
+            "strategy file %s: %d op row(s) (%s%s) have device-subset-"
+            "shaped ids but no %s sidecar was found; if this file was "
+            "exported from a bank-capable strategy those rows are BANK "
+            "placements being imported as regular axis shardings — "
+            "restore the sidecar or use the JSON strategy format",
+            path, len(ambiguous_rows), ", ".join(ambiguous_rows[:4]),
+            "..." if len(ambiguous_rows) > 4 else "", sidecar)
     return st
 
 

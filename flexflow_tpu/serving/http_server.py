@@ -79,7 +79,13 @@ def get_route(path: str, repo, schedulers):
     policy, both fronts)."""
     obs_events.counter("serving.http_requests")
     if path in ("/v2/health/ready", "/healthz"):
-        return 200, {"status": "ok", "ready": True}
+        # resilience block (resilience/status.py): restart/fault/
+        # checkpoint facts + checkpoint age, so a liveness probe can
+        # alert on "restarting in a loop" or "checkpoints stale" — both
+        # invisible to a bare 200
+        from ..resilience import status as resilience_status
+        return 200, {"status": "ok", "ready": True,
+                     "resilience": resilience_status.health_fields()}
     if path == "/metrics":
         return 200, render_prometheus(schedulers)
     if path == "/v2/models":

@@ -57,6 +57,13 @@ def _scripted(default_probe_results):
                 in env.get("XLA_FLAGS", "")
             return {"wrapped_step_s": 0.001, "raw_step_s": 0.001,
                     "overhead_pct": 0.1, "ok": True}, None
+        if stage == "recovery":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"baseline_step_s": 0.1, "ckpt_sync_overhead_pct": 2.3,
+                    "ckpt_async_overhead_pct": 1.1, "ckpt_every": 10,
+                    "time_to_recover_s": 0.5, "ok": True}, None
         raise AssertionError(f"unexpected stage {args}")
 
     return fake_run_stage, calls
@@ -121,3 +128,8 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         # measured percentage reaches the driver JSON
         assert out["obs_overhead_pct"] == 0.1
         assert any(a[1] == "obs_overhead" for a, _ in calls)
+        # so does the checkpoint-overhead + time-to-recover leg
+        assert out["ckpt_async_overhead_pct"] == 1.1
+        assert out["ckpt_sync_overhead_pct"] == 2.3
+        assert out["time_to_recover_s"] == 0.5
+        assert any(a[1] == "recovery" for a, _ in calls)

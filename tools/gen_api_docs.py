@@ -36,6 +36,8 @@ SECTIONS = [
     ("flexflow_tpu.serving", "inference serving (sessions/batcher/HTTP)"),
     ("flexflow_tpu.obs",
      "telemetry (spans, Prometheus metrics, strategy audit records)"),
+    ("flexflow_tpu.resilience",
+     "fault injection, supervisor auto-resume, elastic re-plan"),
     ("flexflow_tpu.utils", "profiling, logging, compilation cache"),
 ]
 

@@ -30,6 +30,11 @@ case "${1:-fast}" in
     # from its checkpoints and complete — the resilience subsystem's
     # recovery path exercised on every push, not just in unit tests
     FF_FAULT_PLAN="crash@2" python tools/resilience_smoke.py
+    # async-dispatch parity smoke: the same tiny fit with
+    # FF_SYNC_EVERY_STEP=1 and with the default deferred loop must
+    # reach IDENTICAL final losses — the async path can never silently
+    # diverge from the sync-every-step semantics
+    python tools/async_parity_smoke.py
     ;;
   slow)
     python -m pytest tests/ -q -m slow

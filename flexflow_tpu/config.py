@@ -130,6 +130,18 @@ class FFConfig:
     # fp32 masters, losses/norms still reduce in fp32 internally.
     # Off by default — enable for MFU on bandwidth-bound models.
     bf16_activations: bool = False
+    # async-dispatch training loop (runtime/metrics_buffer.py): how many
+    # train steps the host may keep in flight before blocking on the
+    # step leaving the window; per-step metrics stay device-resident
+    # and are fetched in one device_get at print_freq/epoch boundaries.
+    # <= 0 forces the sync-every-step fallback (also FF_SYNC_EVERY_STEP=1
+    # / --sync-every-step) — fetch and NaN-screen every step, for
+    # debugging. See docs/performance.md.
+    async_dispatch_steps: int = 8
+    # dataloader prefetch depth (runtime/dataloader.py): device batches
+    # dispatched ahead of consumption; 0 disables, 1 is the old
+    # single-slot double-buffer
+    prefetch_batches: int = 2
     # persistent XLA compilation cache dir; "" = off unless
     # JAX_COMPILATION_CACHE_DIR is set (see utils/compilation_cache.py)
     compilation_cache_dir: str = ""
@@ -316,6 +328,12 @@ class FFConfig:
                 cfg.banked_placement = take()
             elif a == "--pipeline-ragged":
                 cfg.pipeline_ragged = take()
+            elif a == "--async-dispatch-steps":
+                cfg.async_dispatch_steps = int(take())
+            elif a == "--sync-every-step":
+                cfg.async_dispatch_steps = 0
+            elif a == "--prefetch-batches":
+                cfg.prefetch_batches = int(take())
             elif a == "--seed":
                 cfg.seed = int(take())
             # unknown flags: skip (reference forwards to Legion)

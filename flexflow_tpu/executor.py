@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -47,10 +48,22 @@ def _npdt(dtype) -> "np.dtype":
     return np.dtype(to_jnp(dtype))
 
 
+def _trace_sync_on() -> bool:
+    """``FF_TRACE_SYNC=1``: block on the step's outputs inside the
+    instrumentation span so it measures TRUE step latency instead of
+    dispatch time (the async-dispatch loop otherwise returns as soon as
+    XLA enqueues the step). Read per call — only on the traced path —
+    so a debug session can toggle it without rebuilding the step."""
+    from .obs.events import _env_on
+    return _env_on(os.environ.get("FF_TRACE_SYNC"))
+
+
 def _instrument_step(fn, name: str):
     """Wrap a jitted step with per-step telemetry: a span per call with
     the compile-vs-steady split (the FIRST call of a fresh jit traces +
     compiles; later calls replay the executable) and a step counter.
+    With ``FF_TRACE_SYNC=1`` the span additionally blocks on the step's
+    outputs, so it records device latency, not dispatch latency.
 
     Disabled-mode cost is one flag check plus an int increment — the
     bench's obs-overhead leg pins this at <= 3% of a train step, and the
@@ -72,7 +85,10 @@ def _instrument_step(fn, name: str):
         with obs_events.span(f"executor.{name}_step",
                              phase="compile" if n == 0 else "steady",
                              step=n):
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+            if _trace_sync_on():
+                jax.block_until_ready(out)
+            return out
 
     wrapped.__wrapped__ = fn
     for attr in ("lower", "trace", "eval_shape", "clear_cache"):
@@ -1078,6 +1094,13 @@ class Executor:
                     return jnp.mean(v, axis=0)
 
                 bm = {k: reduce_metric(k, v) for k, v in bms.items()}
+            # fused NaN screen for the deferred-metrics loop
+            # (runtime/metrics_buffer.py): the host checks this flag at
+            # flush points instead of fetching the loss every step.
+            # LOSS-only on purpose — the old per-step screen checked
+            # only the loss, and an auxiliary metric overflowing float32
+            # on its own must not trigger a supervisor rollback
+            bm["all_finite"] = jnp.all(jnp.isfinite(bm["loss"]))
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, step + 1)
             if self.opt_state_constraints is not None:

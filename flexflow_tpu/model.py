@@ -39,6 +39,7 @@ from .parallel.machine import DeviceMesh, MachineSpec
 from .parallel.strategy import ShardingStrategy
 from .runtime.dataloader import SingleDataLoader
 from .runtime.metrics import PerfMetrics
+from .runtime.metrics_buffer import MetricsBuffer
 from .runtime.optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 
 _LOSS_NAMES = {
@@ -82,6 +83,10 @@ class FFModel:
         self._output_tensor: Optional[Tensor] = None
         self._dataloaders: List[Tuple[Tensor, np.ndarray]] = []
         self._current_metrics: Optional[Dict[str, float]] = None
+        # live deferred-metrics accumulator while a training driver
+        # (fit / resilience supervisor) is running — checkpoint saves
+        # flush + NaN-screen through it (runtime/metrics_buffer.py)
+        self._metrics_buffer: Optional[MetricsBuffer] = None
 
     # ==================================================================
     # graph construction helpers
@@ -768,59 +773,90 @@ class FFModel:
             batch_axes = ospec[0] if ospec and len(ospec) > 0 else None
             shardings["label"] = NamedSharding(self.dmesh.mesh, P(batch_axes))
         return SingleDataLoader(arrays, bs, shardings, shuffle=shuffle,
-                                seed=self.config.seed)
+                                seed=self.config.seed,
+                                prefetch=self.config.prefetch_batches)
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, callbacks=None, verbose=True):
         """Training loop (reference ``flexflow_cffi.py:2062-2104``; Legion
-        trace ≙ jit cache)."""
+        trace ≙ jit cache).
+
+        Async dispatch: per-step metrics stay device-resident in a
+        :class:`MetricsBuffer` and are fetched in ONE ``device_get`` at
+        ``print_freq``/epoch boundaries (the reference gets the same
+        overlap from Legion's deferred futures); a bounded in-flight
+        window (``config.async_dispatch_steps``) keeps the host from
+        racing ahead. ``FF_SYNC_EVERY_STEP=1`` restores the old
+        fetch-every-step loop for debugging."""
         assert self.executor is not None, "call compile() first"
         epochs = epochs or self.config.epochs
         loader = self._combined_loader(x, y, batch_size)
         history = []
-        for epoch in range(epochs):
-            # re-fetch per epoch: callbacks (e.g. LearningRateScheduler)
-            # may invalidate the jitted step to apply new hyperparams
-            step_fn = self.executor.make_train_step()
-            pm = PerfMetrics()
-            t0 = time.perf_counter()
-            nb = 0
-            for batch in loader:
-                bm = self._run_train_step(step_fn, batch)
-                bsz = next(iter(batch.values())).shape[0]
-                pm.update({k: np.asarray(v) for k, v in bm.items()}, bsz)
-                nb += 1
-                # dynamic recompilation hook (reference model.cc:2422)
-                rs = getattr(self, "_recompile_state", None)
-                if rs is not None and rs.step(self):
-                    step_fn = self.executor.make_train_step()
-                if verbose and nb % self.config.print_freq == 0:
-                    rep = pm.report()
+        # the buffer stays attached through the epoch-end callbacks
+        # (their checkpoint saves screen through it) and is detached
+        # when fit ends — INCLUDING on exceptions, or a stale poisoned
+        # buffer would block save_checkpoint of later clean params
+        try:
+            for epoch in range(epochs):
+                # re-fetch per epoch: callbacks (e.g.
+                # LearningRateScheduler) may invalidate the jitted step
+                # to apply new hyperparams
+                step_fn = self.executor.make_train_step()
+                pm = PerfMetrics()
+                buf = MetricsBuffer.for_config(self.config, pm=pm)
+                self._metrics_buffer = buf
+                t0 = time.perf_counter()
+                nb = 0
+                for batch in loader:
+                    bm = self._run_train_step(step_fn, batch)
+                    bsz = next(iter(batch.values())).shape[0]
+                    buf.push(self._step - 1, bm, bsz)
+                    nb += 1
+                    # dynamic recompilation hook (reference model.cc:2422)
+                    rs = getattr(self, "_recompile_state", None)
+                    if rs is not None and rs.step(self):
+                        step_fn = self.executor.make_train_step()
+                    pf = self.config.print_freq
+                    if pf > 0 and nb % pf == 0:
+                        # flush REGARDLESS of verbosity: print_freq is
+                        # the metric-fetch cadence, not just the print
+                        # cadence (pending device scalars must not pile
+                        # up for a whole quiet epoch)
+                        buf.flush()
+                        if verbose:
+                            rep = pm.report()
+                            msg = " ".join(f"{k}={v:.4f}"
+                                           for k, v in rep.items())
+                            print(f"epoch {epoch} iter "
+                                  f"{nb}/{loader.num_batches} {msg}")
+                buf.flush()
+                dt = time.perf_counter() - t0
+                rep = pm.report()
+                rep["epoch_time_s"] = dt
+                rep["samples_per_sec"] = pm.train_all / dt if dt > 0 \
+                    else 0.0
+                from .obs import events as obs_events
+                from .obs.metrics_registry import REGISTRY
+                obs_events.record_span("fit.epoch", t0, dt, epoch=epoch,
+                                       batches=nb)
+                REGISTRY.gauge(
+                    "ff_train_samples_per_sec",
+                    "Training throughput of the last completed epoch"
+                ).set(rep["samples_per_sec"])
+                history.append(rep)
+                if verbose:
                     msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
-                    print(f"epoch {epoch} iter {nb}/{loader.num_batches} {msg}")
-            dt = time.perf_counter() - t0
-            rep = pm.report()
-            rep["epoch_time_s"] = dt
-            rep["samples_per_sec"] = pm.train_all / dt if dt > 0 else 0.0
-            from .obs import events as obs_events
-            from .obs.metrics_registry import REGISTRY
-            obs_events.record_span("fit.epoch", t0, dt, epoch=epoch,
-                                   batches=nb)
-            REGISTRY.gauge(
-                "ff_train_samples_per_sec",
-                "Training throughput of the last completed epoch"
-            ).set(rep["samples_per_sec"])
-            history.append(rep)
-            if verbose:
-                msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
-                print(f"epoch {epoch} done: {msg}")
-            if callbacks:
-                stop = False
-                for cb in callbacks:
-                    cb.on_epoch_end(epoch, rep, self)
-                    stop = stop or getattr(cb, "stop_requested", False)
-                if stop:
-                    break
+                    print(f"epoch {epoch} done: {msg}")
+                if callbacks:
+                    stop = False
+                    for cb in callbacks:
+                        cb.on_epoch_end(epoch, rep, self)
+                        stop = stop or getattr(cb, "stop_requested",
+                                               False)
+                    if stop:
+                        break
+        finally:
+            self._metrics_buffer = None
         self._current_metrics = history[-1] if history else {}
         if self.config.trace_export_file:
             from .obs import events as obs_events
@@ -848,7 +884,13 @@ class FFModel:
                     lambda a: (a * poison).astype(a.dtype)
                     if jnp.issubdtype(a.dtype, jnp.inexact) else a,
                     self.params)
-                bm = dict(bm, loss=poison)
+                # the in-jit all_finite flag saw the CLEAN loss; the
+                # host-side poison must flip it or the deferred NaN
+                # screen would wave the poisoned step through
+                bm = dict(bm, loss=poison,
+                          all_finite=jnp.logical_and(
+                              bm.get("all_finite", True),
+                              jnp.isfinite(poison)))
         self._step += 1
         return bm
 
@@ -1272,6 +1314,13 @@ class FFModel:
     def save_checkpoint(self, directory: str, step: Optional[int] = None,
                         max_to_keep: int = 3):
         from .runtime.checkpoint import save_model_checkpoint
+        buf = self._metrics_buffer
+        if buf is not None:
+            # deferred NaN screen ALWAYS runs before a checkpoint save:
+            # pending steps are flushed and a non-finite one raises here
+            # — a poisoned state must never reach a checkpoint
+            buf.flush()
+            buf.raise_if_poisoned()
         return save_model_checkpoint(self, directory, step, max_to_keep)
 
     def restore_checkpoint(self, directory: str,

@@ -33,15 +33,13 @@ time-since-last-checkpoint gauge, save/restore spans, and the always-on
 from __future__ import annotations
 
 import logging
-import math
 import random
 import time
-from typing import Any, Dict, List, Optional
-
-import numpy as np
+from typing import Dict, List, Optional
 
 from ..obs import events as obs_events
 from ..obs.metrics_registry import REGISTRY
+from ..runtime.metrics_buffer import MetricsBuffer, NonFiniteMetrics
 from . import status
 from .faults import DeviceLoss, SimulatedCrash  # noqa: F401 (re-export)
 
@@ -52,11 +50,9 @@ class RestartBudgetExceeded(RuntimeError):
     """The supervisor ran out of restarts; the cause is ``__cause__``."""
 
 
-class _NonFiniteLoss(RuntimeError):
-    def __init__(self, step: int, value: float):
-        super().__init__(f"non-finite loss {value} at step {step}")
-        self.step = step
-        self.value = value
+# the NaN-rollback trigger now carries the first bad step index found by
+# the deferred flush (runtime/metrics_buffer.py); old alias kept
+_NonFiniteLoss = NonFiniteMetrics
 
 
 class Supervisor:
@@ -93,6 +89,11 @@ class Supervisor:
         self._last_save_t: Optional[float] = None
         self._run_args: Optional[tuple] = None
         self._nan_steps: set = set()
+        # live deferred-metrics buffer while _run_epoch is driving
+        # steps; _save flushes + NaN-screens through it so a poisoned
+        # state can never reach a checkpoint (the PR-3 invariant under
+        # async dispatch)
+        self._buffer: Optional[MetricsBuffer] = None
 
     # ------------------------------------------------------------------
     def run(self, x=None, y=None, epochs: Optional[int] = None,
@@ -141,7 +142,7 @@ class Supervisor:
                                                    False)
                         if stop:
                             break
-            except _NonFiniteLoss as e:
+            except NonFiniteMetrics as e:
                 if e.step in self._nan_steps:
                     # the rollback replays the exact same batch into the
                     # exact same params (that is what makes injected-
@@ -177,37 +178,51 @@ class Supervisor:
         ff = self.ff
         step_fn = ff.executor.make_train_step()
         pm = PerfMetrics()
+        buf = MetricsBuffer.for_config(ff.config, pm=pm)
+        self._buffer = buf
+        ff._metrics_buffer = buf  # ff.save_checkpoint screens through it
         t0 = time.perf_counter()
         nb = 0
-        while True:
-            batch = loader.next_batch()
-            if batch is None:
-                break
-            bm = ff._run_train_step(step_fn, batch)
-            # the sync is load-bearing twice over: it surfaces async
-            # device errors at the step that caused them, and it is the
-            # NaN check that must run BEFORE the periodic save below
-            # (a poisoned state must never reach a checkpoint)
-            loss = float(np.asarray(bm["loss"]))
-            if not math.isfinite(loss):
-                raise _NonFiniteLoss(ff._step - 1, loss)
-            bsz = next(iter(batch.values())).shape[0]
-            pm.update({k: np.asarray(v) for k, v in bm.items()}, bsz)
-            nb += 1
-            # dynamic recompilation hook — same contract as fit()
-            # (model.py: reference RecompileState, model.cc:2422)
-            rs = getattr(ff, "_recompile_state", None)
-            if rs is not None and rs.step(ff):
-                step_fn = ff.executor.make_train_step()
-            self._since_ckpt += 1
-            if self._since_ckpt >= self.checkpoint_every:
-                self._save(loader)
-            self._update_ckpt_age_gauge()
-            if self.verbose and nb % ff.config.print_freq == 0:
-                rep = pm.report()
-                msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
-                print(f"epoch {loader.epoch} iter {nb}/"
-                      f"{loader.num_batches} {msg}")
+        try:
+            while True:
+                batch = loader.next_batch()
+                if batch is None:
+                    break
+                bm = ff._run_train_step(step_fn, batch)
+                bsz = next(iter(batch.values())).shape[0]
+                # deferred accumulation: metrics stay on device; the
+                # NaN screen is the fused all_finite flag checked at
+                # flush points (every save below, print_freq, epoch
+                # end). In sync-every-step mode the push flushes
+                # immediately — old-loop semantics, but each metric is
+                # converted exactly once (one device_get per step, no
+                # float(np.asarray(loss)) + second np.asarray sweep).
+                buf.push(ff._step - 1, bm, bsz)
+                buf.raise_if_poisoned()
+                nb += 1
+                # dynamic recompilation hook — same contract as fit()
+                # (model.py: reference RecompileState, model.cc:2422)
+                rs = getattr(ff, "_recompile_state", None)
+                if rs is not None and rs.step(ff):
+                    step_fn = ff.executor.make_train_step()
+                self._since_ckpt += 1
+                if self._since_ckpt >= self.checkpoint_every:
+                    # _save flushes + screens the pending window first
+                    self._save(loader)
+                self._update_ckpt_age_gauge()
+                pf = ff.config.print_freq
+                if self.verbose and pf > 0 and nb % pf == 0:
+                    buf.flush()
+                    buf.raise_if_poisoned()
+                    rep = pm.report()
+                    msg = " ".join(f"{k}={v:.4f}" for k, v in rep.items())
+                    print(f"epoch {loader.epoch} iter {nb}/"
+                          f"{loader.num_batches} {msg}")
+            buf.flush()
+            buf.raise_if_poisoned()
+        finally:
+            self._buffer = None
+            ff._metrics_buffer = None
         if nb == 0:
             # resumed from a checkpoint taken at the epoch's last batch
             # (killed before the boundary save overwrote it): nothing
@@ -229,6 +244,13 @@ class Supervisor:
     # ------------------------------------------------------------------
     def _save(self, loader) -> None:
         from ..runtime.checkpoint import save_model_checkpoint
+        if self._buffer is not None:
+            # the deferred NaN screen ALWAYS runs immediately before a
+            # checkpoint save: flush the in-flight window and raise on
+            # the first non-finite step — the rollback happens INSTEAD
+            # of the save, so a poisoned state never lands on disk
+            self._buffer.flush()
+            self._buffer.raise_if_poisoned()
         t0 = time.perf_counter()
         save_model_checkpoint(
             self.ff, self.directory, manager=self._mgr,

@@ -6,10 +6,14 @@ zero-copy memory and index-launches a per-device batch-copy GPU task each
 iteration. TPU-native: the dataset stays in host RAM; each ``next_batch``
 device_puts the batch with the batch-dim NamedSharding, so each chip
 receives only its shard (the analog of the shard-wise Legion copy), with a
-simple double-buffer prefetch.
+configurable-depth prefetch queue (``FFConfig.prefetch_batches``,
+default 2) so the H2D transfers of the next batches overlap compute —
+deeper than one slot matters once the async-dispatch train loop keeps
+several steps in flight.
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import jax
@@ -22,7 +26,7 @@ class SingleDataLoader:
     def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
                  shardings: Optional[Dict[str, jax.sharding.Sharding]] = None,
                  shuffle: bool = False, seed: int = 0,
-                 drop_remainder: bool = True):
+                 drop_remainder: bool = True, prefetch: int = 2):
         sizes = {k: v.shape[0] for k, v in arrays.items()}
         assert len(set(sizes.values())) == 1, f"ragged dataset: {sizes}"
         self.arrays = arrays
@@ -44,7 +48,12 @@ class SingleDataLoader:
         # instead of serializing the full permutation
         self._epoch_rng_state = self.rng.bit_generator.state
         self._shuffled = False
-        self._next_prefetched = None
+        # prefetch queue: device batches for indices idx..idx+len-1,
+        # dispatched ahead of consumption (prefetch=0 disables, 1 is
+        # the old single-slot double-buffer). Prefetching reads only
+        # `_order` — never the rng — so resume stays exact.
+        self.prefetch = max(0, int(prefetch))
+        self._prefetched: deque = deque()
 
     @property
     def num_batches(self) -> int:
@@ -54,7 +63,7 @@ class SingleDataLoader:
 
     def reset(self):
         self.idx = 0
-        self._next_prefetched = None
+        self._prefetched.clear()
         # fresh permutation from arange (not an in-place reshuffle of
         # the previous order): the order is then a pure function of
         # (_epoch_rng_state, shuffle), which is what lets state_dict
@@ -104,7 +113,7 @@ class SingleDataLoader:
         if sd.get("shuffled"):
             self.rng.shuffle(self._order)  # rng lands post-shuffle
             self._shuffled = True
-        self._next_prefetched = None  # re-prefetched on next next_batch
+        self._prefetched.clear()  # re-prefetched on next next_batch
 
     def _device_put(self, batch: Dict[str, np.ndarray]):
         from ..parallel.distributed import put_global
@@ -127,19 +136,21 @@ class SingleDataLoader:
 
     def next_batch(self):
         """Reference ``next_batch_xd_launcher`` analog; returns device dict
-        or None at epoch end. Prefetches the following batch's transfer."""
-        if self._next_prefetched is not None:
-            batch = self._next_prefetched
-            self._next_prefetched = None
+        or None at epoch end. Keeps up to ``prefetch`` following batches'
+        transfers in flight (async H2D overlap)."""
+        if self._prefetched:
+            batch = self._prefetched.popleft()
         else:
             hb = self._host_batch(self.idx)
             if hb is None:
                 return None
             batch = self._device_put(hb)
         self.idx += 1
-        nb = self._host_batch(self.idx)
-        if nb is not None:
-            self._next_prefetched = self._device_put(nb)  # async H2D overlap
+        while len(self._prefetched) < self.prefetch:
+            nb = self._host_batch(self.idx + len(self._prefetched))
+            if nb is None:
+                break
+            self._prefetched.append(self._device_put(nb))
         return batch
 
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:

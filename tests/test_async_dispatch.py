@@ -1,0 +1,354 @@
+"""Async-dispatch training loop (ISSUE 4): device-resident metric
+accumulation, deferred NaN screening, bounded in-flight window,
+configurable dataloader prefetch.
+
+Contracts under test:
+
+  - deferred/accumulated metrics are BIT-EXACT vs the sync-every-step
+    loop across a multi-epoch fit, including gradient accumulation;
+  - the deferred NaN screen (fused ``all_finite`` flag) still rolls a
+    poisoned run back BEFORE any checkpoint lands, with correct
+    first-bad-step attribution, at checkpoint cadences coarser than 1;
+  - the dataloader's configurable prefetch depth keeps
+    ``state_dict``/``load_state_dict`` exact-resume semantics,
+    including a resume taken mid-prefetch.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.resilience import Supervisor, faults, status
+from flexflow_tpu.runtime.dataloader import SingleDataLoader
+from flexflow_tpu.runtime.metrics import PerfMetrics
+from flexflow_tpu.runtime.metrics_buffer import (MetricsBuffer,
+                                                 NonFiniteMetrics)
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    faults.install("")
+    status.reset()
+    os.environ.pop("FF_SYNC_EVERY_STEP", None)
+    yield
+    faults.clear()
+    status.reset()
+    os.environ.pop("FF_SYNC_EVERY_STEP", None)
+
+
+def _blobs(n=256, d=20, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, d)).astype(np.float32)
+    ys = rng.integers(0, classes, size=n).astype(np.int32)
+    return xs, ys
+
+
+def _build(accum=1, batch=64, metrics=("accuracy",)):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.only_data_parallel = True
+    cfg.gradient_accumulation_steps = accum
+    cfg.seed = 7
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 20), name="x")
+    t = ff.dense(x, 64, activation=ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+               list(metrics))
+    return ff
+
+
+# ======================================================================
+# MetricsBuffer unit behavior
+# ======================================================================
+def test_buffer_defers_then_flushes_in_one_fetch():
+    pm = PerfMetrics()
+    buf = MetricsBuffer(window=4, pm=pm)
+    for i in range(6):
+        buf.push(i, {"loss": jnp.float32(2.0),
+                     "all_finite": jnp.asarray(True)}, 8)
+    assert buf.pending == 6
+    assert buf.flush() == 6
+    assert buf.pending == 0 and buf.flushes == 1
+    assert pm.train_all == 48
+    assert pm.report()["loss"] == pytest.approx(2.0)
+    assert not buf.poisoned
+    buf.raise_if_poisoned()  # no-op when clean
+
+
+def test_buffer_sync_mode_flushes_every_push():
+    pm = PerfMetrics()
+    buf = MetricsBuffer(window=0, pm=pm)
+    assert buf.sync
+    buf.push(0, {"loss": jnp.float32(1.0)}, 4)
+    assert buf.pending == 0 and pm.train_all == 4
+
+
+def test_buffer_tracks_first_bad_step():
+    buf = MetricsBuffer(window=8, pm=PerfMetrics())
+    for i in range(5):
+        bad = i in (2, 4)
+        buf.push(10 + i, {"loss": jnp.float32(np.nan if bad else 1.0),
+                          "all_finite": jnp.asarray(not bad)}, 8)
+    buf.flush()
+    assert buf.poisoned and buf.first_bad_step == 12
+    with pytest.raises(NonFiniteMetrics) as ei:
+        buf.raise_if_poisoned()
+    assert ei.value.step == 12
+    assert not np.isfinite(ei.value.value)
+
+
+def test_buffer_screen_is_loss_only():
+    # an auxiliary metric overflowing on its own must NOT poison the
+    # run (old per-step screen checked only the loss) — neither via the
+    # fused flag (executor computes it from the loss) nor the fallback
+    buf = MetricsBuffer(window=8, pm=PerfMetrics())
+    buf.push(0, {"loss": jnp.float32(1.0),
+                 "mae_loss": jnp.float32(np.inf)}, 8)
+    buf.flush()
+    assert not buf.poisoned
+
+
+def test_buffer_max_pending_caps_memory():
+    # no flush point for a long stretch (quiet fit, huge
+    # checkpoint_every): the buffer folds every max_pending steps
+    # instead of retaining the epoch's worth of device scalars
+    pm = PerfMetrics()
+    buf = MetricsBuffer(window=4, pm=pm, max_pending=16)
+    for i in range(50):
+        buf.push(i, {"loss": jnp.float32(1.0),
+                     "all_finite": jnp.asarray(True)}, 8)
+        assert buf.pending < 16
+    buf.flush()
+    assert pm.train_all == 400  # nothing lost across auto-flushes
+
+
+def test_buffer_screens_loss_without_flag():
+    # a custom step fn without the fused flag: flush falls back to
+    # screening the fetched loss itself
+    buf = MetricsBuffer(window=8, pm=PerfMetrics())
+    buf.push(3, {"loss": jnp.float32(np.inf)}, 8)
+    buf.flush()
+    assert buf.poisoned and buf.first_bad_step == 3
+
+
+def test_for_config_honors_env_and_knob():
+    cfg = FFConfig()
+    assert MetricsBuffer.for_config(cfg).window == 8
+    cfg.async_dispatch_steps = 3
+    assert MetricsBuffer.for_config(cfg).window == 3
+    os.environ["FF_SYNC_EVERY_STEP"] = "1"
+    assert MetricsBuffer.for_config(cfg).sync
+
+
+def test_config_flags_parse():
+    cfg = FFConfig.parse_args(["--async-dispatch-steps", "16",
+                               "--prefetch-batches", "4"])
+    assert cfg.async_dispatch_steps == 16
+    assert cfg.prefetch_batches == 4
+    assert FFConfig.parse_args(["--sync-every-step"]) \
+        .async_dispatch_steps == 0
+
+
+# ======================================================================
+# metric parity: deferred vs sync-every-step (bit-exact)
+# ======================================================================
+def _fit_history(sync: bool, accum: int = 1):
+    if sync:
+        os.environ["FF_SYNC_EVERY_STEP"] = "1"
+    else:
+        os.environ.pop("FF_SYNC_EVERY_STEP", None)
+    ff = _build(accum=accum)
+    xs, ys = _blobs()
+    return ff.fit(x=xs, y=ys, epochs=3, verbose=False)
+
+
+def test_deferred_metrics_bit_exact_vs_sync():
+    h_sync = _fit_history(sync=True)
+    h_async = _fit_history(sync=False)
+    assert len(h_sync) == len(h_async) == 3
+    for a, b in zip(h_sync, h_async):
+        # bit-exact: same per-step scalars, same host fold order —
+        # equality, not allclose
+        assert a["loss"] == b["loss"]
+        assert a["accuracy"] == b["accuracy"]
+
+
+def test_deferred_metrics_bit_exact_with_grad_accum():
+    # gradient accumulation reduces metrics in-jit (COUNT_KEYS summed,
+    # RMS_KEYS sqrt-of-mean-of-squares) BEFORE the buffer sees them;
+    # the deferred fold must not change that composition
+    h_sync = _fit_history(sync=True, accum=4)
+    h_async = _fit_history(sync=False, accum=4)
+    for a, b in zip(h_sync, h_async):
+        assert a["loss"] == b["loss"]
+        assert a["accuracy"] == b["accuracy"]
+
+
+def test_train_step_emits_fused_all_finite():
+    ff = _build()
+    xs, ys = _blobs(n=64)
+    step = ff.executor.make_train_step()
+    bm = ff._run_train_step(step, {"x": xs[:64], "label": ys[:64, None]})
+    assert bool(np.asarray(bm["all_finite"]))
+
+
+# ======================================================================
+# deferred NaN screen under the supervisor (the PR-3 invariant)
+# ======================================================================
+def test_deferred_nan_screen_rolls_back_before_any_checkpoint(tmp_path):
+    """nan@N with async dispatch on and a checkpoint cadence COARSER
+    than every step: the poisoned step is caught at the pre-save flush,
+    no checkpoint ever contains non-finite state, and the resumed run's
+    final weights are bit-exact with an uninterrupted one."""
+    xs, ys = _blobs()
+
+    def run(directory, plan=""):
+        faults.install(plan)
+        ff = _build()
+        sup = Supervisor(ff, str(directory), checkpoint_every=2)
+        h = sup.run(xs, ys, epochs=2)
+        return ff, sup, h
+
+    ff0, _, h0 = run(tmp_path / "clean")
+    ff, sup, h = run(tmp_path / "nan", plan="nan@5")
+    assert sup.nan_rollbacks == 1
+    assert np.isfinite(h[-1]["loss"])
+    np.testing.assert_array_equal(
+        np.asarray(ff.params[ff.layers[0].name]["kernel"]),
+        np.asarray(ff0.params[ff0.layers[0].name]["kernel"]))
+    # every checkpoint left on disk holds only finite state
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "nan"))
+    steps = mgr.all_steps()
+    assert steps, "run saved no checkpoints"
+    for s in steps:
+        state, _ = mgr.restore(step=s)
+        for lname, wd in state["params"].items():
+            for wname, arr in wd.items():
+                assert np.isfinite(np.asarray(arr)).all(), \
+                    f"checkpoint {s} carries non-finite {lname}/{wname}"
+
+
+def test_nan_attribution_matches_poisoned_step(tmp_path):
+    faults.install("nan@3")
+    ff = _build()
+    sup = Supervisor(ff, str(tmp_path / "attr"), checkpoint_every=1,
+                     max_restarts=0)
+    with pytest.raises(Exception):
+        sup.run(*_blobs(), epochs=1)
+    # the flush reported step 3 (the step poison_value fired after),
+    # not the flush-point step
+    assert 3 in sup._nan_steps
+
+
+def test_save_checkpoint_screens_live_buffer(tmp_path):
+    ff = _build()
+    pm = PerfMetrics()
+    buf = MetricsBuffer(window=8, pm=pm)
+    ff._metrics_buffer = buf
+    buf.push(4, {"loss": jnp.float32(np.nan),
+                 "all_finite": jnp.asarray(False)}, 8)
+    with pytest.raises(NonFiniteMetrics):
+        ff.save_checkpoint(str(tmp_path / "ck"))
+    assert not os.path.isdir(tmp_path / "ck")
+
+
+# ======================================================================
+# dataloader: configurable prefetch depth, exact resume
+# ======================================================================
+def _loader(arrays, prefetch, seed=3):
+    return SingleDataLoader(dict(arrays), 8, shuffle=True, seed=seed,
+                            prefetch=prefetch)
+
+
+def test_prefetch_depth_fills_queue():
+    rng = np.random.default_rng(0)
+    arrays = {"x": rng.normal(size=(64, 6)).astype(np.float32)}
+    ld = _loader(arrays, prefetch=3)
+    ld.reset()
+    ld.next_batch()
+    assert len(ld._prefetched) == 3
+    # depth 0 disables prefetch entirely
+    ld0 = _loader(arrays, prefetch=0)
+    ld0.reset()
+    ld0.next_batch()
+    assert len(ld0._prefetched) == 0
+
+
+@pytest.mark.parametrize("resume_prefetch", [0, 1, 3])
+def test_resume_mid_prefetch_is_exact(resume_prefetch):
+    """state_dict taken while the prefetch queue is warm restores the
+    exact remaining batch stream — into a loader of ANY prefetch depth
+    (prefetching reads the order, never the rng)."""
+    rng = np.random.default_rng(0)
+    arrays = {"x": rng.normal(size=(64, 6)).astype(np.float32)}
+    a = _loader(arrays, prefetch=3)
+    a.reset()
+    for _ in range(3):
+        a.next_batch()
+    assert len(a._prefetched) == 3  # snapshot taken mid-prefetch
+    sd = json.loads(json.dumps(a.state_dict()))
+    b = _loader(arrays, prefetch=resume_prefetch, seed=999)
+    b.load_state_dict(sd)
+    for _ in range(5):
+        np.testing.assert_array_equal(np.asarray(a.next_batch()["x"]),
+                                      np.asarray(b.next_batch()["x"]))
+    assert a.next_batch() is None and b.next_batch() is None
+    # next epoch's shuffle replays identically too
+    a.reset(); b.reset()
+    np.testing.assert_array_equal(np.asarray(a.next_batch()["x"]),
+                                  np.asarray(b.next_batch()["x"]))
+
+
+def test_prefetch_yields_same_epoch_stream_as_unprefetched():
+    rng = np.random.default_rng(1)
+    arrays = {"x": rng.normal(size=(48, 4)).astype(np.float32)}
+    deep = _loader(arrays, prefetch=4)
+    none = _loader(arrays, prefetch=0)
+    got = [np.asarray(b["x"]) for b in deep]
+    want = [np.asarray(b["x"]) for b in none]
+    assert len(got) == len(want) == 6
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+# ======================================================================
+# observability: FF_TRACE_SYNC + host-blocked gauge
+# ======================================================================
+def test_trace_sync_mode_records_true_latency_spans():
+    from flexflow_tpu.obs import events
+    ff = _build()
+    xs, ys = _blobs(n=64)
+    step = ff.executor.make_train_step()
+    batch = {"x": xs[:64], "label": ys[:64, None]}
+    events.enable()
+    events.clear()
+    os.environ["FF_TRACE_SYNC"] = "1"
+    try:
+        ff._run_train_step(step, batch)
+        spans = [e for e in events.events()
+                 if e["name"] == "executor.train_step"]
+        assert spans, "no train-step span recorded"
+    finally:
+        os.environ.pop("FF_TRACE_SYNC", None)
+        events.disable()
+        events.clear()
+
+
+def test_flush_accumulates_host_blocked_gauge():
+    from flexflow_tpu.obs.metrics_registry import REGISTRY
+    g = REGISTRY.gauge("ff_host_blocked_ms_total")
+    before = g.value()
+    buf = MetricsBuffer(window=2, pm=PerfMetrics())
+    for i in range(6):
+        buf.push(i, {"loss": jnp.float32(1.0),
+                     "all_finite": jnp.asarray(True)}, 8)
+    buf.flush()
+    assert buf.blocked_ms >= 0.0
+    assert g.value() >= before

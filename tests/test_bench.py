@@ -57,6 +57,15 @@ def _scripted(default_probe_results):
                 in env.get("XLA_FLAGS", "")
             return {"wrapped_step_s": 0.001, "raw_step_s": 0.001,
                     "overhead_pct": 0.1, "ok": True}, None
+        if stage == "dispatch_overlap":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            # single-device leg: the parent must CLEAR any inherited
+            # 8-virtual-device forcing (ci.sh exports it)
+            assert "xla_force_host_platform_device_count" \
+                not in env.get("XLA_FLAGS", "")
+            return {"sync_step_s": 0.002, "deferred_step_s": 0.0018,
+                    "deferred_vs_sync": 1.08, "chunk": 16,
+                    "rounds": 10, "ok": True}, None
         if stage == "recovery":
             assert env.get("JAX_PLATFORMS") == "cpu"
             assert "xla_force_host_platform_device_count" \
@@ -128,6 +137,9 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         # measured percentage reaches the driver JSON
         assert out["obs_overhead_pct"] == 0.1
         assert any(a[1] == "obs_overhead" for a, _ in calls)
+        # and the async-dispatch overlap leg
+        assert out["dispatch_overlap_ratio"] == 1.08
+        assert any(a[1] == "dispatch_overlap" for a, _ in calls)
         # so does the checkpoint-overhead + time-to-recover leg
         assert out["ckpt_async_overhead_pct"] == 1.1
         assert out["ckpt_sync_overhead_pct"] == 2.3

@@ -1,0 +1,58 @@
+"""CI async-dispatch parity smoke (ci.sh fast tier).
+
+Runs the same tiny fit twice — once with the sync-every-step fallback
+(``FF_SYNC_EVERY_STEP=1``) and once with the default deferred
+async-dispatch loop — and asserts the final losses are IDENTICAL
+(bit-exact, not approximately equal): the deferred path batches the
+host fetches, it must never change the numbers. Exit code 0 = the
+async path has not silently diverged.
+
+    python tools/async_parity_smoke.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_fit():
+    import numpy as np
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, SGDOptimizer
+
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.only_data_parallel = True
+    cfg.seed = 11
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 16), name="x")
+    t = ff.dense(x, 32, activation=ActiMode.AC_MODE_RELU)
+    ff.softmax(ff.dense(t, 4))
+    ff.compile(SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+               ["accuracy"])
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(192, 16)).astype(np.float32)
+    ys = rng.integers(0, 4, size=192).astype(np.int32)
+    return ff.fit(x=xs, y=ys, epochs=2, verbose=False)
+
+
+def main():
+    import numpy as np
+
+    os.environ["FF_SYNC_EVERY_STEP"] = "1"
+    h_sync = run_fit()
+    os.environ.pop("FF_SYNC_EVERY_STEP", None)
+    h_async = run_fit()
+
+    assert len(h_sync) == len(h_async), (len(h_sync), len(h_async))
+    for e, (a, b) in enumerate(zip(h_sync, h_async)):
+        for k in ("loss", "accuracy"):
+            assert a[k] == b[k], \
+                f"epoch {e} {k}: sync {a[k]!r} != async {b[k]!r}"
+    assert np.isfinite(h_async[-1]["loss"])
+    print(f"async parity smoke OK: {len(h_async)} epochs, final loss "
+          f"{h_async[-1]['loss']:.6f} identical sync vs deferred")
+
+
+if __name__ == "__main__":
+    main()

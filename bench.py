@@ -629,6 +629,112 @@ def stage_recovery(steps: int):
            "ok": async_pct <= 5.0})
 
 
+def stage_serving_overload(steps: int):
+    """Serving-overload leg (ISSUE 5 acceptance): goodput (requests
+    completed WITHIN their deadline per second) at 2x offered load,
+    deadline enforcement + admission control ON vs OFF.
+
+    The session is synthetic (a fixed ``sleep`` per batch) so capacity
+    is controlled and the leg measures the SCHEDULING policy, not XLA
+    step noise on a 2-core host. Without shedding, the queue backlog
+    grows ~1 s/s past capacity and nearly every completion lands after
+    its deadline; with deadlines enforced end-to-end (expired requests
+    skipped at dequeue, doomed ones shed at admission) goodput stays
+    near capacity. Gate: goodput(shedding) >= goodput(baseline)."""
+    import threading
+    import numpy as np
+    from flexflow_tpu.serving.scheduler import BatchScheduler
+
+    T_STEP = 0.040       # synthetic per-batch device time
+    MAX_BATCH = 4        # capacity ~ MAX_BATCH/T_STEP = 100 one-row req/s
+    DEADLINE_MS = 100.0
+    N_CLIENTS = 28       # open-ish loop: 28 clients / 0.14 s = 2x capacity
+    INTERVAL_S = 0.14    # >= deadline so a blocked client never skips a tick
+    DURATION_S = max(2.5, float(steps) / 8.0)
+
+    class FixedLatencySession:
+        input_names = ["x"]
+
+        def infer(self, inputs):
+            time.sleep(T_STEP)
+            return np.zeros((int(inputs["x"].shape[0]), 1), np.float32)
+
+    def run_leg(shed: bool) -> dict:
+        sched = BatchScheduler(FixedLatencySession(), max_batch=MAX_BATCH,
+                               max_delay_ms=2.0, max_queue=512,
+                               name="overload_shed" if shed
+                               else "overload_base")
+        good = [0]
+        offered = [0]
+        lock = threading.Lock()
+        t_end = time.perf_counter() + DURATION_S
+        x = np.zeros((1, 1), np.float32)
+
+        def one_request():
+            t0 = time.perf_counter()
+            try:
+                # baseline: deadline known only to the CLIENT — the
+                # server processes everything FIFO, deadline-blind, and
+                # the client never abandons (the pre-deadline-era
+                # behavior: late work still burns device steps);
+                # shedding: the same deadline handed to the server
+                sched.infer({"x": x},
+                            timeout=15.0 if not shed
+                            else DEADLINE_MS / 1e3,
+                            deadline_ms=DEADLINE_MS if shed else None)
+                if time.perf_counter() - t0 <= DEADLINE_MS / 1e3:
+                    with lock:
+                        good[0] += 1
+            except Exception:  # noqa: BLE001 — shed/expired/timeout
+                pass
+
+        def client(ci):
+            # open loop: fire-and-forget on a fixed tick, so a request
+            # stuck in the backlog never throttles the offered load
+            pending = []
+            while True:
+                t0 = time.perf_counter()
+                if t0 >= t_end:
+                    break
+                with lock:
+                    offered[0] += 1
+                th = threading.Thread(target=one_request)
+                th.start()
+                pending.append(th)
+                time.sleep(max(0.0, (t0 + INTERVAL_S)
+                               - time.perf_counter()))
+            for th in pending:
+                th.join()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = sched.metrics.snapshot(sched._q.qsize())
+        sched.close()
+        return {"offered": offered[0], "good": good[0],
+                "goodput_rps": round(good[0] / DURATION_S, 2),
+                "offered_rps": round(offered[0] / DURATION_S, 2),
+                "completed": snap["completed"],
+                "expired": snap["expired"],
+                "deadline_rejected": snap["deadline_rejected"]}
+
+    base = run_leg(shed=False)
+    shed = run_leg(shed=True)
+    ratio = shed["goodput_rps"] / max(base["goodput_rps"], 1e-9)
+    _emit({"capacity_rps": round(MAX_BATCH / T_STEP, 1),
+           "offered_x_capacity": round(
+               shed["offered_rps"] / (MAX_BATCH / T_STEP), 2),
+           "deadline_ms": DEADLINE_MS,
+           "baseline": base, "shedding": shed,
+           "goodput_base_rps": base["goodput_rps"],
+           "goodput_shed_rps": shed["goodput_rps"],
+           "goodput_ratio": round(ratio, 3),
+           "ok": ratio >= 1.0})
+
+
 # ======================================================================
 # parent orchestration
 # ======================================================================
@@ -870,6 +976,26 @@ def main():
         else:
             errors.append(f"dispatch_overlap: {err}")
 
+    # -- stage 5.43: serving overload goodput -------------------------
+    # ISSUE 5 acceptance: with deadlines + admission control the
+    # serving stack's goodput (completed-within-deadline/sec) at 2x
+    # offered load must be at least the no-shedding baseline's —
+    # measured on every bench run (synthetic session: policy, not XLA)
+    if remaining() > 90:
+        soenv = {"JAX_PLATFORMS": "cpu"}
+        so, err = stage(["--stage", "serving_overload", "--steps", "20"],
+                        240, soenv)
+        if so is not None:
+            out["serving_goodput_ratio"] = so["goodput_ratio"]
+            out["serving_goodput_shed_rps"] = so["goodput_shed_rps"]
+            out["serving_goodput_base_rps"] = so["goodput_base_rps"]
+            if not so["ok"]:
+                errors.append(
+                    f"serving_overload: goodput ratio "
+                    f"{so['goodput_ratio']} < 1.0 at 2x load")
+        else:
+            errors.append(f"serving_overload: {err}")
+
     # -- stage 5.45: checkpoint overhead + time-to-recover ------------
     # ISSUE 3 acceptance: async-save steady-state overhead <= 5% vs the
     # no-checkpoint baseline; time-to-recover reported on every run
@@ -996,5 +1122,7 @@ if __name__ == "__main__":
         stage_dispatch_overlap(a.steps)
     elif a.stage == "recovery":
         stage_recovery(a.steps)
+    elif a.stage == "serving_overload":
+        stage_serving_overload(a.steps)
     else:
         raise SystemExit(f"unknown stage {a.stage!r}")

@@ -35,6 +35,12 @@ case "${1:-fast}" in
     # reach IDENTICAL final losses — the async path can never silently
     # diverge from the sync-every-step semantics
     python tools/async_parity_smoke.py
+    # serving chaos smoke: injected inference failures must open the
+    # per-model circuit breaker (fast 503 + Retry-After), the half-open
+    # probe after the cooldown must restore service, and drain() must
+    # finish in-flight requests before the process exits
+    FF_FAULT_PLAN="infer_fail@0;infer_fail@1;infer_fail@2" \
+      python tools/serving_chaos_smoke.py
     ;;
   slow)
     python -m pytest tests/ -q -m slow

@@ -11,8 +11,8 @@ Fault plan grammar (``FF_FAULT_PLAN`` env var or :func:`install`)::
     plan   := clause (';' clause)*          # ',' also accepted
     clause := kind '@' step [':' arg]
     kind   := crash | nan | inf | corrupt_ckpt | truncate_ckpt
-              | lose_device                  # aliases: nan_grad, corrupt,
-                                             # truncate, lose
+              | lose_device | infer_fail     # aliases: nan_grad, corrupt,
+                                             # truncate, lose, infer
 
 Examples::
 
@@ -46,6 +46,7 @@ Every firing is counted in :mod:`.status` (always on) and as an
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import re
 from typing import List, Optional
@@ -63,6 +64,7 @@ _KINDS = {
     "corrupt_ckpt": "corrupt_ckpt", "corrupt": "corrupt_ckpt",
     "truncate_ckpt": "truncate_ckpt", "truncate": "truncate_ckpt",
     "lose_device": "lose_device", "lose": "lose_device",
+    "infer_fail": "infer_fail", "infer": "infer_fail",
 }
 
 _CLAUSE_RE = re.compile(r"^([a-z_]+)@(\d+)(?::([A-Za-z0-9_]+))?$")
@@ -163,16 +165,22 @@ def get_plan() -> FaultPlan:
 
 def install(plan) -> FaultPlan:
     """Set the process-wide plan (a :class:`FaultPlan` or a grammar
-    string); the API analog of the ``FF_FAULT_PLAN`` env var."""
-    global _plan
+    string); the API analog of the ``FF_FAULT_PLAN`` env var. The
+    inference-call counter restarts at 0 so ``infer_fail@N`` indices in
+    the new plan count from ITS installation, not from whatever calls a
+    previous plan saw."""
+    global _plan, _infer_calls
     _plan = FaultPlan.parse(plan) if isinstance(plan, str) else plan
+    _infer_calls = itertools.count()
     return _plan
 
 
 def clear() -> None:
-    """Drop the installed plan; the env var is re-read on next use."""
-    global _plan
+    """Drop the installed plan; the env var is re-read on next use.
+    Also restarts the inference-call counter (see :func:`install`)."""
+    global _plan, _infer_calls
     _plan = None
+    _infer_calls = itertools.count()
 
 
 def active() -> bool:
@@ -191,6 +199,26 @@ def raise_pending(step: int) -> None:
     f = plan.fire("lose_device", step)
     if f is not None:
         raise DeviceLoss(step, n_lost=int(f.arg or 1))
+
+
+#: process-wide inference-call counter for ``infer_fail@N`` clauses.
+#: Advances only while a plan is active (``InferenceSession.infer``
+#: gates on :func:`active` first), so call indices are deterministic
+#: for a plan installed before serving starts. ``itertools.count`` is
+#: safe under the serving workers' concurrency in CPython.
+_infer_calls = itertools.count()
+
+
+def raise_infer_fault() -> None:
+    """Inference-path clauses (``infer_fail@N``): the N-th
+    ``InferenceSession.infer`` call made while a plan is active raises
+    :class:`FaultError` — the serving chaos harness for circuit-breaker
+    and batch-poison paths. Each clause is one-shot like every other
+    kind; compose K consecutive clauses to trip a breaker with
+    threshold K."""
+    step = next(_infer_calls)
+    if get_plan().fire("infer_fail", step) is not None:
+        raise FaultError(f"injected inference failure at call {step}")
 
 
 def poison_value(step: int) -> Optional[float]:

@@ -10,12 +10,22 @@ frontend (``serve_http``) exposes the Triton-style
 ``POST /v2/models/<name>/infer`` JSON API. Models arrive either as a
 live ``FFModel`` or from the torch-frontend's serialization hand-off
 (``ModelRepository.load_graph`` -> ``file_to_ff``).
+
+Overload robustness (docs/serving.md): per-request deadlines
+(``x-ff-timeout-ms``), admission control that sheds doomed work at the
+queue door, a per-model circuit breaker, batch-poison isolation, and
+graceful drain on both HTTP fronts.
 """
 from .session import InferenceSession, ModelRepository
-from .scheduler import BatchScheduler, QueueFullError, SchedulerMetrics
+from .scheduler import (BatchScheduler, CircuitBreaker, CircuitOpenError,
+                        DeadlineExceededError, DeadlineRejectedError,
+                        DrainingError, InvalidInputError, QueueFullError,
+                        RequestRejected, SchedulerMetrics)
 from .http_server import serve_http
 from .async_server import serve_async
 
 __all__ = ["InferenceSession", "ModelRepository", "BatchScheduler",
-           "QueueFullError", "SchedulerMetrics", "serve_http",
-           "serve_async"]
+           "CircuitBreaker", "CircuitOpenError", "DeadlineExceededError",
+           "DeadlineRejectedError", "DrainingError", "InvalidInputError",
+           "QueueFullError", "RequestRejected", "SchedulerMetrics",
+           "serve_http", "serve_async"]

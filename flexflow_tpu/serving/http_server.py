@@ -1,19 +1,27 @@
 """Dependency-free HTTP frontend speaking the Triton KServe-style API.
 
 Endpoints (JSON bodies, shapes row-major):
-  - ``GET  /v2/health/ready``            -> 200 when serving
+  - ``GET  /v2/health/ready``            -> 200 when serving, 503 while
+    draining (k8s readiness semantics)
   - ``GET  /healthz``                    -> 200 {"status": "ok"} (probe
-    alias — what k8s-style liveness checks expect)
+    alias — what k8s-style liveness checks expect); carries the
+    resilience block AND a per-model serving block (circuit-breaker
+    state, queue depth, draining)
   - ``GET  /v2/models``                  -> {"models": [names]}
   - ``GET  /v2/metrics``                 -> per-model scheduler counters
-    (requests/completed/rejected, queue depth, mean batch rows,
-    latency p50/p99 ms, instances)
+    (requests/completed/rejected/expired/deadline-rejected, queue
+    depth, circuit state, mean batch rows, latency p50/p99 ms,
+    instances)
   - ``GET  /metrics``                    -> Prometheus text exposition
-    (request-latency histograms, queue-depth gauges, request counters —
-    the ``obs/metrics_registry.py`` registry; scrape-ready)
+    (request-latency histograms, queue-depth + circuit-state gauges,
+    request counters — the ``obs/metrics_registry.py`` registry;
+    scrape-ready)
   - ``POST /v2/models/<name>/infer``     -> {"outputs": [{"data", "shape"}]}
     body: {"inputs": [{"name": ..., "shape": [...], "data": [flat]}]};
-    bounded-queue overflow -> 503
+    optional ``x-ff-timeout-ms`` header sets the request deadline.
+    Load shedding (bounded queue, admission control, circuit open,
+    draining) -> 503 + ``Retry-After``; a missed deadline -> 504;
+    malformed inputs -> 400
   - ``POST /v2/models/<name>/generate``  -> {"outputs": [{"name":
     "output_ids", ...}]} — causal-LM decode; body adds
     {"parameters": {"prompt_len", "max_new_tokens", "temperature", "top_k", "top_p",
@@ -22,20 +30,82 @@ Endpoints (JSON bodies, shapes row-major):
 
 Reference analog: the Triton backend's HTTP surface
 (``/root/reference/triton/README.md``); stdlib-only so it runs anywhere
-the framework does.
+the framework does. Deadline/admission/breaker/drain semantics:
+docs/serving.md.
 """
 from __future__ import annotations
 
 import json
+import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
 from ..obs import events as obs_events
 from ..obs.metrics_registry import REGISTRY
-from .scheduler import QueueFullError
+from .scheduler import (CIRCUIT_STATE_NUM, InvalidInputError,
+                        RequestRejected)
+
+
+class ServingState:
+    """Shared per-server lifecycle state (one per front): ``draining``
+    flips readiness to 503 and rejects new inference work with 503 +
+    ``Retry-After`` while in-flight requests finish. The in-flight
+    counter tracks HTTP requests between parse and response-written so
+    a drain can wait for the RESPONSES to flush, not just for the
+    schedulers to go idle (the asyncio front's write happens after the
+    scheduler completes — stopping the loop in that window would reset
+    the client of an already-successful request)."""
+
+    def __init__(self, default_deadline_ms: Optional[float] = None):
+        self.draining = False
+        # the front's configured default deadline: the batching
+        # scheduler applies its own copy, but the uncancellable paths
+        # (generate, batching=False) need it for the post-hoc 504
+        self.default_deadline_ms = default_deadline_ms
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    def enter_request(self):
+        with self._lock:
+            self._inflight += 1
+
+    def exit_request(self):
+        with self._lock:
+            self._inflight -= 1
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+
+def drain_frontend(schedulers, state: ServingState,
+                   deadline_s: float) -> bool:
+    """Shared drain policy for both fronts: stop admitting (readiness
+    -> 503, new inference work -> 503 + ``Retry-After``), drain every
+    scheduler, then wait for the in-flight RESPONSES to flush — the
+    schedulers going idle is not the end of a request; killing the
+    process before the handler writes the response would reset the
+    client of already-successful work. Returns True when nothing was
+    abandoned."""
+    state.draining = True
+    end = time.perf_counter() + max(0.0, deadline_s)
+    clean = True
+    # snapshot: a concurrent unload request pops from the live dict
+    for s in list(schedulers.values()):
+        clean &= s.drain(max(0.0, end - time.perf_counter()))
+    # one observation of 0 is enough: admitted work has flushed, and
+    # anything arriving after the draining flip is shed — re-reading
+    # the counter at the end would let a late shed 503 (counted only
+    # until its response is written) spuriously report work abandoned
+    while time.perf_counter() < end:
+        if state.inflight() == 0:
+            return clean
+        time.sleep(0.005)
+    return clean and state.inflight() == 0
 
 
 def render_body(obj):
@@ -48,10 +118,28 @@ def render_body(obj):
     return json.dumps(obj).encode(), "application/json"
 
 
+def _retry_after(e: RequestRejected) -> Dict[str, str]:
+    """Retry-After header for a shedding rejection: integer seconds
+    (HTTP spec), at least 1."""
+    return {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))}
+
+
+def _past_deadline(t0: float, timeout_ms: Optional[float]):
+    """Post-hoc deadline check for the two UNCANCELLABLE paths
+    (generate, batching=False): the work already ran, but a completion
+    past the declared deadline must be a 504, not a misleadingly-late
+    200. Returns the 504 response tuple, or None within budget."""
+    if timeout_ms is not None and \
+            (time.perf_counter() - t0) * 1e3 > timeout_ms:
+        return 504, {"error": "request deadline "
+                              f"({timeout_ms:.0f} ms) exceeded"}, {}
+    return None
+
+
 def render_prometheus(schedulers) -> str:
     """Prometheus text for ``GET /metrics``: the process-wide registry
-    plus point-in-time gauges (queue depth, instances) sampled at
-    scrape time from the live schedulers.
+    plus point-in-time gauges (queue depth, instances, circuit state)
+    sampled at scrape time from the live schedulers.
 
     The registry is process-wide by design (all fronts' request
     counters/histograms merge into one namespace); the point-in-time
@@ -68,44 +156,70 @@ def render_prometheus(schedulers) -> str:
     REGISTRY.gauge("ff_scheduler_instances",
                    "Model instances draining the queue").set_all(
         ({"model": name}, sched.num_instances) for name, sched in live)
+    REGISTRY.gauge("ff_circuit_state",
+                   "Per-model circuit-breaker state: 0 closed, "
+                   "1 half-open, 2 open").set_all(
+        ({"model": name},
+         CIRCUIT_STATE_NUM.get(sched.breaker.state, 0.0))
+        for name, sched in live)
     return REGISTRY.render()
 
 
-def get_route(path: str, repo, schedulers):
-    """Route one GET; returns ``(status, obj)`` where ``obj`` is a JSON
-    document (dict) or pre-rendered plain text (str — the Prometheus
-    exposition). Shared by the threading and asyncio front-ends (the
-    request counter lives here for the same reason: one counting
-    policy, both fronts)."""
+def get_route(path: str, repo, schedulers, state: Optional[ServingState]
+              = None):
+    """Route one GET; returns ``(status, obj, extra_headers)`` where
+    ``obj`` is a JSON document (dict) or pre-rendered plain text (str —
+    the Prometheus exposition). Shared by the threading and asyncio
+    front-ends (the request counter lives here for the same reason: one
+    counting policy, both fronts)."""
     obs_events.counter("serving.http_requests")
     if path in ("/v2/health/ready", "/healthz"):
         # resilience block (resilience/status.py): restart/fault/
-        # checkpoint facts + checkpoint age, so a liveness probe can
-        # alert on "restarting in a loop" or "checkpoints stale" — both
-        # invisible to a bare 200
+        # checkpoint facts + checkpoint age, so a probe can alert on
+        # "restarting in a loop" or "checkpoints stale" — both
+        # invisible to a bare 200. The serving block adds per-model
+        # circuit-breaker and drain state for the same reason.
         from ..resilience import status as resilience_status
-        return 200, {"status": "ok", "ready": True,
-                     "resilience": resilience_status.health_fields()}
+        draining = bool(state is not None and state.draining)
+        serving = {}
+        # cheap point-in-time fields only — probes fire every few
+        # seconds, and the full stats() snapshot sorts the latency
+        # reservoir under the hot-path metrics lock
+        for name, sched in list(schedulers.items()):
+            serving[name] = {"circuit": sched.breaker.state,
+                             "queue_depth": sched._q.qsize(),
+                             "draining": sched._draining}
+        body = {"status": "draining" if draining else "ok",
+                "ready": not draining,
+                "resilience": resilience_status.health_fields(),
+                "serving": serving}
+        # READINESS flips to 503 while draining (stop routing here);
+        # LIVENESS (/healthz) must stay 200 — the process is alive and
+        # finishing work, and a k8s liveness kill would abort exactly
+        # the in-flight requests the drain protects
+        code = 503 if draining and path == "/v2/health/ready" else 200
+        return code, body, {}
     if path == "/metrics":
-        return 200, render_prometheus(schedulers)
+        return 200, render_prometheus(schedulers), {}
     if path == "/v2/models":
-        return 200, {"models": repo.names()}
+        return 200, {"models": repo.names()}, {}
     if path == "/v2/metrics":
         # per-model scheduler counters + latency percentiles
         # (Triton's /metrics endpoint, prometheus-lite as JSON)
         out = {}
         # snapshot: a concurrent unload may pop from schedulers
         for name, sched in list(schedulers.items()):
-            out[name] = sched.metrics.snapshot(sched._q.qsize())
-            out[name]["instances"] = sched.num_instances
-        return 200, {"models": out}
-    return 404, {"error": f"no route {path}"}
+            out[name] = sched.stats()
+        return 200, {"models": out}, {}
+    return 404, {"error": f"no route {path}"}, {}
 
 
-def post_route(path: str, body: bytes, repo, schedulers):
+def post_route(path: str, body: bytes, repo, schedulers,
+               headers: Optional[Dict[str, str]] = None,
+               state: Optional[ServingState] = None):
     """Route one POST (BLOCKING — the batching scheduler's ``infer``
     waits for the result; the asyncio front runs this in a thread
-    pool). Returns ``(status, json_obj)``."""
+    pool). Returns ``(status, json_obj, extra_headers)``."""
     obs_events.counter("serving.http_requests")
     parts = path.strip("/").split("/")
     # v2/repository/models/<name>/unload (Triton repository API)
@@ -116,14 +230,40 @@ def post_route(path: str, body: bytes, repo, schedulers):
             sched = schedulers.pop(parts[3], None)
             if sched is not None:
                 sched.close()
-            return 200, {"unloaded": parts[3]}
+            return 200, {"unloaded": parts[3]}, {}
         except KeyError as e:
-            return 404, {"error": str(e)}
+            return 404, {"error": str(e)}, {}
     # v2/models/<name>/{infer,generate}
     if len(parts) != 4 or parts[:2] != ["v2", "models"] \
             or parts[3] not in ("infer", "generate"):
-        return 404, {"error": f"no route {path}"}
+        return 404, {"error": f"no route {path}"}, {}
     name, verb = parts[2], parts[3]
+    if state is not None and state.draining:
+        # graceful drain: readiness already flipped; in-flight work
+        # finishes but nothing new is admitted
+        return 503, {"error": "server draining; retry against another "
+                              "replica"}, {"Retry-After": "5"}
+    hdrs = {str(k).lower(): v for k, v in (headers or {}).items()}
+    timeout_ms = None
+    if "x-ff-timeout-ms" in hdrs:
+        try:
+            timeout_ms = float(hdrs["x-ff-timeout-ms"])
+        except ValueError:
+            return 400, {"error": "bad x-ff-timeout-ms header: "
+                                  f"{hdrs['x-ff-timeout-ms']!r}"}, {}
+        if not (timeout_ms > 0 and math.isfinite(timeout_ms)):
+            # inf passes a bare '> 0' check and would overflow the
+            # scheduler's Event.wait; nan fails every comparison
+            return 400, {"error": "x-ff-timeout-ms must be a finite "
+                                  f"positive number, got {timeout_ms}"}, {}
+    # effective deadline + start reference for the direct
+    # (non-scheduler) paths, where the work cannot be shed or
+    # preempted — only 504'd after the fact; the front's configured
+    # default applies to headerless requests there too
+    eff_ms = timeout_ms
+    if eff_ms is None and state is not None:
+        eff_ms = state.default_deadline_ms
+    t0 = time.perf_counter()
     try:
         doc = json.loads(body)
         inputs = {}
@@ -140,7 +280,7 @@ def post_route(path: str, body: bytes, repo, schedulers):
             if missing or "input_ids" not in inputs:
                 return 400, {
                     "error": "generate needs inputs.input_ids "
-                             f"and parameters {missing or ''}"}
+                             f"and parameters {missing or ''}"}, {}
             eos = p.get("eos_token_id")
             top_k = int(p.get("top_k", 0))
             top_p = float(p.get("top_p", 1.0))
@@ -150,7 +290,7 @@ def post_route(path: str, body: bytes, repo, schedulers):
                     or temp < 0.0 or num_beams < 1:
                 return 400, {
                     "error": "need 0 < top_p <= 1, top_k >= 0, "
-                             "temperature >= 0, num_beams >= 1"}
+                             "temperature >= 0, num_beams >= 1"}, {}
             pl = p["prompt_len"]
             out = sess.generate(
                 inputs["input_ids"],
@@ -161,68 +301,164 @@ def post_route(path: str, body: bytes, repo, schedulers):
                 seed=int(p.get("seed", 0)),
                 eos_token_id=None if eos is None else int(eos),
                 top_k=top_k, top_p=top_p, num_beams=num_beams)
+            late = _past_deadline(t0, eff_ms)
+            if late is not None:
+                return late
             return 200, {"outputs": [{
                 "name": "output_ids", "shape": list(out.shape),
-                "data": np.asarray(out, np.int32).ravel().tolist()}]}
+                "data": np.asarray(out, np.int32).ravel().tolist()}]}, {}
         sched = schedulers.get(name)
-        out = sched.infer(inputs) if sched is not None \
-            else repo.get(name).infer(inputs)
+        if sched is not None:
+            # a deadline BEYOND the default 30 s blocking timeout —
+            # header-declared or the scheduler's configured default —
+            # must extend the wait, or a 60 s deadline 504s at 30 s
+            # with half its budget left
+            dl_ms = timeout_ms if timeout_ms is not None \
+                else sched.default_deadline_ms
+            wait_s = 30.0 if dl_ms is None else max(30.0, dl_ms / 1e3)
+            out = sched.infer(inputs, timeout=wait_s,
+                              deadline_ms=timeout_ms)
+        else:
+            out = repo.get(name).infer(inputs)
+            late = _past_deadline(t0, eff_ms)
+            if late is not None:
+                return late
         return 200, {"outputs": [{
             "name": "output0", "shape": list(out.shape),
-            "data": np.asarray(out, np.float32).ravel().tolist()}]}
+            "data": np.asarray(out, np.float32).ravel().tolist()}]}, {}
     except KeyError as e:
-        return 404, {"error": str(e)}
-    except QueueFullError as e:
-        # bounded-queue backpressure: shed load explicitly
-        return 503, {"error": str(e)}
+        return 404, {"error": str(e)}, {}
+    except InvalidInputError as e:
+        # malformed request (schema mismatch): a client error for THIS
+        # request only — co-batched requests are unaffected
+        return 400, {"error": str(e)}, {}
+    except RequestRejected as e:
+        # load shedding (queue full, admission control, circuit open,
+        # draining): explicit 503 with a retry hint
+        return 503, {"error": str(e)}, _retry_after(e)
+    except TimeoutError as e:
+        # deadline exceeded (queued too long or executed too late)
+        return 504, {"error": f"{type(e).__name__}: {e}"}, {}
     except Exception as e:  # noqa: BLE001 — report, don't die
-        return 400, {"error": f"{type(e).__name__}: {e}"}
+        return 400, {"error": f"{type(e).__name__}: {e}"}, {}
 
 
-def _make_handler(repo, schedulers):
+def _make_handler(repo, schedulers, state):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, code: int, obj):
+        def _send(self, code: int, obj, extra: Optional[Dict] = None):
             body, ctype = render_body(obj)
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
+        # POSTs bracket the RESPONSE write in the in-flight counter:
+        # handler threads are daemons the server never joins
+        # (socketserver._Threads skips daemon threads), so drain()
+        # must count them itself or a process exit right after
+        # drain() kills a thread mid-write. GETs (health probes,
+        # metrics scrapes) are NOT counted — losing one mid-write is
+        # harmless, and counting them would let monitoring traffic
+        # flake a clean drain
+
         def do_GET(self):
-            self._send(*get_route(self.path, repo, schedulers))
+            self._send(*get_route(self.path, repo, schedulers, state))
 
         def do_POST(self):
+            state.enter_request()
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(n)
-            except (ValueError, OSError) as e:
-                return self._send(400, {"error": f"bad request: {e}"})
-            self._send(*post_route(self.path, body, repo, schedulers))
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                except (ValueError, OSError) as e:
+                    return self._send(400,
+                                      {"error": f"bad request: {e}"})
+                self._send(*post_route(self.path, body, repo,
+                                       schedulers,
+                                       dict(self.headers.items()),
+                                       state))
+            finally:
+                state.exit_request()
 
     return Handler
+
+
+class HttpServerHandle(tuple):
+    """The ``(server, thread, schedulers)`` triple ``serve_http`` has
+    always returned (tuple unpacking keeps working), plus lifecycle
+    methods: ``drain()`` for graceful shutdown, ``stop()`` for an
+    immediate one."""
+
+    def __new__(cls, srv, thread, schedulers, state):
+        self = super().__new__(cls, (srv, thread, schedulers))
+        self.state = state
+        return self
+
+    @property
+    def server(self):
+        return self[0]
+
+    @property
+    def thread(self):
+        return self[1]
+
+    @property
+    def schedulers(self):
+        return self[2]
+
+    def drain(self, deadline_s: float = 10.0) -> bool:
+        """Graceful drain: flip ``/v2/health/ready`` to 503, reject new
+        inference work with 503 + ``Retry-After``, finish in-flight
+        requests (responses written included) within ``deadline_s``,
+        then close the schedulers and the listener. Returns True when
+        nothing was abandoned."""
+        clean = drain_frontend(self[2], self.state, deadline_s)
+        self[0].shutdown()
+        self[0].server_close()     # refuse (not hang) new connections
+        return clean
+
+    def stop(self):
+        """Immediate shutdown: close the listener, fail queued work."""
+        self[0].shutdown()
+        self[0].server_close()
+        for s in list(self[2].values()):
+            s.close()
 
 
 def serve_http(repo, host: str = "127.0.0.1", port: int = 8000,
                batching: bool = True, block: bool = True,
                max_batch: int = 64, max_delay_ms: float = 2.0,
-               max_queue: int = 256):
-    """Serve a :class:`ModelRepository`. ``block=False`` returns the
-    (server, thread, schedulers) triple for in-process testing. Each
-    model's scheduler drains a bounded queue (``max_queue``; overflow =
-    HTTP 503) with one worker per registered instance."""
+               max_queue: int = 256,
+               default_deadline_ms: Optional[float] = None,
+               breaker_threshold: int = 5,
+               breaker_cooldown_s: float = 5.0):
+    """Serve a :class:`ModelRepository`. ``block=False`` returns an
+    :class:`HttpServerHandle` (unpacks as the ``(server, thread,
+    schedulers)`` triple for in-process testing; adds ``drain()``/
+    ``stop()``). Each model's scheduler drains a bounded queue
+    (``max_queue``; overflow = HTTP 503) with one worker per registered
+    instance; ``default_deadline_ms`` applies to requests without an
+    ``x-ff-timeout-ms`` header, and ``breaker_threshold``/
+    ``breaker_cooldown_s`` configure the per-model circuit breaker."""
     from .scheduler import BatchScheduler
     schedulers = {}
+    state = ServingState(default_deadline_ms=default_deadline_ms)
     if batching:
         for name in repo.names():
             schedulers[name] = BatchScheduler(
                 repo.get_instances(name), max_batch=max_batch,
                 max_delay_ms=max_delay_ms, max_queue=max_queue,
-                name=name)
-    srv = ThreadingHTTPServer((host, port), _make_handler(repo, schedulers))
+                name=name, default_deadline_ms=default_deadline_ms,
+                breaker_threshold=breaker_threshold,
+                breaker_cooldown_s=breaker_cooldown_s)
+    srv = ThreadingHTTPServer((host, port),
+                              _make_handler(repo, schedulers, state))
     if block:
         try:
             srv.serve_forever()
@@ -232,4 +468,4 @@ def serve_http(repo, host: str = "127.0.0.1", port: int = 8000,
         return None
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
-    return srv, t, schedulers
+    return HttpServerHandle(srv, t, schedulers, state)

@@ -13,11 +13,35 @@ Triton-scope hardening (reference ``triton/src/instance.cc``,
   - **bounded queue + backpressure**: the queue holds at most
     ``max_queue`` requests; beyond that ``infer`` raises
     :class:`QueueFullError` (HTTP 503) instead of growing without bound;
+  - **request deadlines**: every request may carry a deadline
+    (``x-ff-timeout-ms`` header or the scheduler default); a request
+    whose deadline passes while queued — or whose client timed out and
+    abandoned it — is failed at dequeue time and NEVER consumes a
+    device step;
+  - **admission control**: when the estimated queue wait (EWMA of
+    recent batch latency x backlog) already exceeds a request's
+    deadline, ``infer`` fast-fails with :class:`DeadlineRejectedError`
+    (HTTP 503 + ``Retry-After``) instead of queueing doomed work;
+  - **circuit breaker**: K consecutive session failures open the
+    per-model circuit — requests fast-fail 503 until a cooldown
+    elapses, then ONE half-open probe is admitted; its success closes
+    the circuit, its failure re-opens it (Triton's model-health
+    isolation);
+  - **batch-poison isolation**: inputs are validated against the
+    session signature at admission (:class:`InvalidInputError`, HTTP
+    400, for the malformed request only); if a batch execution still
+    fails, each member is retried individually once so good co-batched
+    requests succeed anyway;
+  - **graceful drain**: :meth:`BatchScheduler.drain` stops admitting
+    (:class:`DrainingError`, HTTP 503 + ``Retry-After``), finishes
+    everything in flight within a drain deadline, then closes;
   - **N concurrent instances**: one worker thread per model instance
     (Triton's ``instance_group { count: N }``), all draining the shared
     queue;
   - **metrics**: per-model counters + latency reservoir feeding the
-    ``/v2/metrics`` endpoint (p50/p99, queue depth, batch sizes).
+    ``/v2/metrics`` endpoint (p50/p99, queue depth, batch sizes), plus
+    expired / deadline-rejected / breaker-open counters and the circuit
+    state in the Prometheus registry.
 """
 from __future__ import annotations
 
@@ -25,7 +49,7 @@ import collections
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -35,10 +59,130 @@ from ..obs.metrics_registry import DEFAULT_BUCKETS, REGISTRY
 #: extended upward for slow generate calls
 LATENCY_BUCKETS = DEFAULT_BUCKETS + (30.0,)
 
+#: numeric encoding of circuit states for the ``ff_circuit_state`` gauge
+CIRCUIT_STATE_NUM = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
 
-class QueueFullError(RuntimeError):
+
+class RequestRejected(RuntimeError):
+    """Base of all load-shedding rejections (HTTP 503).
+
+    ``retry_after_s`` is the server's estimate of when retrying could
+    succeed — surfaced to HTTP clients as the ``Retry-After`` header."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class QueueFullError(RequestRejected):
     """Raised by ``infer`` when the bounded request queue is full —
     callers should shed load (HTTP 503)."""
+
+
+class DeadlineRejectedError(RequestRejected):
+    """Admission control: the estimated queue wait already exceeds the
+    request's deadline, so queueing it would only waste a device step
+    (HTTP 503 + ``Retry-After``)."""
+
+
+class CircuitOpenError(RequestRejected):
+    """The per-model circuit breaker is open after repeated session
+    failures; requests fast-fail until the cooldown's half-open probe
+    succeeds (HTTP 503 + ``Retry-After``)."""
+
+
+class DrainingError(RequestRejected):
+    """The scheduler is draining for shutdown and admits no new work
+    (HTTP 503 + ``Retry-After``)."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline passed before a result was produced —
+    either while queued (the request never reached a device step) or
+    while the client was waiting (HTTP 504)."""
+
+
+class InvalidInputError(ValueError):
+    """Request inputs do not match the session signature (missing or
+    unknown names, wrong feature shape/dtype, ragged rows) — a client
+    error for THIS request only (HTTP 400), caught at admission so it
+    can never poison a co-batched device step."""
+
+
+class CircuitBreaker:
+    """Per-model circuit breaker (Triton model-health isolation analog).
+
+    closed --(K consecutive session failures)--> open --(cooldown
+    elapses)--> half_open: ONE probe request is admitted; its success
+    closes the circuit, its failure re-opens it. ``allow()`` is the
+    admission gate; request outcomes feed back via
+    ``on_success``/``on_failure``. Thread-safe."""
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 5.0,
+                 on_open=None):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._on_open = on_open
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.opens = 0
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    def allow(self) -> Tuple[bool, float, bool]:
+        """Admission gate: ``(admitted, retry_after_s, is_probe)``.
+        ``is_probe`` marks the single half-open probe admission; its
+        holder MUST end in on_success/on_failure — or release_probe if
+        it dies before reaching the session — or the slot would wedge
+        the model in half-open forever."""
+        with self._lock:
+            if self.state == "closed":
+                return True, 0.0, False
+            if self.state == "open":
+                remaining = (self._opened_at + self.cooldown_s
+                             - time.perf_counter())
+                if remaining > 0:
+                    return False, remaining, False
+                self.state = "half_open"
+                self._probe_inflight = False
+            # half_open: admit exactly one probe at a time
+            if self._probe_inflight:
+                return False, self.cooldown_s, False
+            self._probe_inflight = True
+            return True, 0.0, True
+
+    def release_probe(self) -> None:
+        """Give the half-open probe slot back: the admitted probe was
+        shed before execution (queue full, admission rejection) or
+        expired in the queue, so its outcome says nothing about model
+        health — let the next request probe instead."""
+        with self._lock:
+            if self.state == "half_open":
+                self._probe_inflight = False
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self.state == "half_open":
+                self.state = "closed"
+                self._probe_inflight = False
+
+    def on_failure(self) -> None:
+        opened = False
+        with self._lock:
+            self._failures += 1
+            if self.state == "half_open" or (
+                    self.state == "closed"
+                    and self._failures >= self.threshold):
+                self.state = "open"
+                self._opened_at = time.perf_counter()
+                self._failures = 0
+                self._probe_inflight = False
+                self.opens += 1
+                opened = True
+        if opened and self._on_open is not None:
+            self._on_open()
 
 
 class SchedulerMetrics:
@@ -47,7 +191,23 @@ class SchedulerMetrics:
     Doubles as the bridge into the process-wide Prometheus registry
     (``obs/metrics_registry.py``): every completion lands in the
     ``ff_request_latency_seconds`` histogram and the per-model request
-    counters, labeled by model name — what ``GET /metrics`` serves."""
+    counters, labeled by model name — what ``GET /metrics`` serves.
+
+    Counter semantics (disjoint: every admitted-or-rejected request
+    lands in exactly one of completed/failed/expired/rejected/
+    deadline_rejected):
+      - ``rejected``: shed at admission (queue full, circuit open,
+        draining);
+      - ``deadline_rejected``: shed at admission because the estimated
+        queue wait exceeded the request deadline;
+      - ``expired``: admitted but the client never got a result and no
+        device step was spent ON ITS BEHALF (deadline passed or client
+        abandoned at dequeue time, swept at close/unload, or dropped
+        from a failed batch's individual-retry pass because the client
+        was already gone — that last case rode a failed batch attempt,
+        but got no step of its own);
+      - ``failed``: executed (or retried) and errored;
+      - ``completed``: executed successfully."""
 
     def __init__(self, window: int = 2048, name: str = ""):
         self._lock = threading.Lock()
@@ -56,6 +216,9 @@ class SchedulerMetrics:
         self.completed = 0
         self.failed = 0
         self.rejected = 0
+        self.expired = 0
+        self.deadline_rejected = 0
+        self.breaker_opens = 0
         self.batches = 0
         self.batched_rows = 0
         self._lat = collections.deque(maxlen=window)
@@ -66,10 +229,25 @@ class SchedulerMetrics:
             "Inference requests accepted into the queue")
         self._m_rejected = REGISTRY.counter(
             "ff_requests_rejected_total",
-            "Requests shed by bounded-queue backpressure")
+            "Requests shed at admission (queue full, circuit open, "
+            "draining)")
         self._m_failed = REGISTRY.counter(
             "ff_requests_failed_total",
             "Requests completed with an error")
+        self._m_expired = REGISTRY.counter(
+            "ff_requests_expired_total",
+            "Requests whose deadline passed (or whose client abandoned "
+            "them) before producing a result — failed at dequeue, swept "
+            "at close/unload, or skipped in a failed batch's retry pass; "
+            "no device step was spent on their behalf alone")
+        self._m_deadline_rejected = REGISTRY.counter(
+            "ff_requests_deadline_rejected_total",
+            "Requests shed at admission: estimated queue wait exceeded "
+            "the request deadline")
+        self._m_breaker_opens = REGISTRY.counter(
+            "ff_breaker_opens_total",
+            "Circuit-breaker open transitions (consecutive session "
+            "failures reached the threshold)")
         self._m_latency = REGISTRY.histogram(
             "ff_request_latency_seconds",
             "End-to-end request latency (queue + batch assembly + "
@@ -84,6 +262,21 @@ class SchedulerMetrics:
         with self._lock:
             self.rejected += 1
         self._m_rejected.inc(model=self.name)
+
+    def record_deadline_rejected(self):
+        with self._lock:
+            self.deadline_rejected += 1
+        self._m_deadline_rejected.inc(model=self.name)
+
+    def record_expired(self):
+        with self._lock:
+            self.expired += 1
+        self._m_expired.inc(model=self.name)
+
+    def record_breaker_open(self):
+        with self._lock:
+            self.breaker_opens += 1
+        self._m_breaker_opens.inc(model=self.name)
 
     def record_done(self, latency_s: float, ok: bool):
         with self._lock:
@@ -104,6 +297,9 @@ class SchedulerMetrics:
                 "completed": self.completed,
                 "failed": self.failed,
                 "rejected": self.rejected,
+                "expired": self.expired,
+                "deadline_rejected": self.deadline_rejected,
+                "breaker_opens": self.breaker_opens,
                 "batches": self.batches,
                 "mean_batch_rows": (self.batched_rows
                                     / max(self.batches, 1)),
@@ -114,10 +310,16 @@ class SchedulerMetrics:
 
 
 class _Request:
-    __slots__ = ("inputs", "event", "result", "error", "t0")
+    __slots__ = ("inputs", "rows", "deadline", "abandoned", "probe",
+                 "event", "result", "error", "t0")
 
-    def __init__(self, inputs):
+    def __init__(self, inputs, rows: int = 0,
+                 deadline: Optional[float] = None, probe: bool = False):
         self.inputs = inputs
+        self.rows = rows or int(next(iter(inputs.values())).shape[0])
+        self.deadline = deadline      # absolute perf_counter time
+        self.abandoned = False        # client gave up waiting
+        self.probe = probe            # holds the half-open probe slot
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
@@ -131,21 +333,49 @@ class BatchScheduler:
     ``sessions`` may be one session or a list (one per concurrent
     instance — Triton's instance group); each gets its own worker
     thread draining the shared queue.
-    """
+
+    ``default_deadline_ms`` applies to requests that carry no explicit
+    deadline; ``breaker_threshold``/``breaker_cooldown_s`` configure
+    the per-model circuit breaker; ``est_batch_latency_s`` seeds the
+    admission-control EWMA before the first measured batch (cold-start
+    estimates and tests)."""
 
     def __init__(self, sessions, max_batch: int = 64,
                  max_delay_ms: float = 2.0, max_queue: int = 256,
-                 name: str = ""):
+                 name: str = "", default_deadline_ms: Optional[float] = None,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 5.0,
+                 est_batch_latency_s: Optional[float] = None):
         if not isinstance(sessions, (list, tuple)):
             sessions = [sessions]
-        assert sessions, "need at least one session instance"
+        if not sessions:
+            raise ValueError("need at least one session instance")
         self.sessions: List = list(sessions)
         self.session = self.sessions[0]    # back-compat alias
         self.max_batch = max_batch
         self.max_delay_s = max_delay_ms / 1e3
+        self.default_deadline_ms = default_deadline_ms
         self.metrics = SchedulerMetrics(name=name)
+        self.breaker = CircuitBreaker(
+            breaker_threshold, breaker_cooldown_s,
+            on_open=self.metrics.record_breaker_open)
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
+        self._draining = False
+        # admission-control state: EWMA of measured batch latency plus
+        # the current backlog (queued + executing rows), under one lock
+        self._stat_lock = threading.Lock()
+        self._ewma_batch_s = (float(est_batch_latency_s)
+                              if est_batch_latency_s is not None
+                              else None)
+        self._queued_rows = 0
+        self._active_rows = 0
+        self._active = 0              # requests popped but not finished
+        # admitted but not yet resolved (queued, in a worker's hand
+        # between pop and the _active bump, or executing): drain()'s
+        # idle check — _active alone has a pop-vs-bump TOCTOU window
+        # in which a mid-execution request looks idle
+        self._pending = 0
         self._workers = [
             threading.Thread(target=self._run, args=(s,), daemon=True)
             for s in self.sessions]
@@ -157,23 +387,189 @@ class BatchScheduler:
         return len(self.sessions)
 
     # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    def _validate(self, inputs) -> Tuple[Dict[str, np.ndarray], int]:
+        """Admission-time schema check against the session signature:
+        missing names, ragged row counts, wrong feature shapes/dtypes
+        raise :class:`InvalidInputError` (HTTP 400) for THIS request
+        only, before it can poison a co-batched device step."""
+        names = self.session.input_names
+        missing = [n for n in names if n not in inputs]
+        if missing:
+            raise InvalidInputError(
+                f"missing inputs: {missing} (expected {names})")
+        unknown = [k for k in inputs if k not in names]
+        if unknown:
+            # a typo'd optional tensor silently dropped would return a
+            # 200 computed without data the client thought it sent
+            raise InvalidInputError(
+                f"unknown inputs: {unknown} (expected {names})")
+        sig = getattr(self.session, "input_signature", None) or {}
+        arrs: Dict[str, np.ndarray] = {}
+        rows = None
+        for n in names:
+            arr = np.asarray(inputs[n])
+            if arr.ndim < 1:
+                raise InvalidInputError(
+                    f"input {n!r} must have a leading batch dimension")
+            if rows is None:
+                rows = int(arr.shape[0])
+            elif int(arr.shape[0]) != rows:
+                raise InvalidInputError(
+                    f"ragged batch: {n!r} has {arr.shape[0]} rows, "
+                    f"other inputs have {rows}")
+            if n in sig:
+                shape, dtype = sig[n]
+                if tuple(arr.shape[1:]) != tuple(shape[1:]):
+                    raise InvalidInputError(
+                        f"input {n!r} feature shape {tuple(arr.shape[1:])}"
+                        f" does not match the model's {tuple(shape[1:])}")
+                if not np.can_cast(arr.dtype, dtype, casting="same_kind"):
+                    raise InvalidInputError(
+                        f"input {n!r} dtype {arr.dtype} is not "
+                        f"compatible with the model's {dtype}")
+                if arr.dtype != dtype:
+                    # normalize compatible dtypes HERE so one request
+                    # sending f64 cannot force a per-dtype recompile of
+                    # the warm executable (and cannot poison a batch
+                    # concat with a surprise promotion)
+                    arr = arr.astype(dtype, copy=False)
+            arrs[n] = arr
+        if not rows:
+            raise InvalidInputError("empty batch (0 rows)")
+        return arrs, rows
+
+    def estimated_wait_s(self) -> float:
+        """Admission-control estimate: EWMA of recent batch latency x
+        the backlog in batches, split across instances. 0.0 until a
+        first batch has been measured (or a seed was given)."""
+        with self._stat_lock:
+            ewma = self._ewma_batch_s
+            backlog = self._queued_rows + self._active_rows
+        if ewma is None or backlog <= 0:
+            return 0.0
+        batches = backlog / float(max(1, self.max_batch))
+        return ewma * batches / max(1, self.num_instances)
+
     def infer(self, inputs: Dict[str, np.ndarray],
-              timeout: float = 30.0) -> np.ndarray:
+              timeout: float = 30.0,
+              deadline_ms: Optional[float] = None) -> np.ndarray:
         """Blocking single-request API (each row batch is one request).
-        Raises :class:`QueueFullError` when the bounded queue is full."""
-        r = _Request(inputs)
+
+        ``deadline_ms`` (or the scheduler's ``default_deadline_ms``)
+        bounds the request end-to-end: admission control fast-fails
+        when the estimated queue wait already exceeds it
+        (:class:`DeadlineRejectedError`), a queued request whose
+        deadline passes is failed without a device step, and a timed-out
+        wait marks the request abandoned so it cannot be batched later.
+        Raises :class:`QueueFullError` / :class:`CircuitOpenError` /
+        :class:`DrainingError` for the shedding cases (HTTP 503) and
+        :class:`InvalidInputError` for malformed inputs (HTTP 400)."""
+        if self._draining:
+            self.metrics.record_rejected()
+            raise DrainingError(
+                f"model {self.metrics.name!r} is draining for shutdown",
+                retry_after_s=5.0)
+        arrs, rows = self._validate(inputs)
+        admitted, retry_after, probe = self.breaker.allow()
+        if not admitted:
+            self.metrics.record_rejected()
+            raise CircuitOpenError(
+                f"circuit open for model {self.metrics.name!r} after "
+                f"repeated session failures; retry in {retry_after:.1f}s",
+                retry_after_s=max(retry_after, 0.05))
+        dl_ms = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        deadline = None
+        if dl_ms is not None and dl_ms > 0:
+            deadline = time.perf_counter() + dl_ms / 1e3
+            est = self.estimated_wait_s()
+            if est > dl_ms / 1e3:
+                if probe:
+                    # the probe dies before execution: its outcome says
+                    # nothing about model health, so the slot must not
+                    # stay held or half-open would wedge forever
+                    self.breaker.release_probe()
+                self.metrics.record_deadline_rejected()
+                raise DeadlineRejectedError(
+                    f"estimated queue wait {est * 1e3:.0f} ms exceeds "
+                    f"the request deadline {dl_ms:.0f} ms",
+                    retry_after_s=max(est - dl_ms / 1e3, 0.1))
+        r = _Request(arrs, rows, deadline, probe=probe)
+        # count the rows BEFORE the put: a worker popping the request
+        # immediately would otherwise decrement first and drive the
+        # admission backlog transiently negative under load
+        with self._stat_lock:
+            self._queued_rows += rows
+            self._pending += 1
         try:
             self._q.put_nowait(r)
         except queue.Full:
+            with self._stat_lock:
+                self._queued_rows -= rows
+                self._pending -= 1
+            if probe:
+                self.breaker.release_probe()
             self.metrics.record_rejected()
             raise QueueFullError(
                 f"request queue full ({self._q.maxsize}); retry later")
         self.metrics.record_submitted()
-        if not r.event.wait(timeout):
+        if self._stop.is_set():
+            # raced close(): its sweep may already have passed this
+            # request, leaving it on a queue no worker reads — re-run
+            # the sweep so the client fails promptly, not at timeout
+            self._fail_queued()
+        wait_s = timeout
+        if deadline is not None:
+            wait_s = min(timeout,
+                         max(deadline - time.perf_counter(), 0.0))
+        # a huge or inf timeout/deadline (API callers) must not
+        # OverflowError out of Event.wait with the request enqueued —
+        # the orphan would still consume a device step
+        wait_s = min(wait_s, threading.TIMEOUT_MAX)
+        if not r.event.wait(wait_s):
+            # mark abandoned so the workers skip it at dequeue time —
+            # a timed-out client must never consume a device step
+            r.abandoned = True
+            if deadline is not None \
+                    and time.perf_counter() >= deadline:
+                raise DeadlineExceededError(
+                    f"request deadline ({dl_ms:.0f} ms) exceeded")
             raise TimeoutError("inference request timed out")
         if r.error is not None:
             raise r.error
         return r.result
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict:
+        """Scheduler snapshot + circuit/drain state (the per-model row
+        of ``GET /v2/metrics`` and the ``/healthz`` serving block)."""
+        s = self.metrics.snapshot(self._q.qsize())
+        s["instances"] = self.num_instances
+        s["circuit"] = self.breaker.state
+        s["draining"] = self._draining
+        return s
+
+    def drain(self, deadline_s: float = 10.0) -> bool:
+        """Graceful drain: stop admitting (``infer`` raises
+        :class:`DrainingError` -> HTTP 503 + ``Retry-After``), finish
+        everything queued or executing within ``deadline_s``, then
+        close. Returns True when nothing was left behind."""
+        self._draining = True
+        end = time.perf_counter() + max(0.0, deadline_s)
+        while time.perf_counter() < end:
+            with self._stat_lock:
+                idle = self._pending == 0
+            if idle:
+                break
+            time.sleep(0.005)
+        with self._stat_lock:
+            clean = self._pending == 0
+        self.close()
+        return clean
 
     def close(self):
         """Stop the workers and promptly fail anything still queued —
@@ -181,39 +577,146 @@ class BatchScheduler:
         self._stop.set()
         for w in self._workers:
             w.join(timeout=5)
+        self._fail_queued()
+
+    def _fail_queued(self):
+        """Fail everything still queued (no worker will ever pop it):
+        close()'s sweep, re-run by any ``infer`` whose enqueue raced
+        past it."""
         while True:
             try:
                 r = self._q.get_nowait()
             except queue.Empty:
-                break
-            r.error = RuntimeError("scheduler closed (model unloaded)")
-            self.metrics.record_done(time.perf_counter() - r.t0,
-                                     ok=False)
+                return
+            with self._stat_lock:
+                self._queued_rows -= r.rows
+                self._pending -= 1
+            if r.probe:
+                self.breaker.release_probe()
+            # a shed, not a client error: RequestRejected maps to 503 +
+            # Retry-After so retry-aware clients try another replica.
+            # Counted as expired (never consumed a device step), NOT
+            # failed — ff_requests_failed_total is a model-health
+            # signal and must not fire on routine unload/shutdown
+            r.error = RequestRejected(
+                "scheduler closed (model unloaded or shut down); "
+                "retry against another replica", retry_after_s=5.0)
+            self.metrics.record_expired()
             r.event.set()
 
     # ------------------------------------------------------------------
+    # worker side
+    # ------------------------------------------------------------------
+    def _expire(self, r: _Request, active: bool = False):
+        """Fail a request without running it (deadline passed or client
+        abandoned) — it never consumes a device step. ``active`` marks
+        requests already counted in flight (the individual-retry path);
+        an expired probe gives its half-open slot back."""
+        with self._stat_lock:
+            self._pending -= 1
+            if active:
+                self._active -= 1
+                self._active_rows -= r.rows
+        if r.probe:
+            self.breaker.release_probe()
+        r.error = DeadlineExceededError(
+            "request expired in queue before reaching a device step")
+        self.metrics.record_expired()
+        r.event.set()
+
+    def _take(self, timeout: float) -> Optional[_Request]:
+        """Pop the next LIVE request; expired/abandoned ones are failed
+        on the spot and skipped. None on timeout."""
+        end = time.perf_counter() + timeout
+        while True:
+            remaining = end - time.perf_counter()
+            if remaining <= 0:
+                return None
+            try:
+                r = self._q.get(timeout=remaining)
+            except queue.Empty:
+                return None
+            with self._stat_lock:
+                self._queued_rows -= r.rows
+            if r.abandoned or (r.deadline is not None
+                               and time.perf_counter() >= r.deadline):
+                self._expire(r)
+                continue
+            with self._stat_lock:
+                self._active += 1
+                self._active_rows += r.rows
+            return r
+
     def _drain(self) -> List[_Request]:
-        """Block for one request, then batch whatever arrives within the
-        delay window (up to max_batch rows)."""
-        try:
-            first = self._q.get(timeout=0.1)
-        except queue.Empty:
+        """Block for one live request, then batch whatever arrives
+        within the delay window (up to max_batch rows)."""
+        first = self._take(0.1)
+        if first is None:
             return []
         batch = [first]
-        rows = int(next(iter(first.inputs.values())).shape[0])
+        rows = first.rows
         deadline = self.max_delay_s
         t0 = time.perf_counter()
         while rows < self.max_batch:
             remaining = deadline - (time.perf_counter() - t0)
             if remaining <= 0:
                 break
-            try:
-                r = self._q.get(timeout=remaining)
-            except queue.Empty:
+            r = self._take(remaining)
+            if r is None:
                 break
             batch.append(r)
-            rows += int(next(iter(r.inputs.values())).shape[0])
+            rows += r.rows
         return batch
+
+    def _finish_ok(self, r: _Request, now: float):
+        with self._stat_lock:
+            self._pending -= 1
+            self._active -= 1
+            self._active_rows -= r.rows
+        self.metrics.record_done(now - r.t0, ok=True)
+        r.event.set()
+
+    def _finish_error(self, r: _Request, e: Exception):
+        with self._stat_lock:
+            self._pending -= 1
+            self._active -= 1
+            self._active_rows -= r.rows
+        r.error = e
+        self.metrics.record_done(time.perf_counter() - r.t0, ok=False)
+        r.event.set()
+
+    def _observe_batch_latency(self, dt: float):
+        with self._stat_lock:
+            if self._ewma_batch_s is None:
+                self._ewma_batch_s = dt
+            else:
+                self._ewma_batch_s = 0.7 * self._ewma_batch_s + 0.3 * dt
+
+    def _retry_individually(self, session, batch: List[_Request]):
+        """A failed batch may contain ONE poisoned member: retry each
+        request alone once so good co-batched requests still succeed
+        (request-level fault isolation); only the bad member fails.
+        Members whose client is gone (deadline passed or abandoned
+        during the failed batch attempt) are expired instead of
+        retried — no device step for work nobody is waiting on, and no
+        spurious breaker feedback from it."""
+        for r in batch:
+            if r.abandoned or (r.deadline is not None
+                               and time.perf_counter() >= r.deadline):
+                self._expire(r, active=True)
+                continue
+            try:
+                out = session.infer(r.inputs)
+            except Exception as e:  # noqa: BLE001 — isolate per request
+                # breaker BEFORE the event: a client retrying the
+                # instant the K-th failure surfaces must hit the open
+                # circuit, not race past the threshold
+                self.breaker.on_failure()
+                self._finish_error(r, e)
+            else:
+                r.result = out
+                self.breaker.on_success()
+                self._finish_ok(r, time.perf_counter())
 
     def _run(self, session):
         while not self._stop.is_set():
@@ -222,9 +725,8 @@ class BatchScheduler:
                 continue
             with self.metrics._lock:
                 self.metrics.batches += 1
-                self.metrics.batched_rows += sum(
-                    int(next(iter(r.inputs.values())).shape[0])
-                    for r in batch)
+                self.metrics.batched_rows += sum(r.rows for r in batch)
+            t_exec = time.perf_counter()
             try:
                 names = session.input_names
                 stacked = {
@@ -232,17 +734,18 @@ class BatchScheduler:
                     for n in names}
                 out = session.infer(stacked)
             except Exception as e:  # noqa: BLE001 — fan the error out
-                now = time.perf_counter()
-                for r in batch:
-                    r.error = e
-                    self.metrics.record_done(now - r.t0, ok=False)
-                    r.event.set()
+                if len(batch) > 1:
+                    self._retry_individually(session, batch)
+                else:
+                    # breaker BEFORE the event (see _retry_individually)
+                    self.breaker.on_failure()
+                    self._finish_error(batch[0], e)
                 continue
+            self._observe_batch_latency(time.perf_counter() - t_exec)
+            self.breaker.on_success()
             off = 0
             now = time.perf_counter()
             for r in batch:
-                n = int(next(iter(r.inputs.values())).shape[0])
-                r.result = out[off:off + n]
-                off += n
-                self.metrics.record_done(now - r.t0, ok=True)
-                r.event.set()
+                r.result = out[off:off + r.rows]
+                off += r.rows
+                self._finish_ok(r, now)

@@ -8,9 +8,11 @@ input-shape) and zero-copy host->device batch assembly.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..resilience import faults
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -51,25 +53,49 @@ class InferenceSession:
     def input_names(self) -> List[str]:
         return [t.name for t in self.ff.graph_inputs]
 
+    @property
+    def input_signature(self) -> Dict[str, Tuple[Tuple[int, ...],
+                                                 np.dtype]]:
+        """name -> (compile-time shape, numpy dtype) for each graph
+        input. ``shape[0]`` is the COMPILE-TIME batch size — requests
+        may send any row count; the scheduler's admission validation
+        compares only ``shape[1:]`` and the dtype."""
+        return {t.name: (tuple(t.shape), np.dtype(t.jnp_dtype))
+                for t in self.ff.graph_inputs}
+
     def infer(self, inputs: Dict[str, np.ndarray]) -> np.ndarray:
         """Run one batch; pads to the bucket and slices the result.
         Batches larger than the biggest bucket run in bucket-sized
-        chunks (one executable, several dispatches)."""
+        chunks (one executable, several dispatches). Client errors
+        (missing inputs, ragged rows) raise :class:`ValueError` — not
+        ``assert``, which vanishes under ``python -O`` and would turn
+        them into shape crashes deep in XLA."""
+        if faults.active():
+            faults.raise_infer_fault()
+        return self._infer_checked(inputs)
+
+    def _infer_checked(self, inputs: Dict[str, np.ndarray]) -> np.ndarray:
+        # chunk recursion goes through here, NOT infer(): the fault
+        # hook must advance the infer_fail@N counter exactly once per
+        # top-level call or clause indices stop matching request counts
         names = self.input_names
         missing = [n for n in names if n not in inputs]
-        assert not missing, f"missing inputs: {missing}"
+        if missing:
+            raise ValueError(f"missing inputs: {missing}")
         n = int(next(iter(inputs.values())).shape[0])
         cap = self.buckets[-1]
         if n > cap:
             return np.concatenate(
-                [self.infer({k: v[i:i + cap] for k, v in inputs.items()})
+                [self._infer_checked(
+                    {k: v[i:i + cap] for k, v in inputs.items()})
                  for i in range(0, n, cap)], axis=0)
         bucket = _next_bucket(n, self.buckets)
         padded = {}
         for name in names:
             arr = np.ascontiguousarray(inputs[name])
-            assert arr.shape[0] == n, \
-                f"ragged batch: {name} has {arr.shape[0]} rows, want {n}"
+            if arr.shape[0] != n:
+                raise ValueError(f"ragged batch: {name} has "
+                                 f"{arr.shape[0]} rows, want {n}")
             if bucket != n:
                 pad = np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)
                 arr = np.concatenate([arr, pad], axis=0)

@@ -66,6 +66,13 @@ def _scripted(default_probe_results):
             return {"sync_step_s": 0.002, "deferred_step_s": 0.0018,
                     "deferred_vs_sync": 1.08, "chunk": 16,
                     "rounds": 10, "ok": True}, None
+        if stage == "serving_overload":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            return {"capacity_rps": 100.0, "offered_x_capacity": 2.0,
+                    "deadline_ms": 100.0, "baseline": {},
+                    "shedding": {}, "goodput_base_rps": 3.2,
+                    "goodput_shed_rps": 52.4, "goodput_ratio": 16.4,
+                    "ok": True}, None
         if stage == "recovery":
             assert env.get("JAX_PLATFORMS") == "cpu"
             assert "xla_force_host_platform_device_count" \
@@ -145,3 +152,8 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         assert out["ckpt_sync_overhead_pct"] == 2.3
         assert out["time_to_recover_s"] == 0.5
         assert any(a[1] == "recovery" for a, _ in calls)
+        # and the serving-overload goodput leg (ISSUE 5)
+        assert out["serving_goodput_ratio"] == 16.4
+        assert out["serving_goodput_shed_rps"] == 52.4
+        assert out["serving_goodput_base_rps"] == 3.2
+        assert any(a[1] == "serving_overload" for a, _ in calls)

@@ -343,12 +343,29 @@ def test_healthz_carries_resilience_block(tmp_path):
     from flexflow_tpu.serving.http_server import get_route
     status.record("restarts")
     status.record_checkpoint(12)
-    code, doc = get_route("/healthz", None, {})
+    code, doc, _ = get_route("/healthz", None, {})
     assert code == 200 and doc["status"] == "ok"
     r = doc["resilience"]
     assert r["restarts"] == 1
     assert r["last_checkpoint_step"] == 12
     assert r["checkpoint_age_s"] >= 0.0
+
+
+def test_infer_fault_counter_resets_per_plan():
+    """infer_fail@N indices count from the plan's installation: a
+    second plan installed in the same process must see call index 0
+    again, not wherever the previous plan's counter left off."""
+    from flexflow_tpu.resilience import faults
+    try:
+        faults.install("infer_fail@0")
+        with pytest.raises(faults.FaultError):
+            faults.raise_infer_fault()
+        assert faults.get_plan().unfired() == 0
+        faults.install("infer_fail@0")
+        with pytest.raises(faults.FaultError):
+            faults.raise_infer_fault()
+    finally:
+        faults.clear()
 
 
 # ======================================================================

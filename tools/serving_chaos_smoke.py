@@ -1,0 +1,147 @@
+"""CI serving-chaos smoke (ci.sh fast tier).
+
+Injects consecutive inference failures via ``FF_FAULT_PLAN`` (kind
+``infer_fail@N``: the N-th ``InferenceSession.infer`` call made while
+a plan is active raises), drives the HTTP front end-to-end, and
+asserts the overload-robustness contract:
+
+  1. K consecutive session failures OPEN the per-model circuit breaker
+     — further requests fast-fail 503 + ``Retry-After`` without
+     touching the device, and ``/healthz`` reports the open circuit;
+  2. after the cooldown, the half-open probe succeeds and RESTORES
+     service (circuit closed, 200s again);
+  3. ``drain()`` finishes in-flight requests and the process exits
+     cleanly.
+
+Exit code 0 = the breaker cycle and graceful drain work end-to-end.
+
+    FF_FAULT_PLAN="infer_fail@0;infer_fail@1;infer_fail@2" \
+        python tools/serving_chaos_smoke.py
+"""
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BREAKER_THRESHOLD = 3
+BREAKER_COOLDOWN_S = 0.5
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def main():
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    from flexflow_tpu.resilience import faults
+    from flexflow_tpu.serving import (InferenceSession, ModelRepository,
+                                      serve_http)
+
+    plan = faults.get_plan()
+    if not plan.faults:
+        faults.install(";".join(f"infer_fail@{i}"
+                                for i in range(BREAKER_THRESHOLD)))
+        plan = faults.get_plan()
+    n_clauses = len(plan.faults)
+    assert n_clauses >= BREAKER_THRESHOLD, \
+        f"need >= {BREAKER_THRESHOLD} infer_fail clauses, got {n_clauses}"
+
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 16, in_dim=8, hidden=(16,), num_classes=4)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    repo = ModelRepository()
+    repo.register("m", InferenceSession(ff, batch_buckets=(1, 4)))
+    handle = serve_http(repo, port=_free_port(), block=False,
+                        max_batch=1,
+                        breaker_threshold=BREAKER_THRESHOLD,
+                        breaker_cooldown_s=BREAKER_COOLDOWN_S)
+    base = f"http://127.0.0.1:{handle.server.server_address[1]}"
+    body = json.dumps({"inputs": [{
+        "name": "input", "shape": [1, 8], "data": [0.0] * 8}]}).encode()
+
+    def post():
+        req = urllib.request.Request(f"{base}/v2/models/m/infer",
+                                     data=body)
+        try:
+            r = urllib.request.urlopen(req, timeout=60)
+            return r.status, dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers)
+
+    def healthz():
+        try:
+            return json.loads(urllib.request.urlopen(
+                f"{base}/healthz", timeout=10).read())
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read())
+
+    try:
+        # phase 1: K injected session failures -> breaker opens
+        codes = [post()[0] for _ in range(BREAKER_THRESHOLD)]
+        assert all(c != 200 for c in codes), \
+            f"injected failures must surface as errors, got {codes}"
+        h = healthz()
+        assert h["serving"]["m"]["circuit"] == "open", h["serving"]
+        t0 = time.perf_counter()
+        st, hdrs = post()
+        fast = time.perf_counter() - t0
+        assert st == 503, f"open circuit must 503, got {st}"
+        assert int(hdrs.get("Retry-After", 0)) >= 1, hdrs
+        assert fast < 1.0, f"open-circuit rejection took {fast:.2f}s"
+        mtext = urllib.request.urlopen(f"{base}/metrics",
+                                       timeout=10).read().decode()
+        assert 'ff_breaker_opens_total{model="m"} 1' in mtext, \
+            "breaker open not visible in /metrics"
+        assert 'ff_circuit_state{model="m"} 2' in mtext
+
+        # phase 2: cooldown -> half-open probe succeeds -> closed
+        time.sleep(BREAKER_COOLDOWN_S + 0.1)
+        st, _ = post()
+        assert st == 200, f"half-open probe should restore service: {st}"
+        h = healthz()
+        assert h["serving"]["m"]["circuit"] == "closed", h["serving"]
+        st, _ = post()
+        assert st == 200, f"service not restored after close: {st}"
+        assert plan.unfired() == 0, \
+            f"{plan.unfired()} fault clause(s) never fired"
+
+        # phase 3: graceful drain with work in flight
+        results = []
+
+        def fire():
+            results.append(post()[0])
+
+        t = threading.Thread(target=fire)
+        t.start()
+        time.sleep(0.02)
+        clean = handle.drain(deadline_s=10)
+        t.join()
+        assert results and all(
+            c in (200, 503) for c in results), results
+        assert clean, "drain abandoned in-flight work"
+    except BaseException:
+        handle.stop()
+        raise
+    print(f"serving chaos smoke OK: {n_clauses} injected failures "
+          f"opened the breaker, probe restored service, drain clean")
+
+
+if __name__ == "__main__":
+    main()

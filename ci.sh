@@ -35,6 +35,10 @@ case "${1:-fast}" in
     # reach IDENTICAL final losses — the async path can never silently
     # diverge from the sync-every-step semantics
     python tools/async_parity_smoke.py
+    # reshard parity smoke: searched layout-transition plans must stay
+    # BIT-IDENTICAL to the FF_NAIVE_RESHARD=1 baseline — both the raw
+    # transition matrix and a pipelined model's region boundaries
+    python tools/reshard_parity_smoke.py
     # serving chaos smoke: injected inference failures must open the
     # per-model circuit breaker (fast 503 + Retry-After), the half-open
     # probe after the cooldown must restore service, and drain() must

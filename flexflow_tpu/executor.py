@@ -34,6 +34,7 @@ from .core.tensor import Tensor
 from .dtypes import to_jnp
 from .obs import events as obs_events
 from .ops import EmitCtx, get_op_def
+from .parallel import reshard as reshard_mod
 from .parallel.machine import DeviceMesh
 from .parallel.strategy import ShardingStrategy
 from .runtime import losses as losses_mod
@@ -185,10 +186,16 @@ class GraphProgram:
                 if strategy is not None:
                     sh = strategy.output_sharding(layer.name, i)
                     if sh is not None:
-                        o = jax.lax.with_sharding_constraint(o, sh)
+                        # layout-op outputs take the PLANNED transition
+                        # (explicit collectives under shard_map) — a bare
+                        # constraint lets GSPMD propagate it backward
+                        # through reshape/concat, the documented CPU
+                        # miscompile (parallel/reshard.py)
+                        o = reshard_mod.constrain_output(
+                            o, sh, strategy, layer)
                         if cast:
-                            pre_cast = jax.lax.with_sharding_constraint(
-                                pre_cast, sh)
+                            pre_cast = reshard_mod.constrain_output(
+                                pre_cast, sh, strategy, layer)
                 env[t.guid] = o
                 if capture is not None:
                     # capture keeps the pre-bf16-cast (but still
@@ -223,10 +230,10 @@ class GraphProgram:
             if ish[0] % bdeg == 0:
                 batch_spec = (bk.batch_axes[0] if len(bk.batch_axes) == 1
                               else tuple(bk.batch_axes))
+        from .parallel.banks import rejoin_stack, shard_stack
         xs = jnp.stack([env[m.inputs[0].guid] for m in members])
         in_sp = P(bank_spec, batch_spec, *([None] * (xs.ndim - 2)))
-        xs = jax.lax.with_sharding_constraint(
-            xs, NamedSharding(mesh, in_sp))
+        xs = shard_stack(xs, members[0].inputs[0], in_sp, strategy)
         w = params.get(bk.param_name, {})
         emit_params = members[0].params
         if getattr(bk, "padded", False):
@@ -245,6 +252,7 @@ class GraphProgram:
         out_sp = P(bank_spec, batch_spec, *([None] * (out.ndim - 2)))
         out = jax.lax.with_sharding_constraint(
             out, NamedSharding(mesh, out_sp))
+        out = rejoin_stack(out, bank_spec, batch_spec, strategy)
         for k, m in enumerate(members):
             bank_out[m.name] = out[k]
 
@@ -461,8 +469,11 @@ class Executor:
         psh: Dict[str, Dict[str, Any]] = {}
         ssh: Dict[str, Dict[str, Any]] = {}
         params, state = self._build_params_and_state(seed, psh, ssh)
-        params = jax.device_put(params, psh)
-        state = jax.device_put(state, ssh)
+        # placement via the reshard planner's host→device step: sharded
+        # leaves hand each device only its own slice instead of staging
+        # a full per-device replica (parallel/reshard.place_host)
+        params = jax.tree.map(reshard_mod.place_host, params, psh)
+        state = jax.tree.map(reshard_mod.place_host, state, ssh)
         return params, state
 
     def _build_params_and_state(self, seed, psh, ssh):
@@ -791,7 +802,10 @@ class Executor:
                 raw_xs[t_.name] = a.reshape((M, a.shape[0] // M)
                                             + a.shape[1:])
         else:
-            x = env[pipe.entry_guid]
+            from .parallel.pipeline_lowering import region_entry_transition
+            x = region_entry_transition(
+                env[pipe.entry_guid], self.strategy,
+                self._tensor_by_guid(pipe.entry_guid))
             raw_xs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
 
         epilogue_fn = None
@@ -825,6 +839,8 @@ class Executor:
             out_specs=ys_spec, check_vma=False)
         ys = fn(stacked, pro_params, epi_params, raw_xs,
                 hidden_example, out_example)
+        from .parallel.pipeline_lowering import region_exit_transition
+        ys = region_exit_transition(ys, self.strategy, ys_spec)
         return ys.reshape((-1,) + ys.shape[2:])
 
     def _make_stage_fn(self, training: bool):
@@ -903,6 +919,10 @@ class Executor:
             stacked = dict(stacked, __rng__=chunk_keys)
         assert x.shape[0] % M == 0, \
             f"batch {x.shape[0]} not divisible into {M} microbatches"
+        from .parallel.pipeline_lowering import (region_entry_transition,
+                                                 region_exit_transition)
+        x = region_entry_transition(x, self.strategy,
+                                    self._tensor_by_guid(pipe.entry_guid))
         xs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
         engine = gpipe(self._make_stage_fn(training), pipe.pp_axis, M,
                        with_step_arg=True, n_chunks=v)
@@ -932,6 +952,7 @@ class Executor:
                            in_specs=(param_specs, xs_spec),
                            out_specs=xs_spec, check_vma=False)
         ys = fn(stacked, xs)
+        ys = region_exit_transition(ys, self.strategy, xs_spec)
         return ys.reshape((-1,) + ys.shape[2:])
 
     # ------------------------------------------------------------------

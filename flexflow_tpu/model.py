@@ -688,6 +688,16 @@ class FFModel:
                                      self.strategy, self.optimizer,
                                      self.loss_type, self.metrics,
                                      seed=self.config.seed)
+        # searched data movement: one reshard planner per strategy plans
+        # every layout transition (bank boundaries, pipeline-region
+        # entry/exit, layout-op output constraints) with scored explicit
+        # collectives; chosen step sequences annotate the strategy audit
+        from .parallel.reshard import ReshardPlanner
+        pl = getattr(self.strategy, "resharder", None)
+        if pl is None or pl.dmesh is not self.dmesh:
+            pl = ReshardPlanner(self.dmesh)
+            self.strategy.resharder = pl
+        pl.audit_path = getattr(self, "_strategy_audit_path", None)
         _t0 = time.perf_counter()
         self.params, self.state = self.executor.init_params_and_state()
         if hasattr(self, "_compile_phases"):

@@ -243,3 +243,44 @@ def choose_bank_axes(dmesh, k_members: int,
     batch = tuple(a for a in dmesh.axis_sizes
                   if a not in best and a not in reserved)
     return tuple(best), batch
+
+
+def rejoin_stack(out, bank_spec, batch_spec, strategy):
+    """Explicitly rejoin a banked output stack with the rest of the
+    graph: gather ONLY the bank dim (an all-gather over the bank axes,
+    batch sharding untouched) through the reshard planner, so the
+    downstream per-member reads (``out[k]``) are local indexing instead
+    of a GSPMD-chosen gather rewrite — the rewrite miscompiles on CPU
+    when a pipeline region reshards the same value again (NaN in the
+    banks x pipeline composition). ``FF_NAIVE_RESHARD=1`` keeps the
+    implicit (pre-planner) rejoin."""
+    from jax.sharding import PartitionSpec as P
+    from .reshard import naive_reshard, planner_for
+    if naive_reshard():
+        return out
+    pad = [None] * (out.ndim - 2)
+    src = P(bank_spec, batch_spec, *pad)
+    dst = P(None, batch_spec, *pad)
+    return planner_for(strategy).apply(out, src, dst)
+
+
+def shard_stack(xs, member_t, bank_in_sp, strategy):
+    """Explicitly transition the stacked member inputs onto the bank
+    layout. Stacking shifts every member dim right by one, so a
+    batch-sharded member input lands at ``P(None, dp, ...)`` while the
+    bank wants ``P(bank, batch, ...)`` — an axis MOVE, which the
+    planner lowers as one all-to-all at constant per-device memory (the
+    arXiv 2112.01075 primitive) instead of GSPMD's gather rewrite,
+    which miscompiles this transition on CPU when a pipeline region
+    reshards the value again downstream. ``FF_NAIVE_RESHARD=1`` keeps
+    the bare constraint."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .reshard import (naive_reshard, norm_spec, planner_for,
+                          tensor_spec)
+    if naive_reshard():
+        return jax.lax.with_sharding_constraint(
+            xs, NamedSharding(strategy.dmesh.mesh, bank_in_sp))
+    mem = norm_spec(tensor_spec(strategy, member_t), xs.ndim - 1)
+    src = P(None, *[tuple(d) if d else None for d in mem])
+    return planner_for(strategy).apply(xs, src, bank_in_sp)

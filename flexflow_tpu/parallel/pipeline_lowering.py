@@ -30,7 +30,8 @@ from ..core.layer import Layer
 from ..ffconst import OperatorType
 
 __all__ = ["PipelineRegion", "assign_tp_roles", "find_pipeline_region",
-           "find_ragged_pipeline_region", "layer_signature"]
+           "find_ragged_pipeline_region", "layer_signature",
+           "region_entry_transition", "region_exit_transition"]
 
 
 def layer_signature(layer: Layer) -> Tuple:
@@ -519,3 +520,46 @@ def _verify_run(layers: Sequence[Layer], start: int, unit: int,
             if t.guid not in internal:
                 external.add(t.guid)
     return len(external) == 1
+
+
+# ---------------------------------------------------------------------------
+# region-boundary layout transitions (parallel/reshard.py integration)
+# ---------------------------------------------------------------------------
+
+def region_entry_transition(x, strategy, entry_t):
+    """Explicitly lower the region-entry layout transition.
+
+    The microbatch reshape (``[B,...] -> [M, B/M, ...]``) interleaves
+    rows across shards, so a sharded entry activation cannot reach the
+    GPipe engine's ``P(None, dp, ...)`` spec by any local reshape —
+    GSPMD resolves it with an 'involuntary full rematerialization'
+    whose reshape/concat rewrite miscompiles on CPU (NaN in the banked
+    composition test). Instead the planner gathers the activation to
+    replicated with EXPLICIT collectives (scored steps under a
+    shard_map whose in/out specs pin both layouts); the engine's
+    ``in_specs`` then slice it locally — the one transition GSPMD
+    always gets right. ``FF_NAIVE_RESHARD=1`` restores the bare
+    (pre-planner) path."""
+    from jax.sharding import PartitionSpec as P
+    from .reshard import (naive_reshard, norm_spec, planner_for,
+                          tensor_spec)
+    if naive_reshard():
+        return x
+    src = tensor_spec(strategy, entry_t) if entry_t is not None else None
+    if src is None or not any(norm_spec(src, len(x.shape))):
+        return x
+    return planner_for(strategy).apply(x, src, P())
+
+
+def region_exit_transition(ys, strategy, xs_spec):
+    """Explicitly gather the region output (sharded per the engine's
+    ``out_specs``) back to replicated before the inverse microbatch
+    reshape — the mirror of :func:`region_entry_transition`; the post-
+    region layers re-apply their own strategy constraints."""
+    from jax.sharding import PartitionSpec as P
+    from .reshard import naive_reshard, norm_spec, planner_for
+    if naive_reshard():
+        return ys
+    if not any(norm_spec(xs_spec, ys.ndim)):
+        return ys
+    return planner_for(strategy).apply(ys, xs_spec, P())

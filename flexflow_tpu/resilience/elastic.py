@@ -5,9 +5,12 @@ the machine it has (PAPER.md); losing a device mid-run just means the
 machine changed. The arrays-redistribution line of work (PAPERS.md,
 arxiv 2112.01075 + 2004.13336) treats resharding a live state onto a
 different device layout as a first-class operation — here it rides the
-existing ``restore_model_checkpoint`` replace path, which device_puts
-host numpy leaves against the CURRENT template shardings, whatever mesh
-those live on.
+existing ``restore_model_checkpoint`` replace path, which places host
+numpy leaves against the CURRENT template shardings through the reshard
+planner's host→device step (``parallel/reshard.place_host``): each
+surviving device is handed only its own shard of a sharded leaf, so the
+restore never stages whole-array per-device replicas on the shrunken
+mesh (``FF_NAIVE_RESHARD=1`` restores the old ``device_put`` path).
 
 Flow on (injected) device loss:
 

@@ -442,14 +442,20 @@ def restore_model_checkpoint(ff, directory: str,
     Restored arrays are re-placed with the model's current shardings (so a
     checkpoint taken under one strategy — or one MESH — resumes under
     another: strategy migration and the elastic re-plan's reshard both
-    ride this path)."""
+    ride this path). Placement goes through the reshard planner's
+    host→device step (``parallel/reshard.place_host``): each device is
+    handed ONLY its own shard of a sharded leaf, so restoring a large
+    sharded state never materializes per-device full replicas — the
+    memory-peaky part of the old whole-array ``device_put``
+    (``FF_NAIVE_RESHARD=1`` restores it)."""
     import jax
+    from ..parallel.reshard import place_host
     mgr = CheckpointManager(directory)
     state, meta = mgr.restore(step)
 
     def replace(tmpl, new):
         return jax.tree.map(
-            lambda t, n: jax.device_put(
+            lambda t, n: place_host(
                 np.asarray(n).astype(t.dtype).reshape(t.shape),
                 t.sharding if hasattr(t, "sharding") else None),
             tmpl, new)

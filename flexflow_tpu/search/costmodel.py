@@ -499,6 +499,26 @@ class OpCostModel:
                 "all_to_all": 1.0 / degree, "permute": 1.0 / degree}[collective]
         return mult * frac * volume_bytes / bw + (degree - 1) * lat
 
+    def reshard_step_cost(self, kind: str, degree: int,
+                          volume_bytes: float) -> float:
+        """Cost of ONE step of a reshard lowering plan
+        (``parallel/reshard.py``): ``all_gather`` / ``all_to_all`` price
+        through ``xfer_cost`` — the calibrated collective tables answer
+        first — while ``slice`` is a local block copy (no traffic),
+        priced at measured memory bandwidth plus one dispatch."""
+        if degree <= 1 or volume_bytes <= 0:
+            return 0.0
+        if kind == "slice":
+            mem_bw = self.spec.hbm_bandwidth
+            dispatch = self.overhead_s
+            if self.calib is not None:
+                if self.calib.mem_bw:
+                    mem_bw = self.calib.mem_bw
+                if self.calib.dispatch_s:
+                    dispatch = self.calib.dispatch_s
+            return volume_bytes / max(mem_bw, 1.0) + dispatch
+        return self.xfer_cost(volume_bytes, kind, degree)
+
     def resharding_cost(self, tensor_bytes: float,
                         src_degrees: Dict[int, int],
                         dst_degrees: Dict[int, int]) -> float:

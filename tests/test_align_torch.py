@@ -28,6 +28,13 @@ def _gen(shape, seed, scale=1.0):
     return (rng.standard_normal(shape) * scale).astype(np.float32)
 
 
+def _t(a):
+    """torch tensor from a framework array: ``get_weights``/device
+    arrays are non-writable views, and ``torch.from_numpy`` warns on
+    every tier-1 run — copy first."""
+    return torch.from_numpy(np.array(a, copy=True))
+
+
 def _forward(build, inputs):
     """Build a single-op model, return its jitted forward output."""
     cfg = FFConfig()
@@ -73,8 +80,8 @@ def test_align_linear_fwd_bwd():
 
     tl = torch.nn.Linear(16, 24)
     with torch.no_grad():
-        tl.weight.copy_(torch.from_numpy(w.T))
-        tl.bias.copy_(torch.from_numpy(b))
+        tl.weight.copy_(_t(w.T))
+        tl.bias.copy_(_t(b))
     xt = torch.from_numpy(x).requires_grad_(True)
     yt = tl(xt)
     np.testing.assert_allclose(y, yt.detach().numpy(), atol=ATOL, rtol=RTOL)
@@ -106,8 +113,8 @@ def test_align_conv2d():
     b = ff.get_weights(lname, "bias")
     tc = torch.nn.Conv2d(3, 8, 3, padding=1)
     with torch.no_grad():
-        tc.weight.copy_(torch.from_numpy(w))
-        tc.bias.copy_(torch.from_numpy(b))
+        tc.weight.copy_(_t(w))
+        tc.bias.copy_(_t(b))
     ref = tc(torch.from_numpy(x)).detach().numpy()
     np.testing.assert_allclose(y, ref, atol=1e-3, rtol=1e-3)
 
@@ -174,7 +181,7 @@ def test_align_embedding():
                        else list(ff.params[lname])[0])
     emb = torch.nn.Embedding(50, 12)
     with torch.no_grad():
-        emb.weight.copy_(torch.from_numpy(w))
+        emb.weight.copy_(_t(w))
     ref = emb(torch.from_numpy(ids)).detach().numpy()
     np.testing.assert_allclose(y, ref, atol=ATOL, rtol=RTOL)
 
@@ -205,12 +212,12 @@ def test_align_multihead_attention():
     bv = np.asarray(p["bv"]).reshape(e)
     bo = np.asarray(p["bo"])
     with torch.no_grad():
-        mha.in_proj_weight.copy_(torch.from_numpy(
+        mha.in_proj_weight.copy_(_t(
             np.concatenate([wq.T, wk.T, wv.T], axis=0)))
-        mha.in_proj_bias.copy_(torch.from_numpy(
+        mha.in_proj_bias.copy_(_t(
             np.concatenate([bq, bk, bv])))
-        mha.out_proj.weight.copy_(torch.from_numpy(wo.T))
-        mha.out_proj.bias.copy_(torch.from_numpy(bo))
+        mha.out_proj.weight.copy_(_t(wo.T))
+        mha.out_proj.bias.copy_(_t(bo))
     xt = torch.from_numpy(x)
     ref, _ = mha(xt, xt, xt, need_weights=False)
     np.testing.assert_allclose(y, ref.detach().numpy(), atol=2e-3,
@@ -276,7 +283,7 @@ def test_align_mse_loss_gradient():
 
     gj = np.asarray(jax.grad(loss_jax)(ff.params)[lname]["kernel"])
 
-    wt = torch.from_numpy(w).requires_grad_(True)
+    wt = _t(w).requires_grad_(True)
     yt = torch.from_numpy(x) @ wt
     torch.nn.functional.mse_loss(yt, torch.from_numpy(label)).backward()
     np.testing.assert_allclose(gj, wt.grad.numpy(), atol=1e-3, rtol=1e-3)

@@ -73,6 +73,14 @@ def _scripted(default_probe_results):
                     "shedding": {}, "goodput_base_rps": 3.2,
                     "goodput_shed_rps": 52.4, "goodput_ratio": 16.4,
                     "ok": True}, None
+        if stage == "reshard":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"searched_vs_naive": 1.15, "naive_chunk_s": 0.02,
+                    "searched_chunk_s": 0.017, "peak_ok": True,
+                    "chunk": 16, "rounds": 6,
+                    "time_ok_deferred": True, "ok": True}, None
         if stage == "recovery":
             assert env.get("JAX_PLATFORMS") == "cpu"
             assert "xla_force_host_platform_device_count" \
@@ -147,6 +155,10 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         # and the async-dispatch overlap leg
         assert out["dispatch_overlap_ratio"] == 1.08
         assert any(a[1] == "dispatch_overlap" for a, _ in calls)
+        # and the searched-resharding leg (ISSUE 6)
+        assert out["reshard_searched_vs_naive"] == 1.15
+        assert out["reshard_peak_ok"] is True
+        assert any(a[1] == "reshard" for a, _ in calls)
         # so does the checkpoint-overhead + time-to-recover leg
         assert out["ckpt_async_overhead_pct"] == 1.1
         assert out["ckpt_sync_overhead_pct"] == 2.3

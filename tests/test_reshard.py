@@ -1,0 +1,223 @@
+"""Searched resharding collectives for layout transitions (ISSUE 6).
+
+The planner's contract under test: for any (src, dst) layout pair on
+the 8-device virtual mesh, the planned explicit-collective lowering is
+BIT-IDENTICAL to the naive (bare sharding-constraint) path, lands on
+the requested destination layout, and never exceeds the naive
+gather-everything baseline's peak transient memory; plans persist to
+the .ffcache plan cache and warm-load without re-planning; and the
+elastic device-loss restore places the checkpointed state through the
+planner's host→device step.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.parallel.machine import DeviceMesh, MachineSpec
+from flexflow_tpu.parallel.reshard import (ReshardPlanner, STATS,
+                                           layout_key, norm_spec)
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    STATS.reset()
+    yield
+    STATS.reset()
+
+
+@pytest.fixture()
+def dmesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device mesh")
+    return DeviceMesh(MachineSpec(num_devices=8))  # axes x0,x1,x2 = 2,2,2
+
+
+@pytest.fixture()
+def planner(dmesh, tmp_path):
+    return ReshardPlanner(dmesh, cache_dir=str(tmp_path / "ffcache"))
+
+
+def _x(shape=(8, 8, 4), seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+
+
+# the ISSUE matrix: replicated<->sharded, axis swap, split-factor
+# change, sub-mesh->sub-mesh, axis move
+MATRIX = [
+    ("rep_to_sharded", P(), P("x0", None)),
+    ("sharded_to_rep", P("x0"), P()),
+    ("axis_swap", P("x0", "x1"), P("x1", "x0")),
+    ("split_factor", P(("x0", "x1"), None), P("x0", None)),
+    ("submesh_to_submesh", P("x0"), P("x2")),
+    ("axis_move", P("x0", None), P(None, "x0")),
+    ("multi_axis", P(("x0", "x1"), "x2"), P("x2", ("x0", "x1"))),
+]
+
+
+@pytest.mark.parametrize("name,src,dst",
+                         MATRIX, ids=[m[0] for m in MATRIX])
+def test_plan_execute_matches_naive(planner, monkeypatch, name, src, dst):
+    """Planned transitions are value-preserving and bit-identical to
+    the naive constraint path, and land on the dst layout."""
+    x = _x()
+    searched = np.asarray(
+        jax.jit(lambda a: planner.apply(a, src, dst))(x))
+    monkeypatch.setenv("FF_NAIVE_RESHARD", "1")
+    naive = np.asarray(
+        jax.jit(lambda a: planner.apply(a, src, dst))(x))
+    monkeypatch.delenv("FF_NAIVE_RESHARD")
+    np.testing.assert_array_equal(searched, np.asarray(x))
+    np.testing.assert_array_equal(searched, naive)
+    out = jax.jit(lambda a: planner.apply(a, src, dst))(x)
+    got = norm_spec(out.sharding.spec, out.ndim)
+    assert got == norm_spec(dst, out.ndim), (name, got)
+    assert STATS.executed_searched > 0 and STATS.executed_naive == 1
+
+
+def test_peak_transient_memory_never_exceeds_naive(planner):
+    """The chosen plan's scored peak transient bytes are bounded by the
+    gather-everything baseline's for the whole matrix (the paper's
+    claim, and the bench gate)."""
+    for name, src, dst in MATRIX:
+        plan = planner.plan(src, dst, (8, 8, 4), itemsize=4)
+        assert plan.peak_bytes <= plan.naive_peak_bytes + 1e-6, name
+        assert plan.kind in ("searched", "naive")
+
+
+def test_axis_move_lowers_to_alltoall(planner):
+    """The paper's key primitive: moving a mesh axis between dims is
+    ONE all-to-all at constant per-device memory, not a gather+slice."""
+    plan = planner.plan(P("x0", None), P(None, "x0"), (8, 8, 4), 4)
+    kinds = [s.kind for s in plan.steps]
+    assert kinds == ["alltoall"], kinds
+    # constant memory: strictly below the full-replication baseline
+    assert plan.peak_bytes < plan.naive_peak_bytes
+
+
+def test_same_layout_is_free(planner):
+    plan = planner.plan(P("x0"), P("x0"), (8, 8, 4), 4)
+    assert plan.kind == "noop" and plan.steps == []
+
+
+def test_indivisible_layout_falls_back_to_constraint(planner):
+    # dim0 of size 6 cannot tile over a degree-4 axis pair
+    plan = planner.plan(P(), P(("x0", "x1"),), (6, 8), 4)
+    assert plan.kind == "constraint" and plan.steps == []
+
+
+def test_shape_class_collision_respects_divisibility(planner):
+    """Plans are cached per factor-of-2 shape-class: a cached
+    divisible-shape plan must never be replayed onto a same-band shape
+    the mesh cannot tile ((8,16) and (6,20) both bucket to 512B)."""
+    src, dst = P(("x0", "x1"), None), P()
+    good = planner.plan(src, dst, (8, 16), 4)
+    assert good.steps, "divisible shape must get a real plan"
+    bad = planner.plan(src, dst, (6, 20), 4)
+    assert bad.kind == "constraint" and bad.steps == []
+
+
+def test_plan_cache_warm_load(dmesh, tmp_path):
+    """Plans persist per (mesh, src, dst, itemsize, shape-class): a
+    fresh planner on the same cache dir answers from disk without
+    re-planning."""
+    cache = str(tmp_path / "ffcache")
+    p1 = ReshardPlanner(dmesh, cache_dir=cache)
+    plan1 = p1.plan(P("x0", "x1"), P("x1", "x0"), (8, 8, 4), 4)
+    assert STATS.planned == 1
+    assert os.path.exists(os.path.join(cache, "reshard_plans.json"))
+
+    STATS.reset()
+    p2 = ReshardPlanner(dmesh, cache_dir=cache)
+    plan2 = p2.plan(P("x0", "x1"), P("x1", "x0"), (8, 8, 4), 4)
+    assert STATS.planned == 0 and STATS.plan_cache_hits == 1
+    assert [s.to_json() for s in plan2.steps] \
+        == [s.to_json() for s in plan1.steps]
+    assert plan2.kind == plan1.kind
+
+    # same shape-class (factor-of-2 band) reuses the in-memory memo
+    p2.plan(P("x0", "x1"), P("x1", "x0"), (8, 8, 4), 4)
+    assert STATS.plan_cache_hits == 1
+
+
+def test_reshard_counters_and_audit_records(planner):
+    """Executed transitions feed ff_reshard_* counters and keep an
+    audit trail of the chosen step sequences."""
+    from flexflow_tpu.obs.metrics_registry import REGISTRY
+    before = REGISTRY.counter("ff_reshard_plans_total").value(
+        kind="searched")
+    x = _x()
+    jax.jit(lambda a: planner.apply(a, P("x0"), P()))(x)
+    after = REGISTRY.counter("ff_reshard_plans_total").value(
+        kind="searched")
+    assert after == before + 1
+    assert STATS.bytes_total >= float(x.size * 4)
+    rec = planner._audit_records[-1]
+    assert rec["src"] == layout_key(norm_spec(P("x0"), 3))
+    assert rec["steps"] and "gather" in rec["steps"][0]
+
+
+def test_gradient_through_planned_transition(planner):
+    """Planned transitions sit inside differentiated train steps (bank
+    rejoins, pipeline boundaries): grad must flow exactly."""
+    x = _x((8, 8))
+
+    def f(a):
+        y = planner.apply(a, P("x0", None), P(None, "x0"))
+        return jnp.sum(y * y)
+
+    g = jax.jit(jax.grad(f))(x)
+    np.testing.assert_allclose(np.asarray(g), 2.0 * np.asarray(x),
+                               rtol=1e-6)
+
+
+def _build_mlp():
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    cfg.only_data_parallel = True
+    cfg.seed = 7
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 20), name="x")
+    t = ff.dense(x, 64, activation=ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy", [])
+    return ff
+
+
+def test_elastic_restore_goes_through_planner(tmp_path):
+    """Elastic device-loss e2e: the re-plan's restored state is placed
+    through the planner's host→device step (place_host), not the naive
+    whole-array device_put."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device mesh")
+    from flexflow_tpu.resilience import Supervisor, faults, status
+    from flexflow_tpu.runtime.checkpoint import restore_model_checkpoint
+    faults.install("lose_device@3:2")
+    status.reset()
+    try:
+        ff = _build_mlp()
+        sup = Supervisor(ff, str(tmp_path / "elastic"), checkpoint_every=1)
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(256, 20)).astype(np.float32)
+        ys = rng.integers(0, 4, size=256).astype(np.int32)
+        h = sup.run(xs, ys, epochs=2)
+        assert sup.elastic_replans == 1
+        assert ff.dmesh.num_devices == 4
+        assert np.isfinite(h[-1]["loss"])
+        # the supervisor's recovery restored the checkpoint onto the
+        # shrunken mesh; replay the restore in isolation and assert it
+        # routes through the planner's host→device placement
+        STATS.reset()
+        restore_model_checkpoint(ff, str(tmp_path / "elastic"))
+        assert STATS.host_placements > 0
+        assert STATS.executed_naive == 0
+    finally:
+        faults.clear()
+        status.reset()

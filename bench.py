@@ -30,6 +30,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 METRIC = "bert_base_train_samples_per_sec_per_chip"
@@ -65,11 +66,42 @@ def _sync_fetch(x):
 
 
 def stage_probe():
+    """Backend discovery with an internal watchdog. An unreachable
+    tunneled-TPU plugin makes ``jax.devices()`` hang until the parent's
+    outer timeout (the standing ``probe(default): timeout after 240s``
+    artifact in every BENCH_r0*.json) — which both burned 240s of the
+    global deadline and silently committed the whole round to the CPU
+    retry path. Now the probe bounds itself (``FF_PROBE_TIMEOUT_S``,
+    default 45s) and fails LOUDLY with a distinctive exit code, so the
+    parent falls back within seconds and the headline leg runs with the
+    budget it was promised; a reachable default backend passes exactly
+    as before."""
     _apply_platform_env()
-    import jax
-    devs = jax.devices()
-    _emit({"platform": jax.default_backend(), "n": len(devs),
-           "device_kind": devs[0].device_kind})
+    probe_timeout = float(os.environ.get("FF_PROBE_TIMEOUT_S", "45"))
+    result = {}
+
+    def query():
+        try:
+            import jax
+            devs = jax.devices()
+            result["obj"] = {"platform": jax.default_backend(),
+                             "n": len(devs),
+                             "device_kind": devs[0].device_kind}
+        except BaseException as e:  # reported below, not via excepthook
+            result["err"] = e
+
+    t = threading.Thread(target=query, daemon=True)
+    t.start()
+    t.join(probe_timeout)
+    if "obj" not in result:
+        why = (f"backend init failed: {result['err']}"
+               if "err" in result else
+               f"backend init did not finish within {probe_timeout:.0f}s"
+               f" — unreachable accelerator plugin")
+        print(f"probe: {why}; failing fast so the round keeps its "
+              f"budget", file=sys.stderr, flush=True)
+        os._exit(3)  # loud marker (a hung watchdog thread may remain)
+    _emit(result["obj"])
 
 
 def stage_smoke():

@@ -45,6 +45,12 @@ case "${1:-fast}" in
     # finish in-flight requests before the process exits
     FF_FAULT_PLAN="infer_fail@0;infer_fail@1;infer_fail@2" \
       python tools/serving_chaos_smoke.py
+    # distributed resilience smoke: a 2-process CPU world trains under
+    # the WorldSupervisor, rank 1 is fault-injected to hard-crash
+    # mid-epoch, the world must re-form (relaunch or shrink) and resume
+    # from the last committed two-phase checkpoint with a finite,
+    # rank-agreeing final loss — cross-process recovery on every push
+    python tools/dist_resilience_smoke.py
     ;;
   slow)
     python -m pytest tests/ -q -m slow

@@ -31,6 +31,16 @@ class FFConfig:
     # honor FF_COORDINATOR_ADDRESS / FF_NUM_PROCESSES / FF_PROCESS_ID env.
     coordinator_address: str = ""
     process_id: int = -1
+    # multi-process failure detection (resilience/coord.py): per-rank
+    # heartbeat cadence, how long a silent peer is tolerated, and the
+    # bound on every cross-rank rendezvous (checkpoint commit barriers,
+    # recovery re-rendezvous). 0 = keep the coordinator defaults; the
+    # FF_HB_INTERVAL_S / FF_HB_TIMEOUT_S / FF_BARRIER_TIMEOUT_S env vars
+    # override both. Every wait is bounded — a timeout raises
+    # RankFailure with the suspected rank attributed.
+    heartbeat_interval_s: float = 0.0
+    heartbeat_timeout_s: float = 0.0
+    barrier_timeout_s: float = 0.0
     # memory per device in MB (reference -ll:fsize); used by memory-aware search
     device_mem_mb: int = 0        # 0 = query from device / default model
     # -------- search (reference --budget/--alpha/...) --------

@@ -540,7 +540,12 @@ class FFModel:
         # control replication happen before graph_optimize) so that
         # MachineSpec.detect sees the GLOBAL device view
         from .parallel.distributed import maybe_initialize
-        maybe_initialize(self.config)
+        if maybe_initialize(self.config):
+            # multi-process world: start the failure-detection layer
+            # (per-rank heartbeats + bounded barriers) alongside it —
+            # every later cross-rank wait goes through it
+            from .resilience import coord
+            coord.ensure_started(self.config)
         if machine_spec is not None:
             spec = machine_spec
         elif self.config.machine_model_file:
@@ -880,7 +885,8 @@ class FFModel:
         # clauses fire BEFORE the step runs, NaN/Inf gradient-corruption
         # clauses poison the state after; active() is one cached check,
         # so fault-free runs pay nothing measurable
-        from .resilience import faults
+        from .resilience import coord, faults
+        coord.check()  # surface a detected peer-rank failure pre-step
         if faults.active():
             faults.raise_pending(self._step)
         self.params, self.opt_state, self.state, bm = step_fn(

@@ -29,14 +29,60 @@ _initialized_here = False
 
 
 def is_initialized() -> bool:
-    """True when a jax.distributed client exists (ours or ambient)."""
-    try:
+    """True when a jax.distributed client exists (ours or ambient).
+
+    Detection order (tests/test_distributed.py pins the degradation):
+    the public ``jax.distributed.is_initialized`` when this jax has it,
+    then the private ``jax._src.distributed`` global state, then our own
+    ``_initialized_here`` flag — so a jax upgrade that drops either API
+    degrades to the flag (correct for every world WE joined) instead of
+    silently reporting single-process."""
+    import jax
+    try:  # public API (newer jax)
+        fn = getattr(jax.distributed, "is_initialized", None)
+        if fn is not None and fn():
+            return True
+    except Exception:  # pragma: no cover - public-API drift
+        pass
+    try:  # private fallback: sees worlds initialized by the host program
         from jax._src import distributed as _jd
         if getattr(_jd.global_state, "client", None) is not None:
             return True
     except Exception:  # pragma: no cover - private-API drift
         pass
     return _initialized_here
+
+
+def client():
+    """The live distributed-runtime client (KV store + barriers), or
+    None outside a multi-process world. The coordination layer
+    (``resilience/coord.py``) builds heartbeats and bounded barriers on
+    this."""
+    try:
+        from jax._src import distributed as _jd
+        return getattr(_jd.global_state, "client", None)
+    except Exception:  # pragma: no cover - private-API drift
+        return None
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process CPU worlds need a cross-process collectives backend
+    (the XLA CPU client ships gloo for exactly this); without it every
+    multi-controller computation dies with "Multiprocess computations
+    aren't implemented on the CPU backend". Must run before the CPU
+    client is created — maybe_initialize calls it right before
+    ``jax.distributed.initialize`` (which has the same constraint).
+    TPU/GPU backends ignore the option; jax versions without the flag
+    (or with gloo compiled out) just proceed."""
+    import jax
+    impl = os.environ.get("FF_CPU_COLLECTIVES", "gloo")
+    if not impl or impl == "none":
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+    except Exception:  # pragma: no cover - old jax or no gloo build
+        log.warning("distributed: could not enable CPU collectives "
+                    "(%s); multi-process CPU worlds will not work", impl)
 
 
 def maybe_initialize(config=None) -> bool:
@@ -71,6 +117,7 @@ def maybe_initialize(config=None) -> bool:
     if addr:
         kwargs = dict(coordinator_address=addr, num_processes=nproc,
                       process_id=pid)
+    _enable_cpu_collectives()
     try:
         jax.distributed.initialize(**kwargs)
         _initialized_here = True

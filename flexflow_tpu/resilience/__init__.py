@@ -1,5 +1,5 @@
 """Resilience subsystem: fault injection, verified atomic checkpoints,
-auto-resume, and elastic re-plan on device loss.
+auto-resume, elastic re-plan, and cross-process world recovery.
 
 The reference has no fault-tolerance mechanism (SURVEY.md §5); TPU pods
 are preemptible by design, so this layer makes failure a normal input:
@@ -7,32 +7,47 @@ are preemptible by design, so this layer makes failure a normal input:
   - :mod:`.faults` — deterministic fault injection
     (``FF_FAULT_PLAN="crash@2;nan@5;lose_device@9:2"`` or
     :func:`faults.install`): crash-at-step, NaN/Inf gradient
-    corruption, checkpoint corruption/truncation, virtual device loss;
+    corruption, checkpoint corruption/truncation, virtual device loss,
+    and rank-scoped multi-process faults (``rank_crash@N:r``,
+    ``rank_hang@N:r``, ``corrupt_shard@N:r``, ``crash_after_stage@N:r``);
+  - :mod:`.coord` — multi-process failure detection: per-rank
+    heartbeats over the jax coordination KV store, bounded barriers
+    (never hang forever — timeouts raise :class:`~.coord.RankFailure`
+    with the dead rank attributed), and the monotonic world epoch;
   - hardened checkpoints (``runtime/checkpoint.py``) — atomic
     staging-dir + rename saves, a per-leaf shape/dtype/CRC32 manifest
-    verified on restore, async background saves, and restore that falls
-    back past corrupt or partial steps;
+    verified on restore, async background saves, restore that falls
+    back past corrupt or partial steps, and (multi-host) a two-phase
+    stage/commit protocol with all-rank quorum restore;
   - :mod:`.supervisor` — a resilient training driver: auto-resume from
     the newest valid checkpoint (exact dataloader rng/epoch/position
-    resume), bounded restarts with exponential backoff + jitter, and
-    NaN-loss rollback to the last good checkpoint;
+    resume), bounded restarts with exponential backoff + jitter,
+    NaN-loss rollback to the last good checkpoint; plus the
+    launcher-side :class:`~.supervisor.WorldSupervisor` that re-forms a
+    multi-process world after rank failure (relaunch under a restart
+    budget, else shrink to a batch-divisible survivor world);
   - :mod:`.elastic` — on device loss, rebuild the machine spec for the
     shrunken mesh, re-run the strategy search warm from the persistent
     calibration tables, and reshard the restored state onto the new
     strategy via the checkpoint replace path;
-  - :mod:`.status` — always-on restart/fault/checkpoint facts, merged
-    into both HTTP front-ends' ``/healthz``.
+  - :mod:`.status` — always-on restart/fault/checkpoint/world facts,
+    merged into both HTTP front-ends' ``/healthz``.
 
-See docs/resilience.md.
+See docs/resilience.md and docs/distributed.md.
 """
-from . import elastic, faults, status
+from . import coord, elastic, faults, status
+from .coord import EXIT_RANK_FAILURE, Coordinator, RankFailure
 from .faults import (DeviceLoss, FaultError, FaultPlan, SimulatedCrash,
                      install as install_fault_plan)
-from .supervisor import RestartBudgetExceeded, Supervisor, run_supervised
+from .supervisor import (RestartBudgetExceeded, Supervisor, WorldFailure,
+                         WorldSupervisor, run_supervised,
+                         run_world_member)
 
 __all__ = [
-    "faults", "status", "elastic",
+    "faults", "status", "elastic", "coord",
     "FaultPlan", "FaultError", "SimulatedCrash", "DeviceLoss",
     "install_fault_plan",
     "Supervisor", "run_supervised", "RestartBudgetExceeded",
+    "Coordinator", "RankFailure", "EXIT_RANK_FAILURE",
+    "WorldSupervisor", "WorldFailure", "run_world_member",
 ]

@@ -1,0 +1,399 @@
+"""Cross-process coordination: heartbeats, bounded barriers, world epoch.
+
+The reference leans on Legion's runtime to notice a dead node (and then
+aborts the whole job); jax gives us a distributed KV store + barrier
+service (the coordination client behind ``jax.distributed.initialize``)
+and nothing else. This module turns that into a failure-detection layer
+for the multi-controller world (ISSUE 7):
+
+  - **heartbeats**: every rank runs a daemon thread that bumps a
+    per-rank sequence number in the KV store every
+    ``heartbeat_interval_s``; a monitor on each rank watches its peers
+    and attributes a rank whose sequence stops advancing for
+    ``heartbeat_timeout_s`` (a crashed process stops beating instantly;
+    a SIGSTOP'd/hung one stops within one interval — the writer thread
+    is in-process);
+  - **bounded barriers**: :meth:`Coordinator.barrier` never waits
+    forever — on timeout it consults the heartbeat table and raises
+    :class:`RankFailure` naming the suspected dead rank (or "unknown"
+    when every peer still beats, i.e. a slow rank, not a dead one);
+  - **world epoch**: a monotonic integer identifying the current
+    incarnation of the world. The launcher (``resilience.supervisor.
+    WorldSupervisor``) bumps it on every relaunch/shrink via
+    ``FF_WORLD_EPOCH``; all heartbeat keys and barrier ids are
+    epoch-scoped so debris from a dead epoch can never satisfy (or
+    poison) a rendezvous in the next one;
+  - **supervised exit**: under a world supervisor
+    (``FF_WORLD_SUPERVISED=1``) a detected failure additionally arms a
+    delayed hard-exit (:data:`EXIT_RANK_FAILURE`) so a survivor stuck
+    inside a device collective — unreachable from Python — still dies
+    within a bound and the supervisor can re-form the world.
+
+Single-process worlds get a no-op coordinator (local KV, barriers
+return immediately) so every call site stays unconditional.
+
+Timeouts are configurable via ``FFConfig`` (``heartbeat_interval_s``,
+``heartbeat_timeout_s``, ``barrier_timeout_s``) or the ``FF_HB_INTERVAL_S``
+/ ``FF_HB_TIMEOUT_S`` / ``FF_BARRIER_TIMEOUT_S`` env vars (env wins; the
+launcher uses it to tighten test worlds). See docs/distributed.md.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..obs import events as obs_events
+from ..obs.metrics_registry import REGISTRY
+from . import status
+
+log = logging.getLogger("flexflow_tpu")
+
+#: process exit code meaning "I detected a peer rank failure and chose
+#: to die so the world supervisor can re-form the world" — distinct from
+#: a crash of this rank itself.
+EXIT_RANK_FAILURE = 17
+
+
+class RankFailure(RuntimeError):
+    """A peer rank is dead or unreachable. ``rank`` is the suspected
+    dead rank (None when the timeout could not be attributed), ``epoch``
+    the world epoch it happened in."""
+
+    def __init__(self, reason: str, rank: Optional[int] = None,
+                 epoch: int = 0):
+        who = f"rank {rank}" if rank is not None else "unknown rank"
+        super().__init__(f"{who} failed (epoch {epoch}): {reason}")
+        self.rank = rank
+        self.epoch = epoch
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------------
+# KV backends
+# ---------------------------------------------------------------------------
+class LocalKV:
+    """In-process stand-in for the distributed KV store: single-process
+    worlds and unit tests run the same Coordinator code against it."""
+
+    def __init__(self):
+        self._data: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def dir_get(self, prefix: str) -> List[tuple]:
+        with self._lock:
+            return [(k, v) for k, v in self._data.items()
+                    if k.startswith(prefix)]
+
+    def barrier(self, name: str, timeout_s: float,
+                world: int = 1) -> None:
+        if world > 1:
+            raise TimeoutError(
+                f"LocalKV cannot rendezvous a {world}-process world")
+
+
+class JaxKV:
+    """The real thing: jax's distributed-runtime client (the same
+    service that backed ``jax.distributed.initialize``'s rendezvous)."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def set(self, key: str, value: str) -> None:
+        self._client.key_value_set(key, value, allow_overwrite=True)
+
+    def dir_get(self, prefix: str) -> List[tuple]:
+        return list(self._client.key_value_dir_get(prefix))
+
+    def barrier(self, name: str, timeout_s: float,
+                world: int = 1) -> None:
+        # raises (DEADLINE_EXCEEDED) on timeout; the Coordinator turns
+        # that into an attributed RankFailure
+        self._client.wait_at_barrier(name, int(timeout_s * 1000))
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+# ---------------------------------------------------------------------------
+# Coordinator
+# ---------------------------------------------------------------------------
+class Coordinator:
+    """Per-process view of the multi-rank world. One per process
+    (module singleton via :func:`ensure_started`); every public method
+    is thread-safe."""
+
+    def __init__(self, rank: int, world: int, *,
+                 epoch: Optional[int] = None, kv=None,
+                 heartbeat_interval_s: Optional[float] = None,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 barrier_timeout_s: Optional[float] = None,
+                 supervised: Optional[bool] = None):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.epoch = int(os.environ.get("FF_WORLD_EPOCH", "0")
+                         if epoch is None else epoch)
+        self.heartbeat_interval_s = _env_float(
+            "FF_HB_INTERVAL_S", heartbeat_interval_s or 0.25)
+        self.heartbeat_timeout_s = _env_float(
+            "FF_HB_TIMEOUT_S", heartbeat_timeout_s or 10.0)
+        self.barrier_timeout_s = _env_float(
+            "FF_BARRIER_TIMEOUT_S", barrier_timeout_s or 60.0)
+        self.supervised = (os.environ.get("FF_WORLD_SUPERVISED") == "1"
+                           if supervised is None else supervised)
+        if kv is None:
+            if world > 1:
+                from ..parallel import distributed as dist
+                c = dist.client()
+                if c is None:
+                    raise RuntimeError(
+                        "Coordinator for a multi-process world needs the "
+                        "jax distributed client (jax.distributed."
+                        "initialize first)")
+                kv = JaxKV(c)
+            else:
+                kv = LocalKV()
+        self.kv = kv
+        self._seq = 0
+        self._failure: Optional[RankFailure] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # rank -> (last seen seq, monotonic time the seq last advanced)
+        self._peer_seen: Dict[int, tuple] = {}
+        status.set_value("world_epoch", self.epoch)
+        status.set_value("world_rank", self.rank)
+        status.set_value("world_size", self.world)
+        REGISTRY.gauge("ff_world_epoch",
+                       "Monotonic epoch of the current world incarnation"
+                       ).set(float(self.epoch))
+
+    # -- key naming ----------------------------------------------------
+    def _hb_prefix(self) -> str:
+        return f"ff/hb/e{self.epoch}/"
+
+    def _hb_key(self, rank: int) -> str:
+        return f"{self._hb_prefix()}{rank}"
+
+    # -- heartbeats ----------------------------------------------------
+    def start(self) -> "Coordinator":
+        """Begin beating + monitoring. Idempotent."""
+        if self._thread is not None or self.world <= 1:
+            return self
+        self.beat()  # first beat synchronously: peers see us immediately
+        self._thread = threading.Thread(
+            target=self._loop, name="ff-coord-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2 * self.heartbeat_interval_s + 1.0)
+        self._thread = None
+
+    def beat(self) -> None:
+        self._seq += 1
+        self.kv.set(self._hb_key(self.rank), str(self._seq))
+
+    def _loop(self) -> None:
+        misses_metric = REGISTRY.counter(
+            "ff_heartbeat_misses_total",
+            "Peer heartbeat timeouts observed by this rank")
+        detected_at: Optional[float] = None
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self.beat()
+                stale = self._scan_peers()
+            except Exception as e:  # noqa: BLE001 — KV died = world died
+                stale = None
+                with self._lock:
+                    if self._failure is None:
+                        self._failure = RankFailure(
+                            f"coordination service unreachable: {e}",
+                            rank=None, epoch=self.epoch)
+                        _record_failure(self._failure)
+            if stale:
+                with self._lock:
+                    if self._failure is None:
+                        misses_metric.inc()
+                        self._failure = RankFailure(
+                            f"no heartbeat for "
+                            f"{self.heartbeat_timeout_s:.1f}s",
+                            rank=stale[0], epoch=self.epoch)
+                        _record_failure(self._failure)
+            if self._failure is not None and self.supervised:
+                # the main thread may be stuck inside a device collective
+                # (unreachable from Python) — give it one timeout's grace
+                # to surface the failure via check()/barrier(), then die
+                # loudly so the world supervisor can re-form the world
+                if detected_at is None:
+                    detected_at = time.monotonic()
+                elif time.monotonic() - detected_at \
+                        > self.heartbeat_timeout_s:
+                    log.error(
+                        "coordinator: rank failure unhandled for %.1fs "
+                        "— exiting %d for the world supervisor",
+                        self.heartbeat_timeout_s, EXIT_RANK_FAILURE)
+                    os._exit(EXIT_RANK_FAILURE)
+
+    def _scan_peers(self) -> List[int]:
+        """Ranks whose heartbeat seq has not advanced within the
+        timeout. A peer we have never seen is not stale until the
+        timeout passes from OUR start — ranks join at different times.
+        Callers race (heartbeat thread vs a timed-out barrier on the
+        main/writer thread), so the peer table update is locked."""
+        now = time.monotonic()
+        seen: Dict[int, str] = {}
+        for key, val in self.kv.dir_get(self._hb_prefix()):
+            tail = key.rsplit("/", 1)[-1]
+            if tail.isdigit():
+                seen[int(tail)] = val
+        stale = []
+        with self._lock:
+            for r in range(self.world):
+                if r == self.rank:
+                    continue
+                cur = seen.get(r)
+                prev = self._peer_seen.get(r)
+                if cur is not None and (prev is None or prev[0] != cur):
+                    self._peer_seen[r] = (cur, now)
+                    continue
+                if prev is None:
+                    # never beat: count from monitor start
+                    self._peer_seen[r] = (None, now)
+                    continue
+                if now - prev[1] > self.heartbeat_timeout_s:
+                    stale.append(r)
+        return stale
+
+    # -- failure surface ----------------------------------------------
+    def failure(self) -> Optional[RankFailure]:
+        with self._lock:
+            return self._failure
+
+    def check(self) -> None:
+        """Raise the pending :class:`RankFailure`, if any. Cheap — the
+        train loop calls this every step."""
+        f = self.failure()
+        if f is not None:
+            raise f
+
+    # -- bounded barrier ----------------------------------------------
+    def barrier(self, name: str,
+                timeout_s: Optional[float] = None) -> None:
+        """Epoch-scoped rendezvous of every rank in the world; raises
+        :class:`RankFailure` (with the dead rank attributed from the
+        heartbeat table) instead of waiting forever. ``name`` must be
+        unique per logical use (checkpoint barriers include the step)."""
+        self.check()
+        if self.world <= 1:
+            return
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.barrier_timeout_s
+        bid = f"ff:e{self.epoch}:{name}"
+        t0 = time.perf_counter()
+        try:
+            self.kv.barrier(bid, timeout_s, world=self.world)
+        except RankFailure:
+            raise
+        except Exception as e:  # timeout / connection loss
+            stale = self._scan_peers()
+            f = RankFailure(
+                f"barrier {name!r} timed out after {timeout_s:.1f}s "
+                f"({e})", rank=stale[0] if stale else None,
+                epoch=self.epoch)
+            with self._lock:
+                if self._failure is None:
+                    self._failure = f
+            _record_failure(f)
+            raise f from e
+        finally:
+            obs_events.record_span("coord.barrier", t0,
+                                   time.perf_counter() - t0,
+                                   barrier=name)
+
+
+def _record_failure(f: RankFailure) -> None:
+    status.record("rank_failures")
+    status.set_value("last_rank_failure",
+                     f"rank={f.rank} epoch={f.epoch} {f.reason}")
+    REGISTRY.counter("ff_rank_failures_total",
+                     "Peer rank failures detected by this process").inc()
+    obs_events.counter("resilience.rank_failure")
+    obs_events.instant("resilience.rank_failure", rank=f.rank,
+                       epoch=f.epoch, reason=f.reason)
+    log.error("coordinator: %s", f)
+
+
+# ---------------------------------------------------------------------------
+# process-wide singleton
+# ---------------------------------------------------------------------------
+_coord: Optional[Coordinator] = None
+_coord_lock = threading.Lock()
+
+
+def get() -> Optional[Coordinator]:
+    return _coord
+
+
+def ensure_started(config=None) -> Coordinator:
+    """The process coordinator, creating + starting it on first use.
+    Called from ``FFModel.compile`` right after the world rendezvous;
+    single-process worlds get the no-op local coordinator."""
+    global _coord
+    with _coord_lock:
+        if _coord is not None:
+            return _coord
+        import atexit
+
+        import jax
+        # stop the heartbeat thread BEFORE interpreter teardown: a beat
+        # in flight while the XLA distributed client is being destroyed
+        # aborts the process (std::terminate) at exit
+        atexit.register(reset)
+        kw = {}
+        if config is not None:
+            for name in ("heartbeat_interval_s", "heartbeat_timeout_s",
+                         "barrier_timeout_s"):
+                v = getattr(config, name, None)
+                if v:
+                    kw[name] = float(v)
+        _coord = Coordinator(jax.process_index(), jax.process_count(),
+                             **kw).start()
+        return _coord
+
+
+def reset() -> None:
+    """Tear down the singleton (tests)."""
+    global _coord
+    with _coord_lock:
+        c, _coord = _coord, None
+    if c is not None:
+        c.stop()
+
+
+def check() -> None:
+    """Module-level pending-failure check: no-op without a coordinator."""
+    c = _coord
+    if c is not None:
+        c.check()
+
+
+def barrier(name: str, timeout_s: Optional[float] = None) -> None:
+    """Module-level bounded barrier: no-op without a coordinator (the
+    single-process checkpoint path calls this unconditionally)."""
+    c = _coord
+    if c is not None:
+        c.barrier(name, timeout_s=timeout_s)

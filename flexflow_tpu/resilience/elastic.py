@@ -52,6 +52,23 @@ def surviving_device_count(n_alive: int, batch_size: int) -> int:
     return 1
 
 
+def shrunken_world_size(n_alive_ranks: int, batch_size: int,
+                        devices_per_rank: int = 1) -> int:
+    """Largest usable PROCESS count <= ``n_alive_ranks`` after rank
+    loss: the global batch must divide over the shrunken world's total
+    devices, same constraint as :func:`surviving_device_count` one
+    level up. ``batch_size`` 0/unknown accepts any survivor count.
+    Used by the world supervisor's shrink path (cross-process elastic
+    recovery, ISSUE 7)."""
+    n_alive_ranks = max(1, n_alive_ranks)
+    if batch_size <= 0:
+        return n_alive_ranks
+    for n in range(n_alive_ranks, 0, -1):
+        if batch_size % (n * max(1, devices_per_rank)) == 0:
+            return n
+    return 1
+
+
 def shrunken_spec(spec, n_devices: int):
     """A :class:`MachineSpec` for the post-loss machine: same hardware
     generation/constants, fewer devices. The physical ICI shape and any

@@ -12,7 +12,8 @@ Fault plan grammar (``FF_FAULT_PLAN`` env var or :func:`install`)::
     clause := kind '@' step [':' arg]
     kind   := crash | nan | inf | corrupt_ckpt | truncate_ckpt
               | lose_device | infer_fail     # aliases: nan_grad, corrupt,
-                                             # truncate, lose, infer
+              | rank_crash | rank_hang       # truncate, lose, infer
+              | corrupt_shard | crash_after_stage
 
 Examples::
 
@@ -26,6 +27,24 @@ Examples::
     FF_FAULT_PLAN="lose_device@4:2"          # virtual loss of 2 devices
                                              # before step 4
     FF_FAULT_PLAN="crash@2;nan@6;lose@9"     # compose freely
+
+Rank-scoped kinds (multi-process worlds, ISSUE 7) take the target rank
+as the arg and fire ONLY in the process whose ``jax.process_index()``
+matches (every rank parses the same plan; non-matching ranks simply
+never consume the clause)::
+
+    FF_FAULT_PLAN="rank_crash@3:1"           # rank 1 hard-exits (os._exit,
+                                             # no cleanup) before step 3
+    FF_FAULT_PLAN="rank_hang@3:1"            # rank 1 SIGSTOPs itself —
+                                             # heartbeats stop, survivors
+                                             # attribute it
+    FF_FAULT_PLAN="corrupt_shard@2:1"        # flip bytes in rank 1's shard
+                                             # of the committed step-2
+                                             # multi-host checkpoint
+    FF_FAULT_PLAN="crash_after_stage@2:1"    # rank 1 dies BETWEEN staging
+                                             # its step-2 shard and the
+                                             # manifest commit (torn-
+                                             # checkpoint drill)
 
 Semantics:
 
@@ -65,7 +84,16 @@ _KINDS = {
     "truncate_ckpt": "truncate_ckpt", "truncate": "truncate_ckpt",
     "lose_device": "lose_device", "lose": "lose_device",
     "infer_fail": "infer_fail", "infer": "infer_fail",
+    "rank_crash": "rank_crash",
+    "rank_hang": "rank_hang",
+    "corrupt_shard": "corrupt_shard",
+    "crash_after_stage": "crash_after_stage",
 }
+
+#: exit code of an injected hard rank crash (``rank_crash`` /
+#: ``crash_after_stage``): ``os._exit`` with no cleanup, so to the rest
+#: of the world it is indistinguishable from a SIGKILL'd process.
+RANK_CRASH_EXIT = 13
 
 _CLAUSE_RE = re.compile(r"^([a-z_]+)@(\d+)(?::([A-Za-z0-9_]+))?$")
 
@@ -130,17 +158,32 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
-        return cls.parse(os.environ.get(ENV_VAR, ""))
+        """``FF_FAULT_PLAN`` plus — only in world epoch 0 —
+        ``FF_FAULT_PLAN_EPOCH0``. The epoch-gated variant is how a
+        world-supervised run injects a rank fault exactly once: clauses
+        fire once per *process*, so a relaunched world (fresh processes,
+        same environment) would re-fire a plain ``FF_FAULT_PLAN`` clause
+        forever; the epoch-0 plan dies with the epoch it wounded."""
+        parts = [os.environ.get(ENV_VAR, "")]
+        if int(os.environ.get("FF_WORLD_EPOCH", "0") or 0) == 0:
+            parts.append(os.environ.get(ENV_VAR + "_EPOCH0", ""))
+        return cls.parse(";".join(p for p in parts if p))
 
     # ------------------------------------------------------------------
     def unfired(self) -> int:
         return sum(1 for f in self.faults if not f.fired)
 
-    def fire(self, kind: str, step: int) -> Optional[Fault]:
+    def fire(self, kind: str, step: int,
+             rank: Optional[int] = None) -> Optional[Fault]:
         """Consume and return the first unfired clause of ``kind`` due
-        at ``step``; None otherwise."""
+        at ``step``; None otherwise. ``rank`` (rank-scoped kinds: the
+        caller's process index) must match the clause's arg — a clause
+        targeting another rank is left unfired for THAT rank's process
+        to consume."""
         for f in self.faults:
-            if not f.fired and f.kind == kind and f.step == step:
+            if not f.fired and f.kind == kind and f.step == step \
+                    and (rank is None or f.arg is None
+                         or int(f.arg) == rank):
                 f.fired = True
                 status.record_fault(kind, step)
                 obs_events.counter(f"resilience.fault.{kind}")
@@ -191,14 +234,53 @@ def active() -> bool:
 # ---------------------------------------------------------------------------
 # injection sites
 # ---------------------------------------------------------------------------
+def _rank() -> int:
+    """This process's rank; 0 when jax is not importable yet."""
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:  # pragma: no cover - pre-jax callers
+        return 0
+
+
 def raise_pending(step: int) -> None:
-    """Crash / device-loss clauses due before ``step`` executes."""
+    """Crash / device-loss / rank-scoped clauses due before ``step``
+    executes."""
     plan = get_plan()
     if plan.fire("crash", step) is not None:
         raise SimulatedCrash(step)
     f = plan.fire("lose_device", step)
     if f is not None:
         raise DeviceLoss(step, n_lost=int(f.arg or 1))
+    if plan.fire("rank_crash", step, rank=_rank()) is not None:
+        # hard death — no atexit, no finally, heartbeats just stop;
+        # the surviving world must notice via resilience/coord.py
+        os._exit(RANK_CRASH_EXIT)
+    if plan.fire("rank_hang", step, rank=_rank()) is not None:
+        # freeze the WHOLE process (heartbeat thread included): the
+        # truthful simulation of a wedged rank. SIGKILL still works on
+        # a stopped process — the world supervisor reaps it.
+        import signal
+        os.kill(os.getpid(), signal.SIGSTOP)
+
+
+def maybe_crash_after_stage(step: int) -> None:
+    """``crash_after_stage@N:r``: die between staging this rank's shard
+    (fsynced, debris-only) and the manifest commit — the torn-multi-host-
+    checkpoint drill. Called by the two-phase writer right after the
+    shard fsync."""
+    if get_plan().fire("crash_after_stage", step, rank=_rank()) \
+            is not None:
+        os._exit(RANK_CRASH_EXIT)
+
+
+def maybe_corrupt_shard(step: int, shard_path: str) -> None:
+    """``corrupt_shard@N:r``: flip bytes in THIS rank's shard of the
+    committed multi-host checkpoint ``step`` — quorum restore must rule
+    the step out on every rank."""
+    if get_plan().fire("corrupt_shard", step, rank=_rank()) is not None:
+        if os.path.exists(shard_path):
+            _flip_bytes(shard_path)
 
 
 #: process-wide inference-call counter for ``infer_fail@N`` clauses.

@@ -28,6 +28,13 @@ def _fresh() -> Dict[str, Any]:
         "last_fault": None,               # "kind@step" of the newest firing
         "last_checkpoint_step": None,
         "last_checkpoint_unix_s": None,
+        # multi-process world (resilience/coord.py); epoch/rank/size are
+        # set when a coordinator starts, failures as they are detected
+        "world_epoch": 0,
+        "world_rank": 0,
+        "world_size": 1,
+        "rank_failures": 0,               # peer failures detected here
+        "last_rank_failure": None,        # "rank=R epoch=E reason"
     }
 
 

@@ -33,6 +33,7 @@ time-since-last-checkpoint gauge, save/restore spans, and the always-on
 from __future__ import annotations
 
 import logging
+import os
 import random
 import time
 from typing import Dict, List, Optional
@@ -41,6 +42,7 @@ from ..obs import events as obs_events
 from ..obs.metrics_registry import REGISTRY
 from ..runtime.metrics_buffer import MetricsBuffer, NonFiniteMetrics
 from . import status
+from .coord import EXIT_RANK_FAILURE, RankFailure
 from .faults import DeviceLoss, SimulatedCrash  # noqa: F401 (re-export)
 
 log = logging.getLogger("flexflow_tpu")
@@ -159,6 +161,12 @@ class Supervisor:
                 self._recover(loader, reason="nan_loss", err=e)
             except DeviceLoss as e:
                 loader = self._recover_device_loss(loader, e)
+            except RankFailure:
+                # a dead PEER rank: no in-process restore can reform the
+                # world (every restore is a collective with the corpse).
+                # Propagate so the world supervisor can relaunch/shrink;
+                # the restart budget is for THIS process's failures.
+                raise
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # noqa: BLE001 — that's the job
@@ -344,6 +352,264 @@ class Supervisor:
                 "ff_time_since_last_checkpoint_seconds",
                 "Age of the newest completed checkpoint"
             ).set(time.monotonic() - self._last_save_t)
+
+
+def run_world_member(fn, *args, **kwargs):
+    """Run a worker-main under world-supervision exit semantics: a
+    :class:`~flexflow_tpu.resilience.coord.RankFailure` (a dead PEER)
+    exits with :data:`EXIT_RANK_FAILURE` so the
+    :class:`WorldSupervisor` can tell "I detected a corpse" apart from
+    "I am the corpse". Every other exception propagates normally."""
+    try:
+        return fn(*args, **kwargs)
+    except RankFailure as e:
+        log.error("world member exiting for re-formation: %s", e)
+        # os._exit, not sys.exit: the process may hold wedged device
+        # state; skip atexit/XLA teardown that could hang the exit
+        os._exit(EXIT_RANK_FAILURE)
+
+
+class WorldFailure(RuntimeError):
+    """The world could not be re-formed within the restart/shrink
+    policy; per-rank exit details ride in ``.report``."""
+
+    def __init__(self, msg: str, report=None):
+        super().__init__(msg)
+        self.report = report or []
+
+
+class WorldSupervisor:
+    """Launcher-side supervisor of an N-process jax.distributed world —
+    the cross-process half of the resilience story (ISSUE 7; the
+    per-process :class:`Supervisor` handles everything that does not
+    kill a rank).
+
+    Workers detect a dead peer via ``resilience/coord.py`` (missed
+    heartbeats, bounded barriers) and exit ``EXIT_RANK_FAILURE``; dead
+    ranks just die (or hang and are killed here). On any failed epoch
+    the WorldSupervisor kills the remnants, bumps the **world epoch**,
+    and re-forms the world at a fresh coordinator port:
+
+      - while the restart budget lasts: **relaunch** at full size — the
+        dead rank comes back and every rank resumes bit-exact from the
+        last committed multi-host checkpoint step (quorum restore);
+      - budget exhausted (or ``policy="shrink"``): **shrink** — drop to
+        the largest batch-divisible world below the current size and
+        keep going; the restored state reshards onto the smaller world
+        through the reshard planner's ``place_host`` path exactly like
+        the in-process elastic re-plan.
+
+    ``worker_cmd`` is either a callable ``(rank, nprocs, port, epoch)
+    -> argv list`` or an argv template whose ``{rank}``/``{nprocs}``/
+    ``{port}``/``{epoch}`` placeholders are substituted. Workers
+    inherit the environment plus the ``FF_*`` world variables
+    (coordinator address, process id/count, world epoch,
+    ``FF_WORLD_SUPERVISED=1``).
+
+    Every wait is bounded: a world that neither finishes nor fails
+    within ``world_timeout_s`` is killed and treated as failed
+    (unattributed hang)."""
+
+    def __init__(self, worker_cmd, nprocs: int, *,
+                 max_world_restarts: int = 1, policy: str = "auto",
+                 min_world: int = 1, batch_size: int = 0,
+                 devices_per_rank: int = 1,
+                 world_timeout_s: float = 300.0,
+                 poll_interval_s: float = 0.1, env=None):
+        assert policy in ("auto", "relaunch", "shrink"), policy
+        self.worker_cmd = worker_cmd
+        self.nprocs = int(nprocs)
+        self.max_world_restarts = max_world_restarts
+        self.policy = policy
+        self.min_world = max(1, min_world)
+        self.batch_size = batch_size
+        self.devices_per_rank = max(1, devices_per_rank)
+        self.world_timeout_s = world_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.env = dict(env) if env else None
+        self.epoch = int(os.environ.get("FF_WORLD_EPOCH", "0"))
+        self.world_restarts = 0
+        self.shrinks = 0
+        self.report: List[Dict] = []
+
+    # -- helpers -------------------------------------------------------
+    def _argv(self, rank: int, port: int) -> List[str]:
+        if callable(self.worker_cmd):
+            return list(self.worker_cmd(rank, self.nprocs, port,
+                                        self.epoch))
+        subst = {"{rank}": str(rank), "{nprocs}": str(self.nprocs),
+                 "{port}": str(port), "{epoch}": str(self.epoch)}
+        out = []
+        for a in self.worker_cmd:
+            for k, v in subst.items():  # embedded forms too: --rank={rank}
+                a = a.replace(k, v)
+            out.append(a)
+        return out
+
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            return s.getsockname()[1]
+
+    # -- one epoch -----------------------------------------------------
+    def _launch_epoch(self) -> List[Dict]:
+        """Spawn the world, wait bounded, reap everything; returns the
+        per-rank records (rank, rc, out, err)."""
+        import signal
+        import subprocess
+        import tempfile
+        port = self._free_port()
+        base_env = dict(os.environ)
+        if self.env:
+            base_env.update(self.env)
+        procs = []
+        deadline = time.monotonic() + self.world_timeout_s
+        try:
+            # spawning INSIDE the try: a Popen failure on a later rank
+            # (EMFILE, bad argv) must still reap the ranks already
+            # launched — they would otherwise block in rendezvous forever
+            for r in range(self.nprocs):
+                env = dict(base_env)
+                env.update({
+                    "FF_COORDINATOR_ADDRESS": f"localhost:{port}",
+                    "FF_NUM_PROCESSES": str(self.nprocs),
+                    "FF_PROCESS_ID": str(r),
+                    "FF_WORLD_EPOCH": str(self.epoch),
+                    "FF_WORLD_SUPERVISED": "1",
+                })
+                # files, not pipes: a chatty worker must never deadlock
+                # the launcher on a full pipe while we wait on a sibling
+                out_f = tempfile.TemporaryFile(mode="w+")
+                err_f = tempfile.TemporaryFile(mode="w+")
+                p = subprocess.Popen(self._argv(r, port), env=env,
+                                     stdout=out_f, stderr=err_f,
+                                     text=True, start_new_session=True)
+                procs.append({"rank": r, "proc": p, "out_f": out_f,
+                              "err_f": err_f, "rc": None})
+            while True:
+                alive = 0
+                failed = False
+                for rec in procs:
+                    if rec["rc"] is None:
+                        rc = rec["proc"].poll()
+                        if rc is None:
+                            alive += 1
+                        else:
+                            rec["rc"] = rc
+                            failed = failed or rc != 0
+                if alive == 0 or failed or time.monotonic() > deadline:
+                    break
+                time.sleep(self.poll_interval_s)
+        finally:
+            for rec in procs:
+                if rec["proc"].poll() is None:
+                    # SIGKILL the whole group: a SIGSTOP'd (hung-fault)
+                    # worker ignores anything milder
+                    rec["killed"] = True
+                    try:
+                        os.killpg(rec["proc"].pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+            out = []
+            for rec in procs:
+                rec["proc"].wait()
+                rec["rc"] = rec["proc"].returncode
+                rec.setdefault("killed", False)
+                for key in ("out_f", "err_f"):
+                    f = rec.pop(key)
+                    f.seek(0)
+                    rec[key[:3]] = f.read()
+                    f.close()
+                rec.pop("proc")
+                out.append(rec)
+        return out
+
+    @staticmethod
+    def _suspects(records) -> List[int]:
+        """Ranks believed dead/hung on their own: died hard without our
+        SIGKILL, or — ONLY when no rank died hard — still running
+        (wedged) when a peer exited with the detector code and we
+        reaped them. A hard death explains the epoch's failure, and the
+        reaped survivors were healthy ranks we killed ourselves;
+        counting them too would over-shrink worlds larger than 2."""
+        detectors = [r["rank"] for r in records
+                     if r["rc"] == EXIT_RANK_FAILURE]
+        out = [r["rank"] for r in records
+               if r["rc"] not in (0, EXIT_RANK_FAILURE)
+               and not r["killed"]]
+        if not out and detectors:
+            out = [r["rank"] for r in records if r["killed"]]
+        return sorted(out)
+
+    def _classify(self, records) -> str:
+        detectors = [r["rank"] for r in records
+                     if r["rc"] == EXIT_RANK_FAILURE]
+        return (f"suspect ranks {self._suspects(records)} (exit codes "
+                f"{[r['rc'] for r in records]}), detected by ranks "
+                f"{detectors}")
+
+    # -- the loop ------------------------------------------------------
+    def run(self) -> List[Dict]:
+        """Drive the world to a successful epoch; returns the per-rank
+        records (with stdout/stderr) of that epoch. Raises
+        :class:`WorldFailure` when the policy is exhausted."""
+        from .elastic import shrunken_world_size
+        while True:
+            log.info("world supervisor: launching epoch %d with %d "
+                     "process(es)", self.epoch, self.nprocs)
+            records = self._launch_epoch()
+            self.report.append({"epoch": self.epoch,
+                                "nprocs": self.nprocs,
+                                "rcs": [r["rc"] for r in records]})
+            if all(r["rc"] == 0 for r in records):
+                status.set_value("world_epoch", self.epoch)
+                return records
+            why = self._classify(records)
+            REGISTRY.counter(
+                "ff_world_restarts_total",
+                "World re-formations by the world supervisor").inc()
+            obs_events.instant("resilience.world_restart",
+                               epoch=self.epoch, nprocs=self.nprocs,
+                               why=why)
+            self.epoch += 1
+            relaunch_ok = (self.policy in ("auto", "relaunch")
+                           and self.world_restarts
+                           < self.max_world_restarts)
+            if relaunch_ok:
+                self.world_restarts += 1
+                status.record("restarts")
+                log.warning("world supervisor: %s — relaunching epoch "
+                            "%d at full size %d (restart %d/%d)", why,
+                            self.epoch, self.nprocs,
+                            self.world_restarts,
+                            self.max_world_restarts)
+                continue
+            n_failed = len(self._suspects(records)) or 1
+            new_n = 0
+            if self.policy in ("auto", "shrink") \
+                    and self.nprocs - n_failed >= self.min_world:
+                new_n = shrunken_world_size(
+                    self.nprocs - n_failed, self.batch_size,
+                    self.devices_per_rank)
+            if new_n >= self.min_world and new_n > 0:
+                log.warning("world supervisor: %s — shrinking world "
+                            "%d -> %d for epoch %d", why, self.nprocs,
+                            new_n, self.epoch)
+                self.nprocs = new_n
+                self.shrinks += 1
+                status.record("elastic_replans")
+                obs_events.counter("resilience.world_shrink")
+                continue
+            tails = "; ".join(
+                f"rank {r['rank']} rc={r['rc']}: "
+                f"{(r['err'] or '')[-500:]}" for r in records
+                if r["rc"] != 0)
+            raise WorldFailure(
+                f"world unrecoverable after {self.world_restarts} "
+                f"restart(s) and {self.shrinks} shrink(s): {why}\n"
+                f"{tails}", report=self.report)
 
 
 def run_supervised(ff, directory: str, x=None, y=None,

@@ -25,6 +25,34 @@ Durability contract (resilience subsystem, ISSUE 3):
     host gather in the caller (it must run on every process) and the
     file writes on a background thread, so the train loop overlaps the
     checkpoint I/O (bench's recovery leg pins steady-state overhead).
+
+Multi-host worlds (ISSUE 7) extend the same contract per-world with a
+**two-phase commit** (format v2, ``manifest.json`` carries
+``"format": "multihost"``):
+
+  1. **stage**: every rank pickles ONLY the state blocks its own devices
+     hold (no collective gather — each leaf is split by the sharding's
+     owner map, replicated leaves are written once by their lowest
+     owning rank) into ``tmp-<step>/shard-<rank>.pkl`` + an fsynced
+     ``shard-<rank>.ok.json`` sidecar recording the file's CRC32;
+  2. **barrier** (bounded, ``resilience/coord.py`` — a dead rank raises
+     :class:`~flexflow_tpu.resilience.coord.RankFailure` instead of
+     hanging the save);
+  3. **commit**: rank 0 alone writes ``manifest.json`` naming every
+     shard file + CRC, then ``meta.json``, then publishes the step with
+     one atomic rename. A crash at ANY point — any rank, either phase —
+     leaves either a fully-restorable committed step or cleanly-ignored
+     ``tmp-*`` staging debris; a torn-but-listed step cannot exist.
+
+Restore in a multi-host world reaches **quorum**: each rank publishes
+the set of steps it can locally verify (manifest + every shard CRC) to
+the coordination KV store, and all ranks deterministically adopt the
+newest step EVERY rank verified, falling back past steps any rank finds
+corrupt. A world of a different size (elastic shrink/grow) restores the
+same files: every rank assembles the full host state from all shard
+files and re-places it through ``parallel/reshard.place_host``.
+Shard files live under the checkpoint directory, which multi-host
+deployments must put on storage every rank can read (tests use /tmp).
 """
 from __future__ import annotations
 
@@ -125,6 +153,102 @@ def _verify_manifest(state, manifest: Dict[str, Any], where: str) -> None:
                 f"{rec['crc32']:#010x} (bit rot or truncated write)")
 
 
+class ShardBlocks:
+    """One leaf of the multi-host shard tree: the global array metadata
+    plus the blocks THIS rank owns. Blocks are ``(index, ndarray)``
+    where ``index`` is a per-dim ``[start, stop]`` list into the global
+    shape. Picklable by construction (plain python + numpy)."""
+
+    __slots__ = ("shape", "dtype", "blocks")
+
+    def __init__(self, shape, dtype, blocks):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = str(dtype)
+        self.blocks = blocks
+
+    def __getstate__(self):
+        return (self.shape, self.dtype, self.blocks)
+
+    def __setstate__(self, s):
+        self.shape, self.dtype, self.blocks = s
+
+
+def _norm_index(idx, shape) -> List[List[int]]:
+    """A shard's index tuple as concrete [start, stop] per dim."""
+    out = []
+    for r, dim in zip(idx, shape):
+        if isinstance(r, slice):
+            out.append([int(r.start or 0),
+                        int(dim if r.stop is None else r.stop)])
+        else:  # integer index — never produced by shardings we emit
+            out.append([int(r), int(r) + 1])
+    # rank-0 dims beyond the index tuple are unsharded
+    out.extend([0, int(dim)] for dim in shape[len(idx):])
+    return out
+
+
+def _owned_blocks(x) -> ShardBlocks:
+    """The blocks of leaf ``x`` this process must persist. Each distinct
+    shard index is owned by exactly one device — the lowest
+    ``(process_index, id)`` among the devices holding it — so replicated
+    leaves are written once (by rank 0's lowest device), sharded leaves
+    exactly partition across the world, and no byte is written twice."""
+    import jax
+    if not isinstance(x, jax.Array) or not hasattr(x, "sharding"):
+        arr = np.asarray(x)
+        blocks = []
+        if jax.process_index() == 0:
+            blocks = [(_norm_index((), arr.shape), arr)]
+        return ShardBlocks(arr.shape, arr.dtype, blocks)
+    shape = x.shape
+    owner: Dict[str, Any] = {}
+    for dev, idx in x.sharding.devices_indices_map(shape).items():
+        key = json.dumps(_norm_index(idx, shape))
+        cur = owner.get(key)
+        rank = (dev.process_index, dev.id)
+        if cur is None or rank < cur:
+            owner[key] = rank
+    me = jax.process_index()
+    blocks = []
+    for shard in x.addressable_shards:
+        nidx = _norm_index(shard.index, shape)
+        key = json.dumps(nidx)
+        if owner.get(key) == (shard.device.process_index,
+                              shard.device.id) \
+                and shard.device.process_index == me:
+            blocks.append((nidx, np.asarray(shard.data)))
+    return ShardBlocks(shape, np.dtype(x.dtype), blocks)
+
+
+def _assemble_blocks(leaves) -> np.ndarray:
+    """Merge one leaf's ShardBlocks from every rank file into the global
+    host array."""
+    first = leaves[0]
+    out = np.empty(first.shape, dtype=np.dtype(first.dtype))
+    filled = 0
+    for lf in leaves:
+        for idx, block in lf.blocks:
+            sl = tuple(slice(a, b) for a, b in idx)
+            out[sl] = np.asarray(block).reshape(
+                tuple(b - a for a, b in idx))
+            filled += int(np.prod([b - a for a, b in idx]) or 1)
+    if filled < int(np.prod(first.shape) or 1):
+        raise CheckpointCorruption(
+            f"shard blocks cover {filled} of "
+            f"{int(np.prod(first.shape) or 1)} elements of a "
+            f"{first.dtype}{list(first.shape)} leaf — missing shard "
+            f"data (wrong world size at save, or a lost shard file)")
+    return out
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
 def _fsync_dir(path: str) -> None:
     try:
         fd = os.open(path, os.O_RDONLY)
@@ -166,6 +290,8 @@ class CheckpointManager:
         self.writer = writer
         self._pending: Optional[threading.Thread] = None
         self._pending_error: Optional[BaseException] = None
+        self._restore_seq = 0  # multi-host quorum-round sequencer
+        self._save_seq = 0     # multi-host save-barrier sequencer
         try:
             import orbax.checkpoint as ocp
             self._ocp = ocp
@@ -210,24 +336,31 @@ class CheckpointManager:
         """state: arbitrary pytree (params/opt_state/op state).
 
         Collective in a multi-controller world: EVERY process must call
-        (cross-host shards gather collectively); process 0 writes.
-        ``blocking=False`` (or ``async_save=True`` at construction)
-        returns after the host gather and writes on a background thread
-        — call :meth:`wait` (or any later save/restore) to join."""
+        (with the same ``blocking``). Multi-host saves take the
+        two-phase sharded path — each rank stages only its own blocks,
+        no cross-host gather ever happens. ``blocking=False`` (or
+        ``async_save=True`` at construction) returns after the local
+        shard extraction and runs the writes (and, multi-host, the
+        commit barriers) on a background thread — call :meth:`wait` (or
+        any later save/restore) to join."""
         import jax
-        host_state = _tree_to_numpy(state)  # collective gather
-        if jax.process_index() != 0:
-            return
+        multihost = jax.process_count() > 1
+        if multihost:
+            # local shard extraction — pure host work, no collectives
+            host_state = jax.tree.map(_owned_blocks, state)
+        else:
+            host_state = _tree_to_numpy(state)
         self.wait()  # one write in flight at a time
         if blocking is None:
             blocking = not self.async_save
         meta = dict(metadata or {})
+        write = self._write_multihost if multihost else self._write_step
         if blocking:
-            self._write_step(step, host_state, meta)
+            write(step, host_state, meta)
         else:
             def run():
                 try:
-                    self._write_step(step, host_state, meta)
+                    write(step, host_state, meta)
                 except BaseException as e:  # surfaced by wait()
                     self._pending_error = e
             t = threading.Thread(target=run, name=f"ckpt-save-{step}",
@@ -308,6 +441,148 @@ class CheckpointManager:
                                time.perf_counter() - t0, step=step)
 
     # ------------------------------------------------------------------
+    # multi-host two-phase commit (format v2)
+    # ------------------------------------------------------------------
+    def _write_multihost(self, step: int, shard_tree,
+                         metadata: Dict[str, Any]) -> None:
+        """Stage this rank's shard + sidecar, bounded-barrier, then rank
+        0 alone commits manifest + meta + atomic rename. Runs on EVERY
+        rank (possibly on the async writer thread)."""
+        import jax
+        from ..resilience import coord, faults
+        c = coord.ensure_started()
+        rank, world = jax.process_index(), jax.process_count()
+        t0 = time.perf_counter()
+        # barrier ids must be fresh per save: saves are collective and
+        # serialized (wait()), so a per-manager counter agrees across
+        # ranks even when the same step is ever re-saved
+        self._save_seq += 1
+        tag = f"{step}-{self._save_seq}"
+        tmp = os.path.join(self.directory, f"tmp-{step}")
+        if rank == 0:
+            if os.path.isdir(tmp):
+                import shutil
+                # stale debris from a killed save (possibly a different
+                # world size) must not leak into this step's manifest
+                shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+        c.barrier(f"ckpt-begin-{tag}")
+        # ---- phase 1: stage -----------------------------------------
+        import pickle
+        shard = os.path.join(tmp, f"shard-{rank}.pkl")
+        with open(shard, "wb") as f:
+            pickle.dump(shard_tree, f)
+            f.flush()
+            os.fsync(f.fileno())
+        crc = _file_crc32(shard)
+        with open(os.path.join(tmp, f"shard-{rank}.ok.json"), "w") as f:
+            json.dump({"rank": rank, "crc32": crc,
+                       "bytes": os.path.getsize(shard),
+                       "epoch": c.epoch}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if faults.active():
+            faults.maybe_crash_after_stage(step)
+        c.barrier(f"ckpt-stage-{tag}")
+        # ---- phase 2: commit (rank 0 only) --------------------------
+        sdir = self._step_dir(step)
+        if rank == 0:
+            shards = {}
+            for r in range(world):
+                ok = os.path.join(tmp, f"shard-{r}.ok.json")
+                with open(ok) as f:
+                    rec = json.load(f)
+                shards[f"shard-{r}.pkl"] = {"crc32": rec["crc32"],
+                                            "bytes": rec["bytes"]}
+            manifest = {"version": 2, "format": "multihost",
+                        "world_size": world, "epoch": c.epoch,
+                        "shards": shards}
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump({"step": step, "world_size": world,
+                           **metadata}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.isdir(sdir):
+                import shutil
+                shutil.rmtree(sdir, ignore_errors=True)
+            os.replace(tmp, sdir)
+            _fsync_dir(self.directory)
+            self._gc()
+        # every rank leaves only once the step is committed — "resume
+        # from the last committed step" means the same step on all ranks
+        c.barrier(f"ckpt-commit-{tag}")
+        if faults.active():
+            faults.maybe_corrupt_shard(
+                step, os.path.join(sdir, f"shard-{rank}.pkl"))
+        from ..resilience import status
+        status.record_checkpoint(step)
+        REGISTRY.counter("ff_checkpoint_saves_total",
+                         "Completed checkpoint saves").inc()
+        REGISTRY.gauge("ff_checkpoint_last_step",
+                       "Step of the newest completed checkpoint"
+                       ).set(float(step))
+        obs_events.record_span("ckpt.save", t0,
+                               time.perf_counter() - t0, step=step,
+                               multihost=True)
+
+    def _verified_steps(self) -> List[int]:
+        """Steps THIS rank can verify cheaply (manifest present, every
+        listed shard file's CRC matches; single-process format steps
+        verify by full load)."""
+        out = []
+        for s in self.all_steps():
+            sdir = self._step_dir(s)
+            mpath = os.path.join(sdir, "manifest.json")
+            try:
+                with open(mpath) as f:
+                    manifest = json.load(f)
+                if manifest.get("format") == "multihost":
+                    for fname, rec in manifest["shards"].items():
+                        p = os.path.join(sdir, fname)
+                        if _file_crc32(p) != rec["crc32"]:
+                            raise CheckpointCorruption(
+                                f"step {s}: {fname} CRC mismatch")
+                else:
+                    self._load_step(s, verify=True)
+            except Exception as e:  # noqa: BLE001 — a probe
+                log.warning("checkpoint step %d fails local "
+                            "verification (%s)", s, e)
+                from ..resilience import status
+                status.record("corrupt_checkpoints_skipped")
+                REGISTRY.counter(
+                    "ff_checkpoint_corrupt_skipped_total",
+                    "Restore fallbacks past corrupt/partial steps").inc()
+                obs_events.counter("ckpt.corrupt_skipped")
+                continue
+            out.append(s)
+        return out
+
+    def _quorum_step(self) -> Optional[int]:
+        """Newest step EVERY rank verifies, agreed through the
+        coordination KV store; None when no step survives quorum.
+        Collective — every rank must call (same restore sequence)."""
+        from ..resilience import coord
+        mine = self._verified_steps()
+        c = coord.get()
+        if c is None or c.world <= 1:
+            return mine[-1] if mine else None
+        self._restore_seq += 1
+        prefix = f"ff/restore/e{c.epoch}/s{self._restore_seq}/"
+        c.kv.set(prefix + str(c.rank), ",".join(map(str, mine)))
+        c.barrier(f"restore-{self._restore_seq}")
+        common: Optional[set] = None
+        for _, csv in c.kv.dir_get(prefix):
+            steps = {int(t) for t in csv.split(",") if t}
+            common = steps if common is None else (common & steps)
+        if not common:
+            return None
+        return max(common)
+
+    # ------------------------------------------------------------------
     def restore(self, step: Optional[int] = None, verify: bool = True):
         """Returns (state, metadata).
 
@@ -315,10 +590,25 @@ class CheckpointManager:
         included). Default (latest): walk steps newest-first, skipping
         corrupt or partial ones with a warning, and return the newest
         valid step — the auto-resume entry point must survive a torn or
-        bit-rotted newest checkpoint."""
+        bit-rotted newest checkpoint.
+
+        Multi-host worlds make the default restore COLLECTIVE: every
+        rank must call it, and all adopt the quorum step (the newest one
+        every rank verifies — see :meth:`_quorum_step`)."""
         self.wait()
         if step is not None:
             return self._load_step(step, verify=verify)
+        import jax
+        if jax.process_count() > 1:
+            s = self._quorum_step()
+            if s is None:
+                raise FileNotFoundError(
+                    f"no checkpoint step in {self.directory} survives "
+                    f"all-rank quorum verification")
+            # quorum already CRC-verified exactly these files on this
+            # rank — re-hashing every shard byte on the load would read
+            # the whole checkpoint off shared storage twice
+            return self._load_step(s, verify=False)
         candidates = self.all_steps()
         if not candidates:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
@@ -349,24 +639,49 @@ class CheckpointManager:
     def _load_step(self, step: int, verify: bool = True):
         t0 = time.perf_counter()
         sdir = self._step_dir(step)
-        path = os.path.join(sdir, "state")
-        if self._ocp is not None and os.path.isdir(path):
-            with self._ocp.PyTreeCheckpointer() as ckptr:
-                state = ckptr.restore(path)
-        else:
-            import pickle
-            with open(path + ".pkl", "rb") as f:
-                state = pickle.load(f)
-        with open(os.path.join(sdir, "meta.json")) as f:
-            meta = json.load(f)
         mpath = os.path.join(sdir, "manifest.json")
-        if verify and os.path.exists(mpath):
+        manifest = None
+        if os.path.exists(mpath):
             with open(mpath) as f:
                 manifest = json.load(f)
-            _verify_manifest(state, manifest, f"checkpoint step {step}")
+        if manifest is not None and manifest.get("format") == "multihost":
+            state = self._load_multihost(sdir, manifest, verify=verify)
+        else:
+            path = os.path.join(sdir, "state")
+            if self._ocp is not None and os.path.isdir(path):
+                with self._ocp.PyTreeCheckpointer() as ckptr:
+                    state = ckptr.restore(path)
+            else:
+                import pickle
+                with open(path + ".pkl", "rb") as f:
+                    state = pickle.load(f)
+            if verify and manifest is not None:
+                _verify_manifest(state, manifest,
+                                 f"checkpoint step {step}")
+        with open(os.path.join(sdir, "meta.json")) as f:
+            meta = json.load(f)
         obs_events.record_span("ckpt.restore", t0,
                                time.perf_counter() - t0, step=step)
         return state, meta
+
+    def _load_multihost(self, sdir: str, manifest: Dict[str, Any],
+                        verify: bool = True):
+        """Assemble the full host state from every rank's shard file —
+        readable by a world of ANY size (the elastic shrink/relaunch
+        resume path), since each shard carries its global indices."""
+        import pickle
+
+        import jax
+        trees = []
+        for fname, rec in sorted(manifest["shards"].items()):
+            p = os.path.join(sdir, fname)
+            if verify and _file_crc32(p) != rec["crc32"]:
+                raise CheckpointCorruption(
+                    f"{sdir}: {fname} CRC32 != manifest (bit rot or "
+                    f"torn shard)")
+            with open(p, "rb") as f:
+                trees.append(pickle.load(f))
+        return jax.tree.map(lambda *ls: _assemble_blocks(ls), *trees)
 
     def verify_step(self, step: int) -> bool:
         """True iff ``step`` loads and passes manifest verification."""

@@ -9,35 +9,93 @@ import os
 import sys
 
 
-def launch_world(n_local: int = 2, timeout: float = 300.0):
-    """Spawn the 2-controller world and return both stdouts. Raises on
-    any controller failure; asserts the replicated loss agrees."""
+def launch_world(n_local: int = 2, timeout: float = 300.0,
+                 extra_env: dict = None, worker_path: str = None,
+                 expect_ok: bool = True, reap_on_failure: bool = True):
+    """Spawn the 2-controller world and return both stdouts.
+
+    Fail-fast reaping: the first controller to exit nonzero gets its
+    sibling SIGKILLed immediately (a failed controller 0 must not block
+    ``timeout`` seconds on controller 1, which may be wedged in a
+    collective that will never complete) and BOTH processes are always
+    reaped — on assertion failure the message carries both stderr
+    tails. ``extra_env`` augments the worker environment (fault plans,
+    timeout tuning); ``worker_path`` substitutes a different worker
+    main; ``expect_ok=False`` skips the DIST_OK/loss assertions and
+    returns ``(returncodes, stdouts, stderrs)`` raw for tests that
+    drive failure scenarios; ``reap_on_failure=False`` lets BOTH
+    controllers run to their own exit (bounded by ``timeout``) — for
+    tests asserting a survivor's own failure detection is bounded,
+    where the fail-fast sibling kill would mask the very path under
+    test."""
     import socket
     import subprocess
+    import time
 
-    worker = os.path.abspath(__file__)
+    worker = os.path.abspath(worker_path or __file__)
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
     env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(worker)) \
-        + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep \
+        + env.get("PYTHONPATH", "")
     env.pop("JAX_PLATFORMS", None)  # the worker sets its own
+    if extra_env:
+        env.update(extra_env)
+    import tempfile
+
+    # files, not pipes: a chatty controller (failure-drill stack
+    # traces) must never wedge on a full pipe while we poll — the
+    # poll loop only drains at the end
+    files = [(tempfile.TemporaryFile(mode="w+"),
+              tempfile.TemporaryFile(mode="w+")) for _ in range(2)]
     procs = [subprocess.Popen(
         [sys.executable, worker, str(port), str(i), str(n_local)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        stdout=files[i][0], stderr=files[i][1], text=True,
         env=env) for i in range(2)]
-    outs = []
-    for i, p in enumerate(procs):
-        try:
-            out, err = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0 and "DIST_OK" in out, \
-            f"controller {i} failed:\n{out}\n{err[-2000:]}"
-        outs.append(out)
+    deadline = time.monotonic() + timeout
+    timed_out = False
+    try:
+        while True:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                break
+            if reap_on_failure \
+                    and any(rc is not None and rc != 0 for rc in rcs):
+                break  # first failure: kill the sibling NOW
+            if time.monotonic() > deadline:
+                timed_out = True
+                break
+            time.sleep(0.05)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        outs, errs = [], []
+        for p, (out_f, err_f) in zip(procs, files):
+            p.wait()  # both processes always reaped
+            chunks = []
+            for f in (out_f, err_f):
+                f.seek(0)
+                chunks.append(f.read())
+                f.close()
+            outs.append(chunks[0])
+            errs.append(chunks[1])
+    rcs = [p.returncode for p in procs]
+    if not expect_ok:
+        return rcs, outs, errs
+
+    def _tails() -> str:
+        return "\n".join(
+            f"-- controller {i} (rc={rcs[i]}) stderr tail --\n"
+            f"{errs[i][-2000:]}" for i in range(2))
+
+    assert not timed_out, \
+        f"world timed out after {timeout:.0f}s\n{_tails()}"
+    for i in range(2):
+        assert rcs[i] == 0 and "DIST_OK" in outs[i], \
+            f"controller {i} failed:\n{outs[i][-1000:]}\n{_tails()}"
     losses = [[t for t in o.split() if t.startswith("loss1=")][0]
               for o in outs]
     assert losses[0] == losses[1], losses

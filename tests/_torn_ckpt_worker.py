@@ -1,0 +1,90 @@
+"""Worker for the torn multi-host checkpoint drills (test_resilience).
+
+One controller of a 2-process CPU world exercising the two-phase
+checkpoint commit directly (no model — the unit under test is
+``CheckpointManager``'s stage/barrier/commit protocol and quorum
+restore). Driven by ``launch_world`` with:
+
+  - ``FF_TORN_CKPT_DIR``: shared checkpoint directory;
+  - ``FF_TORN_MODE=train``: save step 1 (committed), then step 2 — an
+    injected ``crash_after_stage@2:1`` kills rank 1 BETWEEN staging its
+    step-2 shard and the manifest commit; rank 0's stage barrier must
+    time out to an attributed RankFailure (detector exit code), never
+    hang, and step 2 must end as ``tmp-2`` debris, not a listed step;
+  - ``FF_TORN_MODE=restore``: a fresh world quorum-restores and prints
+    the adopted step + a CRC of the assembled state — the test asserts
+    every rank lands on the last COMMITTED step, bit-exact.
+
+The state is a cross-process sharded array (each rank owns half the
+rows) plus a replicated host scalar, so shard ownership, assembly, and
+replicated-leaf dedup are all on the hook.
+"""
+import os
+import sys
+
+if __name__ == "__main__":
+    # env setup must precede any jax import
+    _LOCAL = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={_LOCAL}"
+
+
+def main():
+    import zlib
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["FF_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+    os.environ["FF_NUM_PROCESSES"] = "2"
+    os.environ["FF_PROCESS_ID"] = str(pid)
+    # tight bounds: the torn save must fail in seconds, not minutes
+    os.environ.setdefault("FF_HB_INTERVAL_S", "0.1")
+    os.environ.setdefault("FF_HB_TIMEOUT_S", "3")
+    os.environ.setdefault("FF_BARRIER_TIMEOUT_S", "8")
+
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from flexflow_tpu.parallel.distributed import maybe_initialize
+    from flexflow_tpu.resilience import coord, run_world_member
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+    assert maybe_initialize(), "worker must join the 2-process world"
+    coord.ensure_started()
+    devs = np.array(jax.devices()).reshape(jax.process_count(), -1)
+    mesh = Mesh(devs, ("dcn", "x"))
+    rows = NamedSharding(mesh, P("dcn"))
+
+    def state_at(step: int):
+        base = (np.arange(32, dtype=np.float32).reshape(8, 4)
+                * float(step + 1))
+        w = jax.make_array_from_callback(
+            (8, 4), rows, lambda idx: base[idx])
+        return {"w": w, "bias": np.float32(step)}
+
+    mgr = CheckpointManager(os.environ["FF_TORN_CKPT_DIR"])
+    if os.environ.get("FF_TORN_MODE", "train") == "train":
+        def run():
+            mgr.save(1, state_at(1), metadata={"tag": "good"})
+            # crash_after_stage@2:1 fires inside this save on rank 1
+            mgr.save(2, state_at(2), metadata={"tag": "torn"})
+            print(f"TRAIN_OK pid={pid}", flush=True)
+        run_world_member(run)
+    else:
+        state, meta = mgr.restore()
+        w = np.asarray(state["w"])
+        crc = zlib.crc32(w.tobytes()) & 0xFFFFFFFF
+        print(f"RESTORE_OK pid={pid} step={meta['step']} "
+              f"crc={crc:#010x} "
+              f"bias={float(np.asarray(state['bias'])):.1f} "
+              f"steps={','.join(map(str, sorted(mgr.all_steps())))}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

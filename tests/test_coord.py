@@ -1,0 +1,257 @@
+"""Cross-process coordination layer (ISSUE 7): heartbeats, bounded
+barriers, world epoch, rank-scoped fault clauses, and the
+WorldSupervisor's relaunch/shrink policy.
+
+Everything here runs without a real multi-process jax world: LocalKV
+stands in for the distributed KV store (two Coordinator instances in
+one process play two ranks), and the WorldSupervisor tests drive tiny
+``python -c`` workers whose exit codes script each failure scenario.
+The real 2-process world is covered by tests/test_distributed.py and
+the torn-checkpoint drills in tests/test_resilience.py.
+"""
+import sys
+import time
+
+import pytest
+
+from flexflow_tpu.resilience import (EXIT_RANK_FAILURE, Coordinator,
+                                     RankFailure, WorldFailure,
+                                     WorldSupervisor, coord, faults, status)
+from flexflow_tpu.resilience.coord import LocalKV
+from flexflow_tpu.resilience.elastic import shrunken_world_size
+from flexflow_tpu.resilience.faults import FaultPlan
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    coord.reset()
+    faults.install("")
+    status.reset()
+    yield
+    coord.reset()
+    faults.clear()
+    status.reset()
+
+
+def _pair(kv, **kw):
+    """Two coordinators sharing one KV: rank 0 and rank 1 of a
+    2-process world, in-process."""
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("heartbeat_timeout_s", 0.3)
+    kw.setdefault("barrier_timeout_s", 0.2)
+    kw.setdefault("supervised", False)
+    kw.setdefault("epoch", 0)
+    return (Coordinator(0, 2, kv=kv, **kw),
+            Coordinator(1, 2, kv=kv, **kw))
+
+
+# ======================================================================
+# heartbeats
+# ======================================================================
+def test_heartbeat_detects_silent_peer():
+    kv = LocalKV()
+    c0, c1 = _pair(kv)
+    try:
+        c0.start()
+        # rank 1 beats for a while, then goes silent (crash/SIGSTOP)
+        deadline = time.monotonic() + 0.2
+        while time.monotonic() < deadline:
+            c1.beat()
+            time.sleep(0.05)
+        deadline = time.monotonic() + 3.0
+        while c0.failure() is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        f = c0.failure()
+        assert isinstance(f, RankFailure)
+        assert f.rank == 1  # attributed, not anonymous
+        with pytest.raises(RankFailure):
+            c0.check()
+        snap = status.snapshot()
+        assert snap["rank_failures"] >= 1
+        assert "rank=1" in snap["last_rank_failure"]
+    finally:
+        c0.stop()
+
+
+def test_heartbeat_quiet_while_peers_beat():
+    kv = LocalKV()
+    c0, c1 = _pair(kv)
+    try:
+        c0.start()
+        deadline = time.monotonic() + 0.6  # 2x the 0.3s timeout
+        while time.monotonic() < deadline:
+            c1.beat()
+            time.sleep(0.05)
+        assert c0.failure() is None
+        c0.check()  # no raise
+    finally:
+        c0.stop()
+
+
+def test_world_facts_in_status():
+    Coordinator(1, 2, kv=LocalKV(), epoch=4, supervised=False)
+    snap = status.snapshot()
+    assert snap["world_epoch"] == 4
+    assert snap["world_rank"] == 1
+    assert snap["world_size"] == 2
+
+
+# ======================================================================
+# bounded barriers
+# ======================================================================
+def test_barrier_timeout_attributes_stale_rank():
+    kv = LocalKV()
+    c0, c1 = _pair(kv, heartbeat_timeout_s=0.1)
+    c1.beat()
+    c0._scan_peers()        # observe rank 1's seq once...
+    time.sleep(0.15)        # ...then let it go stale
+    with pytest.raises(RankFailure) as ei:
+        c0.barrier("sync", timeout_s=0.01)
+    assert ei.value.rank == 1
+    assert "sync" in str(ei.value)
+    # the failure is latched: every later wait fails fast
+    with pytest.raises(RankFailure):
+        c0.check()
+
+
+def test_barrier_timeout_unattributed_when_peers_beat():
+    kv = LocalKV()
+    c0, c1 = _pair(kv)
+    c1.beat()
+    c0._scan_peers()
+    c1.beat()  # rank 1 is alive, just not at the barrier: a slow rank
+    with pytest.raises(RankFailure) as ei:
+        c0.barrier("sync", timeout_s=0.01)
+    assert ei.value.rank is None
+    assert "unknown rank" in str(ei.value)
+
+
+def test_single_process_coordinator_is_noop():
+    c = Coordinator(0, 1, kv=LocalKV(), supervised=False)
+    assert c.start() is c and c._thread is None  # no heartbeat thread
+    c.barrier("anything", timeout_s=0.01)       # returns immediately
+    c.check()
+    c.stop()
+
+
+def test_module_level_calls_noop_without_coordinator():
+    assert coord.get() is None
+    coord.check()
+    coord.barrier("x")  # single-process checkpoint path calls this
+
+
+def test_ensure_started_singleton():
+    c = coord.ensure_started()
+    assert c.world == 1  # the test process is a single-controller world
+    assert coord.ensure_started() is c
+    assert coord.get() is c
+
+
+def test_epoch_scopes_heartbeat_keys():
+    kv = LocalKV()
+    old = Coordinator(0, 2, kv=kv, epoch=0, supervised=False)
+    old.beat()  # debris from the dead epoch
+    new = Coordinator(0, 2, kv=kv, epoch=1, supervised=False)
+    assert kv.dir_get(new._hb_prefix()) == []
+    assert old._hb_prefix() != new._hb_prefix()
+
+
+# ======================================================================
+# rank-scoped fault clauses
+# ======================================================================
+def test_rank_scoped_clause_only_fires_on_target_rank():
+    plan = FaultPlan.parse(
+        "rank_crash@3:1;rank_hang@4:0;corrupt_shard@2:1;"
+        "crash_after_stage@2:0")
+    assert [f.kind for f in plan.faults] == [
+        "rank_crash", "rank_hang", "corrupt_shard", "crash_after_stage"]
+    # a clause targeting rank 1 is invisible to rank 0 — and stays
+    # unfired for rank 1's process to consume
+    assert plan.fire("rank_crash", 3, rank=0) is None
+    assert plan.unfired() == 4
+    assert plan.fire("rank_crash", 3, rank=1) is not None
+    assert plan.unfired() == 3
+
+
+def test_epoch0_fault_plan_gating(monkeypatch):
+    monkeypatch.setenv("FF_FAULT_PLAN_EPOCH0", "rank_crash@3:1")
+    monkeypatch.delenv("FF_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("FF_WORLD_EPOCH", raising=False)
+    assert len(FaultPlan.from_env().faults) == 1  # epoch 0: armed
+    monkeypatch.setenv("FF_WORLD_EPOCH", "1")
+    assert FaultPlan.from_env().faults == []  # relaunched world: inert
+
+
+# ======================================================================
+# shrink policy arithmetic
+# ======================================================================
+def test_shrunken_world_size_respects_batch_divisibility():
+    assert shrunken_world_size(3, 8) == 2   # 8 % 3 != 0 -> drop to 2
+    assert shrunken_world_size(4, 8) == 4
+    assert shrunken_world_size(3, 8, devices_per_rank=2) == 2  # 8 % 4
+    assert shrunken_world_size(2, 0) == 2   # unknown batch: any size
+    assert shrunken_world_size(0, 8) == 1   # floor at 1
+
+
+# ======================================================================
+# WorldSupervisor policy (scripted subprocess workers)
+# ======================================================================
+def _ws(body, nprocs=2, **kw):
+    """A WorldSupervisor over ``python -c`` workers; ``body`` sees
+    rank/epoch as argv[1]/argv[2]."""
+    kw.setdefault("world_timeout_s", 60.0)
+    kw.setdefault("poll_interval_s", 0.02)
+    return WorldSupervisor(
+        [sys.executable, "-c", body, "{rank}", "{epoch}"],
+        nprocs=nprocs, **kw)
+
+
+def test_world_supervisor_relaunches_within_budget():
+    # rank 1 hard-dies in epoch 0 only; the relaunch must succeed
+    ws = _ws("import sys; sys.exit(13 if sys.argv[1:3] == ['1', '0'] "
+             "else 0)", max_world_restarts=1)
+    records = ws.run()
+    assert ws.world_restarts == 1 and ws.shrinks == 0
+    assert ws.epoch == 1 and ws.nprocs == 2
+    assert [r["rc"] for r in records] == [0, 0]
+    assert ws.report[0]["rcs"].count(13) == 1
+
+
+def test_world_supervisor_shrinks_when_budget_exhausted():
+    # rank 1 always dies: relaunch is pointless, the world must shrink
+    # to the largest batch-divisible survivor count (2 -> 1)
+    ws = _ws("import sys; sys.exit(13 if sys.argv[1] == '1' else 0)",
+             max_world_restarts=0, policy="shrink", batch_size=8)
+    records = ws.run()
+    assert ws.shrinks == 1 and ws.nprocs == 1
+    assert [r["rc"] for r in records] == [0]
+    assert status.snapshot()["elastic_replans"] >= 1
+
+
+def test_world_supervisor_reaps_hung_rank_on_detector_exit():
+    # rank 1 wedges forever; rank 0 detects and exits the detector code.
+    # The supervisor must SIGKILL the hung rank (never wait the full
+    # world timeout), attribute it, and shrink past it.
+    body = ("import sys, time\n"
+            "r, e = sys.argv[1:3]\n"
+            "if e == '0':\n"
+            "    time.sleep(600) if r == '1' else sys.exit(%d)\n"
+            "sys.exit(0)" % EXIT_RANK_FAILURE)
+    ws = _ws(body, max_world_restarts=0, policy="shrink", batch_size=8,
+             world_timeout_s=120.0)
+    t0 = time.monotonic()
+    ws.run()
+    assert time.monotonic() - t0 < 60.0  # no unbounded wait
+    assert ws.shrinks == 1 and ws.nprocs == 1
+    # the wedged rank was attributed from the detector's exit
+    assert 13 not in ws.report[0]["rcs"]
+    assert EXIT_RANK_FAILURE in ws.report[0]["rcs"]
+
+
+def test_world_supervisor_gives_up_with_report():
+    ws = _ws("import sys; sys.exit(13)", max_world_restarts=1,
+             policy="relaunch")
+    with pytest.raises(WorldFailure) as ei:
+        ws.run()
+    assert len(ei.value.report) == 2  # epoch 0 + the failed relaunch
+    assert all(13 in rec["rcs"] for rec in ei.value.report)

@@ -19,3 +19,46 @@ def test_two_process_dp_training():
          if tok.startswith("loss1=")]
     assert len(a) == 2
     assert np.isfinite(a).all()
+
+
+def test_is_initialized_survives_jax_api_drift(monkeypatch):
+    """Satellite: ``is_initialized`` asks the public
+    ``jax.distributed.is_initialized`` first, then the private
+    ``jax._src.distributed`` global state — a jax upgrade that drops or
+    breaks either must degrade to the ``_initialized_here`` flag
+    (correct for every world WE joined) instead of silently reporting
+    single-process."""
+    import jax
+    import jax._src
+
+    from flexflow_tpu.parallel import distributed as dist
+
+    assert not dist.is_initialized()  # the test process: no world
+    assert dist.client() is None
+
+    # the public API's verdict is trusted without touching privates
+    monkeypatch.setattr(jax.distributed, "is_initialized",
+                        lambda: True, raising=False)
+    assert dist.is_initialized()
+
+    class _Drifted:  # no global_state / no client attribute
+        pass
+
+    # public API raises (signature drift), private module reshaped:
+    # fall through to the flag rather than crash or lie
+    monkeypatch.setattr(jax.distributed, "is_initialized",
+                        lambda: (_ for _ in ()).throw(TypeError()),
+                        raising=False)
+    monkeypatch.setattr(jax._src, "distributed", _Drifted())
+    monkeypatch.setattr(dist, "_initialized_here", False)
+    assert not dist.is_initialized()
+    monkeypatch.setattr(dist, "_initialized_here", True)
+    assert dist.is_initialized()  # worlds WE joined stay visible
+
+    # public API absent entirely (pre-addition jax): same degradation
+    monkeypatch.setattr(jax.distributed, "is_initialized", None,
+                        raising=False)
+    assert dist.is_initialized()
+    monkeypatch.setattr(dist, "_initialized_here", False)
+    assert not dist.is_initialized()
+    assert dist.client() is None  # private drift degrades to None

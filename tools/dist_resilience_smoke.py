@@ -1,0 +1,126 @@
+"""CI multi-host resilience smoke (ci.sh fast tier, ISSUE 7).
+
+Launcher mode (default): a :class:`WorldSupervisor` drives a 2-process
+CPU world training a tiny MLP under per-process Supervisors with
+per-step multi-host checkpoints. ``FF_FAULT_PLAN_EPOCH0`` injects
+``rank_crash@3:1`` — rank 1 hard-dies (``os._exit``, no cleanup)
+before global step 3 in world epoch 0. The world must notice (bounded
+heartbeat/barrier timeouts, never a hang), re-form at epoch 1
+(relaunch under the restart budget — or shrink when exhausted), resume
+from the last committed two-phase checkpoint, and finish with a finite
+loss on every rank. Exit code 0 = the cross-process recovery path
+works end-to-end.
+
+Worker mode (``--worker``; world env injected by the WorldSupervisor):
+one controller of the world. Env knobs: ``FF_SMOKE_CKPT_DIR`` (shared
+checkpoint dir), ``FF_LOCAL_DEVICES`` (default 1), ``FF_SMOKE_POLICY``.
+
+Bounded: tight heartbeat (0.1s) / failure (3s) / barrier (20s)
+timeouts and a 240s world timeout keep the whole smoke well inside the
+fast tier's budget (typically ~60s).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if "--worker" in sys.argv:
+    # worker env setup must precede any jax import
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                               + os.environ.get("FF_LOCAL_DEVICES", "1"))
+
+
+def worker() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.resilience import Supervisor, run_world_member
+
+    def train():
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        cfg.only_data_parallel = True
+        cfg.heartbeat_interval_s = 0.1
+        ff = FFModel(cfg)
+        x = ff.create_tensor((cfg.batch_size, 16), name="x")
+        t = ff.dense(x, 32, activation=ActiMode.AC_MODE_RELU)
+        ff.softmax(ff.dense(t, 4))
+        ff.compile(SGDOptimizer(lr=0.1),
+                   "sparse_categorical_crossentropy", [])
+        rng = np.random.default_rng(0)  # same data on every rank
+        xs = rng.normal(size=(48, 16)).astype(np.float32)
+        ys = rng.integers(0, 4, size=48).astype(np.int32)
+        sup = Supervisor(ff, os.environ["FF_SMOKE_CKPT_DIR"],
+                         checkpoint_every=1)
+        # the committed step this incarnation resumes from (-1 = fresh
+        # world): lets the launcher/test prove the relaunched epoch
+        # really resumed instead of silently retraining from scratch
+        from flexflow_tpu.runtime.checkpoint import CheckpointManager
+        start = CheckpointManager(
+            os.environ["FF_SMOKE_CKPT_DIR"]).latest_step()
+        hist = sup.run(x=xs, y=ys, epochs=2, shuffle=False)
+        loss = hist[-1]["loss"]
+        assert np.isfinite(loss), f"non-finite final loss {loss}"
+        print(f"SMOKE_OK rank={jax.process_index()} "
+              f"epoch={os.environ.get('FF_WORLD_EPOCH', '0')} "
+              f"world={jax.process_count()} "
+              f"start={-1 if start is None else start} "
+              f"loss={loss:.6f}", flush=True)
+
+    run_world_member(train)
+
+
+def launch() -> None:
+    import tempfile
+
+    from flexflow_tpu.resilience import WorldSupervisor
+
+    ckpt = tempfile.mkdtemp(prefix="ff_dist_smoke_")
+    policy = os.environ.get("FF_SMOKE_POLICY", "auto")
+    env = {
+        "FF_SMOKE_CKPT_DIR": ckpt,
+        "FF_FAULT_PLAN_EPOCH0": os.environ.get(
+            "FF_FAULT_PLAN_EPOCH0", "rank_crash@3:1"),
+        "FF_HB_INTERVAL_S": "0.1",
+        "FF_HB_TIMEOUT_S": "3",
+        "FF_BARRIER_TIMEOUT_S": "20",
+        "FF_LOCAL_DEVICES": "1",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    }
+    env.pop("JAX_PLATFORMS", None)
+    ws = WorldSupervisor(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        nprocs=2, max_world_restarts=1, policy=policy,
+        batch_size=8, devices_per_rank=1, world_timeout_s=240.0,
+        env=env)
+    records = ws.run()
+    assert ws.world_restarts + ws.shrinks >= 1, \
+        "fault injected but the world never needed re-forming"
+    losses = []
+    for rec in records:
+        toks = [t for ln in rec["out"].splitlines()
+                if ln.startswith("SMOKE_OK") for t in ln.split()
+                if t.startswith("loss=")]
+        assert toks, f"rank {rec['rank']} printed no SMOKE_OK:\n" \
+            f"{rec['out'][-800:]}\n{rec['err'][-800:]}"
+        losses.append(float(toks[-1].split("=")[1]))
+    assert len(set(losses)) == 1, f"final losses disagree: {losses}"
+    import shutil
+    shutil.rmtree(ckpt, ignore_errors=True)
+    print(f"dist resilience smoke OK: {len(ws.report)} world epoch(s) "
+          f"{ws.report}, {ws.world_restarts} relaunch(es), "
+          f"{ws.shrinks} shrink(s), final world {ws.nprocs} proc(s), "
+          f"loss {losses[0]:.6f}")
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        launch()

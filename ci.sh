@@ -19,6 +19,12 @@ fi
 
 case "${1:-fast}" in
   fast)
+    # static analysis gate (docs/static_analysis.md): the framework-
+    # invariant linter must be clean over the whole package, and every
+    # checked-in strategy artifact must pass the static plan verifier —
+    # an unsound plan or an invariant regression fails the push before
+    # a single test runs
+    python tools/ffcheck.py --lint flexflow_tpu/ --verify-strategies
     python -m pytest tests/ -x -q
     # tier-1 smoke under FF_TRACE=1: the default run above exercises the
     # disabled (near-zero-cost) telemetry paths; this pass exercises the

@@ -1,0 +1,26 @@
+"""Static analysis: plan verification + framework-invariant linting.
+
+Two engines behind one CLI (``tools/ffcheck.py``) and one library API:
+
+  - :mod:`flexflow_tpu.analysis.plan_verifier` — proves a searched
+    strategy/PCG executable on a machine model BEFORE a device runs it:
+    mesh-axis soundness and shard divisibility for every op, a legal
+    ``reshard.ReshardPlanner`` lowering for every layout seam, a static
+    per-device peak-memory envelope, and SPMD collective-ordering
+    consistency (deadlock freedom). Wired into ``FFModel.compile``
+    post-search; failures raise :class:`PlanVerificationError` with
+    op/seam attribution.
+  - :mod:`flexflow_tpu.analysis.lint` — AST rules for the hard
+    invariants PRs 4–7 established (no implicit host sync in the
+    dispatch window, ``-O``-safe typed errors instead of ``assert``,
+    every cross-rank/thread wait bounded, no wall-clock reads inside
+    jitted fns), with a ``# ffcheck: ok(<rule>)`` suppression pragma.
+
+Both run in ``ci.sh``'s fast tier as a hard gate. See
+``docs/static_analysis.md``.
+"""
+from .lint import LintFinding, lint_file, lint_paths  # noqa: F401
+from .plan_verifier import (Finding, PlanReport,  # noqa: F401
+                            PlanVerificationError, StructMesh,
+                            verify_model, verify_plan,
+                            verify_strategy_file)

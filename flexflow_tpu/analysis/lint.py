@@ -1,0 +1,472 @@
+"""AST-based framework-invariant linter (the ``ffcheck`` lint engine).
+
+PRs 4–7 accumulated hard invariants that nothing enforced until now;
+each is a rule here, checked statically over the package source:
+
+  ``host-sync``
+      No implicit host synchronization in the async-dispatch hot path:
+      ``float()`` / ``bool()`` on values, ``np.asarray`` / ``np.array``,
+      ``.item()``, and ``jax.device_get`` inside ``executor.py`` or the
+      per-step ``runtime/`` modules (:data:`HOST_SYNC_MODULES`) outside
+      designated flush points (:data:`FLUSH_FUNCS`). One stray
+      conversion re-serializes the dispatch window PR 4 opened.
+  ``bare-assert``
+      No ``assert`` in runtime-reachable modules: ``python -O`` strips
+      asserts, so input/precondition checks must be typed errors
+      (``ValueError``/``RuntimeError``) — the repo-wide extension of
+      PR 5's ``session.infer`` fix.
+  ``raw-wait``
+      No unbounded thread/queue waits in serving/resilience/checkpoint
+      threads (:data:`WAIT_MODULES`): ``.join()`` / ``.wait()`` /
+      ``.get()`` with no timeout can wedge a drain, a supervisor, or an
+      exit path forever. Every wait passes a bound.
+  ``raw-rank-wait``
+      No raw cross-rank waits outside ``resilience/coord.py``: the jax
+      distributed client's ``wait_at_barrier`` /
+      ``blocking_key_value_get`` hang forever when a peer dies —
+      ``coord.Coordinator`` wraps them with heartbeat-attributed
+      timeouts (PR 7), and every call site must route through it.
+  ``time-in-jit``
+      No wall-clock reads (``time.time()`` etc.) inside functions that
+      are ``jax.jit``-ed: the call executes once at trace time and
+      bakes a constant into the executable.
+
+Suppression: a trailing (or immediately preceding) comment
+``# ffcheck: ok(<rule>)`` — comma-separate several rules, or bare
+``# ffcheck: ok`` for all — silences a line, visibly and greppably.
+
+Reporters: :func:`render_text` / :func:`render_json`. The CLI front end
+is ``tools/ffcheck.py``; ``ci.sh``'s fast tier runs it as a hard gate.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["RULES", "LintFinding", "lint_file", "lint_paths",
+           "render_text", "render_json"]
+
+RULES: Dict[str, str] = {
+    "host-sync": "implicit host synchronization in a hot path",
+    "bare-assert": "bare assert in runtime-reachable code (-O strips it)",
+    "raw-wait": "unbounded thread/queue wait",
+    "raw-rank-wait": "cross-rank wait not routed through coord.py",
+    "time-in-jit": "wall-clock read inside a jitted function",
+    # always reported (never filtered by --rules): a file that does not
+    # parse cannot be checked for ANY rule
+    "parse-error": "file does not parse",
+}
+
+#: hot-path modules for ``host-sync`` — the files on the per-step
+#: dispatch path. ``runtime/checkpoint.py`` is deliberately absent:
+#: checkpoint saves are flush points by design (PR 4 flushes + screens
+#: the metrics buffer before every save).
+HOST_SYNC_MODULES: Tuple[str, ...] = (
+    "executor.py", "runtime/metrics_buffer.py", "runtime/dataloader.py",
+    "runtime/metrics.py", "runtime/optimizers.py", "runtime/losses.py",
+    "runtime/zero.py",
+)
+
+#: function names that ARE flush points: conversions inside them happen
+#: on already-fetched host values (or are the one designated fetch).
+#: NOTE: deliberately NOT "update" — Optimizer.update in
+#: runtime/optimizers.py is the hottest jitted code the rule scopes;
+#: PerfMetrics.update (the flush-side fold) is exempted per-module below
+FLUSH_FUNCS: Set[str] = {"flush", "report", "state_dict",
+                         "load_state_dict", "summary", "snapshot"}
+
+#: per-module additions to FLUSH_FUNCS (matched by path suffix)
+MODULE_FLUSH_FUNCS: Dict[str, Set[str]] = {
+    # PerfMetrics.update folds ALREADY-FETCHED host values (called from
+    # MetricsBuffer.flush) — a flush point by design
+    "runtime/metrics.py": {"update"},
+}
+
+#: calls whose result is host data by construction — float()/bool() of
+#: these never syncs the device (config reads, sizes, clocks)
+_SAFE_CALL_NAMES = {"getattr", "len", "min", "max", "round", "abs",
+                    "int", "float", "str", "repr", "sum"}
+_SAFE_CALL_CHAINS = ("os.environ", "time.", "math.")
+
+#: modules whose threads must never wait unbounded (``raw-wait``)
+WAIT_MODULES: Tuple[str, ...] = ("/serving/", "/resilience/",
+                                 "runtime/checkpoint.py")
+
+#: keyword names that count as a bound on a wait call
+_TIMEOUT_KWARGS = {"timeout", "timeout_s", "timeout_ms", "deadline_s",
+                   "deadline"}
+
+#: the jax distributed client's raw blocking primitives (``raw-rank-wait``)
+_RANK_WAIT_ATTRS = {"wait_at_barrier", "blocking_key_value_get"}
+
+#: wall-clock reads that must not appear inside jitted fns
+_CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "process_time"}
+
+_PRAGMA_RE = re.compile(r"#\s*ffcheck:\s*ok(?:\(([^)]*)\))?")
+
+
+@dataclasses.dataclass
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}")
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def _pragmas(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line number -> suppressed rule set (None = all rules)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None or not m.group(1).strip():
+            out[i] = None
+        else:
+            out[i] = {r.strip() for r in m.group(1).split(",")
+                      if r.strip()}
+    return out
+
+
+def _suppressed(pragmas, rule: str, line: int) -> bool:
+    for ln in (line, line - 1):
+        rules = pragmas.get(ln, "missing")
+        if rules != "missing" and (rules is None or rule in rules):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# per-rule AST checks
+# ---------------------------------------------------------------------------
+
+def _attr_chain(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _Scope(ast.NodeVisitor):
+    """Shared walk that tracks the enclosing function-name stack."""
+
+    def __init__(self):
+        self.func_stack: List[str] = []
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _HostSyncVisitor(_Scope):
+    def __init__(self, add, flush_funcs: Set[str]):
+        super().__init__()
+        self.add = add
+        self.flush_funcs = flush_funcs
+
+    def _in_flush(self) -> bool:
+        return any(f in self.flush_funcs for f in self.func_stack)
+
+    @staticmethod
+    def _host_safe_arg(arg: ast.AST) -> bool:
+        """Arguments that cannot hold a device value: literals, and
+        calls to host-only producers (getattr/len/os.environ/...)."""
+        if isinstance(arg, ast.Constant):
+            return True
+        if isinstance(arg, ast.Call):
+            fn = arg.func
+            if isinstance(fn, ast.Name):
+                return fn.id in _SAFE_CALL_NAMES
+            if isinstance(fn, ast.Attribute):
+                chain = _attr_chain(fn)
+                return any(chain.startswith(c)
+                           for c in _SAFE_CALL_CHAINS)
+        return False
+
+    def visit_Call(self, node: ast.Call):
+        if not self._in_flush():
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in ("float", "bool") \
+                    and len(node.args) == 1 and not node.keywords \
+                    and not self._host_safe_arg(node.args[0]):
+                self.add(node, f"{fn.id}() on a value in a hot path "
+                               f"forces a device sync; keep metrics "
+                               f"device-resident and convert at a flush "
+                               f"point (runtime/metrics_buffer.py)")
+            elif isinstance(fn, ast.Attribute):
+                chain = _attr_chain(fn)
+                if chain in ("np.asarray", "np.array", "numpy.asarray",
+                             "numpy.array"):
+                    self.add(node, f"{chain}() on a traced/device value "
+                                   f"in a hot path is an implicit host "
+                                   f"sync; use jnp, or fetch at a flush "
+                                   f"point")
+                elif chain.endswith("jax.device_get") \
+                        or chain == "jax.device_get":
+                    self.add(node, "jax.device_get outside a flush "
+                                   "point re-serializes the dispatch "
+                                   "window")
+                elif fn.attr == "item" and not node.args \
+                        and not node.keywords:
+                    self.add(node, ".item() is an implicit host sync; "
+                                   "fetch at a flush point instead")
+        self.generic_visit(node)
+
+
+class _AssertVisitor(ast.NodeVisitor):
+    def __init__(self, add):
+        self.add = add
+
+    def visit_Assert(self, node: ast.Assert):
+        self.add(node, "bare assert is stripped under python -O; raise "
+                       "a typed ValueError/RuntimeError instead")
+        self.generic_visit(node)
+
+
+class _WaitVisitor(ast.NodeVisitor):
+    def __init__(self, add):
+        self.add = add
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            kw = {k.arg for k in node.keywords if k.arg}
+            bounded = bool(node.args) or (kw & _TIMEOUT_KWARGS)
+            if fn.attr in ("join", "wait") and not bounded:
+                self.add(node, f".{fn.attr}() without a timeout can "
+                               f"wedge this thread forever; pass a "
+                               f"bound (and handle expiry)")
+            elif fn.attr == "get" and self._queue_like(fn.value) \
+                    and not self._get_bounded(node, kw):
+                self.add(node, ".get() without a timeout blocks "
+                               "forever on an empty queue; pass "
+                               "timeout= (or block=False) and handle "
+                               "queue.Empty")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _get_bounded(node: ast.Call, kw: set) -> bool:
+        """``queue.get`` blocks forever unless a timeout is passed
+        (second positional or keyword) or block is literally False —
+        ``get(True)`` / ``get(block=True)`` are still unbounded."""
+        if (kw & _TIMEOUT_KWARGS) or len(node.args) >= 2:
+            return True
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value is False:
+            return True
+        return any(k.arg == "block"
+                   and isinstance(k.value, ast.Constant)
+                   and k.value.value is False
+                   for k in node.keywords)
+
+    @staticmethod
+    def _queue_like(recv: ast.AST) -> bool:
+        """Receiver looks like a queue (``self._q``, ``in_queue`` ...)
+        — dict/module ``.get()`` (which needs a key anyway) stays out."""
+        name = recv.attr if isinstance(recv, ast.Attribute) \
+            else recv.id if isinstance(recv, ast.Name) else ""
+        name = name.lower()
+        return name in ("q", "queue") or name.endswith(("_q", "queue"))
+
+
+class _RankWaitVisitor(ast.NodeVisitor):
+    def __init__(self, add):
+        self.add = add
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _RANK_WAIT_ATTRS:
+            self.add(node, f"raw {fn.attr}() hangs forever when a peer "
+                           f"rank dies; route the wait through "
+                           f"resilience.coord (bounded, heartbeat-"
+                           f"attributed)")
+        self.generic_visit(node)
+
+
+def _jitted_function_names(tree: ast.AST) -> Set[str]:
+    """Names of functions this module jits: ``jax.jit(f)`` / ``jit(f)``
+    call sites plus ``@jax.jit`` / ``@partial(jax.jit, ...)``
+    decorators."""
+    jitted: Set[str] = set()
+
+    def is_jit(fn: ast.AST) -> bool:
+        if isinstance(fn, ast.Name):
+            return fn.id == "jit"
+        if isinstance(fn, ast.Attribute):
+            return _attr_chain(fn).endswith("jax.jit") \
+                or fn.attr == "jit"
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and is_jit(node.func):
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    jitted.add(a.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit(dec):
+                    jitted.add(node.name)
+                elif isinstance(dec, ast.Call) and (
+                        is_jit(dec.func)
+                        or any(is_jit(a) for a in dec.args)):
+                    jitted.add(node.name)
+    return jitted
+
+
+def _check_time_in_jit(tree: ast.AST, add) -> None:
+    jitted = _jitted_function_names(tree)
+    if not jitted:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or node.name not in jitted:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute):
+                chain = _attr_chain(sub.func)
+                if chain in {f"time.{a}" for a in _CLOCK_ATTRS} \
+                        or chain == "datetime.datetime.now":
+                    add(sub, f"{chain}() inside jitted fn "
+                             f"{node.name!r} executes once at trace "
+                             f"time and bakes a constant into the "
+                             f"executable; time on the host, outside "
+                             f"the jit")
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def _component_suffix(norm: str, m: str) -> bool:
+    """Path-component-anchored suffix match: ``executor.py`` matches
+    ``flexflow_tpu/executor.py`` but NOT ``serving/batch_executor.py``,
+    and works for package-root-relative paths too."""
+    return norm == m or norm.endswith("/" + m)
+
+
+def _host_sync_scope(norm: str) -> bool:
+    return any(_component_suffix(norm, m) for m in HOST_SYNC_MODULES)
+
+
+def _wait_scope(norm: str) -> bool:
+    anchored = "/" + norm
+    for m in WAIT_MODULES:
+        if m.startswith("/"):
+            if m in anchored:
+                return True
+        elif _component_suffix(norm, m):
+            return True
+    return False
+
+
+def lint_file(path: str, source: Optional[str] = None,
+              rules: Optional[Iterable[str]] = None) -> List[LintFinding]:
+    """Lint one file; returns findings (pragma-suppressed ones removed).
+    ``rules`` restricts the rule set (default: all)."""
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [LintFinding("parse-error", path, e.lineno or 0, 0,
+                            f"file does not parse: {e.msg}")]
+    active = set(rules) if rules is not None else set(RULES)
+    norm = _norm(path)
+    lines = source.splitlines()
+    pragmas = _pragmas(source)
+    findings: List[LintFinding] = []
+
+    def adder(rule: str):
+        def add(node: ast.AST, message: str) -> None:
+            line = getattr(node, "lineno", 0)
+            if _suppressed(pragmas, rule, line):
+                return
+            snippet = lines[line - 1].strip() \
+                if 0 < line <= len(lines) else ""
+            findings.append(LintFinding(
+                rule, path, line, getattr(node, "col_offset", 0),
+                message, snippet))
+        return add
+
+    if "bare-assert" in active and "/tests/" not in "/" + norm \
+            and not os.path.basename(norm).startswith("test_"):
+        _AssertVisitor(adder("bare-assert")).visit(tree)
+    if "host-sync" in active and _host_sync_scope(norm):
+        flush = set(FLUSH_FUNCS)
+        for suffix, extra in MODULE_FLUSH_FUNCS.items():
+            if _component_suffix(norm, suffix):
+                flush |= extra
+        _HostSyncVisitor(adder("host-sync"), flush).visit(tree)
+    if "raw-wait" in active and _wait_scope(norm):
+        _WaitVisitor(adder("raw-wait")).visit(tree)
+    if "raw-rank-wait" in active \
+            and not norm.endswith("resilience/coord.py"):
+        _RankWaitVisitor(adder("raw-rank-wait")).visit(tree)
+    if "time-in-jit" in active:
+        _check_time_in_jit(tree, adder("time-in-jit"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings
+
+
+def lint_paths(paths: Sequence[str],
+               rules: Optional[Iterable[str]] = None
+               ) -> List[LintFinding]:
+    """Lint files and directory trees (``tests``/``__pycache__`` dirs
+    and ``test_*.py`` files are skipped)."""
+    findings: List[LintFinding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", "tests",
+                                              ".git"))
+                for fn in sorted(files):
+                    if fn.endswith(".py") and not fn.startswith("test_"):
+                        findings.extend(
+                            lint_file(os.path.join(root, fn),
+                                      rules=rules))
+        else:
+            findings.extend(lint_file(p, rules=rules))
+    return findings
+
+
+def render_text(findings: Sequence[LintFinding]) -> str:
+    if not findings:
+        return "ffcheck: clean"
+    out = [f.format() for f in findings]
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    out.append("ffcheck: " + ", ".join(
+        f"{n} x {r}" for r, n in sorted(by_rule.items())))
+    return "\n".join(out)
+
+
+def render_json(findings: Sequence[LintFinding]) -> str:
+    return json.dumps({"findings": [f.to_json() for f in findings],
+                       "count": len(findings)}, indent=1)

@@ -1,0 +1,817 @@
+"""Static plan verifier: prove a searched strategy executable, pre-device.
+
+FlexFlow's simulator *scores* strategies but never proves them runnable —
+this repo learned that twice (PR 6's GSPMD 4x-values and NaN-transition
+miscompiles, both shipped by a search that was happy with the plan).
+Following the legality conditions of portable-collective redistribution
+(PAPERS.md, arXiv 2112.01075), this module checks a (strategy, layers,
+machine) triple statically, at compile time, before a device ever runs
+a step:
+
+  1. **op-shard** — every op output / weight / graph-input
+     PartitionSpec is mesh-axis sound (axes exist, no axis reused
+     within a spec, spec rank fits the tensor rank) and every sharded
+     dim is divisible by its axes' product (an indivisible shard is
+     exactly the layout GSPMD falls back to generic padding/resharding
+     on — the miscompile class the planner exists to bypass);
+  2. **seam** — every layout seam lowers to a legal
+     :class:`~flexflow_tpu.parallel.reshard.ReshardPlanner` plan:
+     layout-op output constraints, bank stack/rejoin boundaries,
+     pipeline-region entry/exit, and checkpoint-restore placement
+     (``reshard.place_host``). A seam whose plan comes back
+     ``kind="constraint"`` would fall back to GSPMD's generic
+     resharding at runtime — flagged as an error with the op/seam
+     attributed;
+  3. **memory** — a conservative static per-device peak-memory envelope
+     (params + grads + optimizer slots + peak activation pair + the
+     largest planned reshard transient) against the machine model's HBM
+     (or ``--device-mem-mb``);
+  4. **collective-order** — SPMD deadlock freedom: all ranks must issue
+     the same collective sequence. Full-mesh constraints and planned
+     shard_map seams are order-consistent by construction; the
+     structures that can diverge — bank members, place-group branches
+     (MPMD-inside-SPMD ``lax.switch``), ragged-pipeline prologue/
+     epilogue (``lax.cond`` on the stage index) — must not contain
+     collective ops, and subset axes must not collide with the pipeline
+     axes (the banks×pipeline double transition, PR 6's NaN bug).
+
+``FFModel.compile`` runs this post-search (``FFConfig.plan_verify``,
+``FF_PLAN_VERIFY=0`` to disable); failures raise
+:class:`PlanVerificationError` naming the offending op/seam, findings
+are appended to the strategy audit record, and every run bumps the
+``ff_plan_verify_*`` counters under a ``plan_verify.run`` span.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import events as obs_events
+from ..obs.metrics_registry import REGISTRY
+
+__all__ = ["Finding", "PlanReport", "PlanVerificationError",
+           "StructMesh", "verify_plan", "verify_model",
+           "verify_strategy_file"]
+
+
+# ---------------------------------------------------------------------------
+# findings + report
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Finding:
+    """One verification finding, attributed to an op and (optionally) a
+    seam. ``check`` is the engine that produced it (op-shard / seam /
+    memory / collective-order), ``severity`` "error" or "warn"."""
+    check: str
+    severity: str
+    op: str
+    message: str
+    seam: Optional[str] = None
+
+    def format(self) -> str:
+        where = f"{self.op}" + (f" @ {self.seam}" if self.seam else "")
+        return f"[{self.check}] {where}: {self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class PlanVerificationError(ValueError):
+    """A strategy failed static verification. ``findings`` carries the
+    error-severity findings, each attributed to an op/seam."""
+
+    def __init__(self, findings: Sequence[Finding], context: str = ""):
+        self.findings = [f for f in findings if f.severity == "error"]
+        lines = [f.format() for f in self.findings]
+        head = f"plan verification failed ({len(lines)} error(s))"
+        if context:
+            head += f" for {context}"
+        super().__init__(head + ":\n  " + "\n  ".join(lines))
+
+
+@dataclasses.dataclass
+class PlanReport:
+    """The result of one verification pass: findings plus the derived
+    artifacts (memory breakdown, static collective schedule)."""
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    memory: Dict[str, float] = dataclasses.field(default_factory=dict)
+    collectives: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    duration_s: float = 0.0
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warn"]
+
+    def ok(self) -> bool:
+        return not self.errors
+
+    def add(self, check: str, severity: str, op: str, message: str,
+            seam: Optional[str] = None) -> None:
+        self.findings.append(Finding(check, severity, op, message, seam))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"findings": [f.to_json() for f in self.findings],
+                "memory": dict(self.memory),
+                "collectives": list(self.collectives),
+                "duration_s": self.duration_s,
+                "ok": self.ok()}
+
+    def raise_if_failed(self, context: str = "") -> None:
+        if not self.ok():
+            raise PlanVerificationError(self.findings, context)
+
+
+# ---------------------------------------------------------------------------
+# spec helpers (layout normalization itself lives in parallel.reshard)
+# ---------------------------------------------------------------------------
+
+def _spec_entries(spec) -> List[Tuple[str, ...]]:
+    """Per-entry mesh-axis tuples of a PartitionSpec (or its JSON form),
+    WITHOUT rank padding — used for rank/soundness checks."""
+    out: List[Tuple[str, ...]] = []
+    if spec is None:
+        return out
+    for e in tuple(spec):
+        if e is None:
+            out.append(())
+        elif isinstance(e, (tuple, list)):
+            out.append(tuple(e))
+        else:
+            out.append((e,))
+    return out
+
+
+def _check_spec(report: PlanReport, axis_sizes: Dict[str, int], op: str,
+                what: str, spec, shape: Optional[Sequence[int]],
+                seam: Optional[str] = None) -> None:
+    """Mesh-axis soundness + divisibility of one PartitionSpec against
+    one (possibly unknown) shape."""
+    entries = _spec_entries(spec)
+    if not entries:
+        return
+    seen: set = set()
+    for axes in entries:
+        for a in axes:
+            if a not in axis_sizes:
+                report.add("op-shard", "error", op,
+                           f"{what} spec {spec} names unknown mesh axis "
+                           f"{a!r} (mesh axes: {sorted(axis_sizes)})",
+                           seam)
+            elif a in seen:
+                report.add("op-shard", "error", op,
+                           f"{what} spec {spec} reuses mesh axis {a!r} "
+                           f"(an axis may shard at most one dim)", seam)
+            seen.add(a)
+    if shape is None:
+        return
+    if len(entries) > len(shape):
+        report.add("op-shard", "error", op,
+                   f"{what} spec {spec} has {len(entries)} entries for a "
+                   f"rank-{len(shape)} tensor of shape {tuple(shape)}",
+                   seam)
+        return
+    for d, axes in enumerate(entries):
+        deg = 1
+        for a in axes:
+            deg *= axis_sizes.get(a, 1)
+        if deg > 1 and shape[d] % deg != 0:
+            report.add("op-shard", "error", op,
+                       f"{what} dim {d} of shape {tuple(shape)} is not "
+                       f"divisible by its shard degree {deg} "
+                       f"(axes {axes}) — this layout only executes via "
+                       f"GSPMD's generic padded resharding", seam)
+
+
+def _spec_degree(spec, axis_sizes: Dict[str, int]) -> int:
+    deg = 1
+    for axes in _spec_entries(spec):
+        for a in axes:
+            deg *= axis_sizes.get(a, 1)
+    return deg
+
+
+def _opt_slots(optimizer) -> int:
+    """Optimizer-state leaves per parameter for the memory envelope:
+    Adam-family keeps two moments, momentum-SGD one, plain SGD none.
+    Unknown optimizers are costed at two (conservative)."""
+    if optimizer is None:
+        return 2
+    name = type(optimizer).__name__.lower()
+    if "adam" in name or "lamb" in name:
+        return 2
+    if "sgd" in name:
+        return 1 if getattr(optimizer, "momentum", 0.0) else 0
+    return 2
+
+
+# ---------------------------------------------------------------------------
+# the verifier
+# ---------------------------------------------------------------------------
+
+def verify_plan(strategy, layers: Sequence, *,
+                machine_spec=None,
+                graph_inputs: Sequence = (),
+                optimizer=None,
+                hbm_bytes: Optional[float] = None,
+                context: str = "") -> PlanReport:
+    """Statically verify one (strategy, layers, machine) triple.
+
+    ``strategy`` is a :class:`~flexflow_tpu.parallel.strategy.
+    ShardingStrategy` (or any object with ``.ops``/``.inputs``/
+    ``.banks``/``.place_groups``/``.pipeline`` and a ``.dmesh`` carrying
+    ``axis_sizes``); ``layers`` the executable layer list the specs are
+    keyed by (the rewritten program when the search rewrote the graph).
+    Returns a :class:`PlanReport`; call :meth:`PlanReport.
+    raise_if_failed` (what ``FFModel.compile`` does) to turn errors into
+    a typed :class:`PlanVerificationError`.
+    """
+    t0 = time.perf_counter()
+    report = PlanReport()
+    dmesh = getattr(strategy, "dmesh", None)
+    axis_sizes: Dict[str, int] = dict(getattr(dmesh, "axis_sizes", {}))
+    spec = machine_spec or getattr(dmesh, "spec", None)
+    by_name = {l.name: l for l in layers}
+
+    _check_op_shards(report, strategy, by_name, axis_sizes, graph_inputs)
+    reshard_peak = _check_seams(report, strategy, layers, by_name,
+                                axis_sizes, spec, graph_inputs)
+    _check_collective_order(report, strategy, layers, by_name, axis_sizes)
+    _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
+                  hbm_bytes, reshard_peak)
+
+    report.duration_s = time.perf_counter() - t0
+    REGISTRY.counter("ff_plan_verify_runs_total",
+                     "Static plan verification passes").inc()
+    for f in report.findings:
+        REGISTRY.counter("ff_plan_verify_findings_total",
+                         "Plan verification findings by check"
+                         ).inc(check=f.check)
+    if report.errors:
+        REGISTRY.counter("ff_plan_verify_errors_total",
+                         "Plan verifications that found errors").inc()
+    obs_events.record_span("plan_verify.run", t0, report.duration_s,
+                           findings=len(report.findings),
+                           errors=len(report.errors),
+                           context=context or "")
+    return report
+
+
+# -- check 1: per-op shard specs --------------------------------------------
+
+def _check_op_shards(report, strategy, by_name, axis_sizes,
+                     graph_inputs) -> None:
+    weight_shapes = {
+        name: {w.name: tuple(w.shape) for w in (l.weights or ())}
+        for name, l in by_name.items()}
+    for name, os_ in getattr(strategy, "ops", {}).items():
+        layer = by_name.get(name)
+        for i, sp in enumerate(getattr(os_, "outputs", ()) or ()):
+            if sp is None:
+                continue
+            shape = None
+            if layer is not None and i < len(layer.outputs):
+                shape = layer.outputs[i].shape
+            _check_spec(report, axis_sizes, name, f"output[{i}]", sp,
+                        shape)
+        for wname, sp in (getattr(os_, "weights", {}) or {}).items():
+            if sp is None:
+                continue
+            shape = weight_shapes.get(name, {}).get(wname)
+            _check_spec(report, axis_sizes, name, f"weight {wname!r}",
+                        sp, shape, seam="checkpoint-restore")
+    in_shapes = {t.name: tuple(t.shape) for t in graph_inputs}
+    for tname, sp in getattr(strategy, "inputs", {}).items():
+        _check_spec(report, axis_sizes, tname, "input", sp,
+                    in_shapes.get(tname))
+
+
+# -- check 2: layout seams --------------------------------------------------
+
+class StructMesh:
+    """Structural mesh stand-in: ``axis_sizes`` plus a machine spec —
+    everything the verifier, ``load_strategy``, and
+    ``ReshardPlanner.plan`` need, with no jax devices behind it. Used
+    by the CLI's strategy verification and the fixture tests."""
+
+    def __init__(self, axis_sizes: Dict[str, int], spec=None):
+        from ..parallel.machine import MachineSpec
+        self.axis_sizes = {str(k): int(v) for k, v in axis_sizes.items()}
+        self.spec = spec or MachineSpec(
+            num_devices=int(np.prod(list(self.axis_sizes.values())
+                                    or [1])),
+            generation="cpu-sim")
+
+
+def _seam_planner(strategy, spec, axis_sizes):
+    """A non-persisting planner over the strategy's mesh: seam probes
+    must not warm the executor's shared disk cache."""
+    from ..parallel.reshard import ReshardPlanner
+    return ReshardPlanner(StructMesh(axis_sizes, spec), persist=False)
+
+
+def _probe_seam(report, planner, op: str, seam: str, src, dst,
+                shape: Sequence[int], itemsize: int = 4) -> float:
+    """Plan one seam transition; error when the planner cannot lower it
+    (kind="constraint" = the GSPMD generic-resharding fallback — the
+    PR 6 miscompile class). Returns the plan's transient peak bytes."""
+    try:
+        plan = planner.plan(src, dst, tuple(shape), itemsize)
+    except Exception as e:  # noqa: BLE001 — surface, don't crash
+        report.add("seam", "error", op,
+                   f"planner failed to lower {src} -> {dst} on shape "
+                   f"{tuple(shape)}: {e}", seam)
+        return 0.0
+    if plan.kind == "constraint":
+        report.add(
+            "seam", "error", op,
+            f"transition {src} -> {dst} on shape {tuple(shape)} has no "
+            f"legal portable-collective lowering (indivisible shard) "
+            f"and would fall back to GSPMD generic resharding — the "
+            f"known miscompile class the reshard planner exists to "
+            f"bypass", seam)
+        return 0.0
+    report.collectives.append(
+        {"seam": seam, "op": op, "kind": plan.kind,
+         "steps": plan.describe()})
+    return float(plan.peak_bytes)
+
+
+def _check_seams(report, strategy, layers, by_name, axis_sizes, spec,
+                 graph_inputs) -> float:
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.reshard import (LAYOUT_OPS, _input_specs_replicated,
+                                    norm_spec)
+    planner = _seam_planner(strategy, spec, axis_sizes)
+    peak = 0.0
+    from ..dtypes import itemsize as _isz
+
+    # (a) layout-op output constraints (executor emit_layers →
+    #     reshard.constrain_output): replicated inputs + sharded output
+    #     spec on a reshape/concat/... is an explicit transition
+    for layer in layers:
+        if layer.op_type not in LAYOUT_OPS:
+            continue
+        os_ = getattr(strategy, "ops", {}).get(layer.name)
+        if os_ is None:
+            continue
+        for i, sp in enumerate(os_.outputs or ()):
+            if sp is None or i >= len(layer.outputs):
+                continue
+            shape = layer.outputs[i].shape
+            if not any(norm_spec(sp, len(shape))):
+                continue
+            if not _input_specs_replicated(strategy, layer):
+                continue
+            peak = max(peak, _probe_seam(
+                report, planner, layer.name, "layout-op-output",
+                P(), sp, shape, _isz(layer.outputs[i].dtype)))
+
+    # (b) bank boundaries (executor._emit_bank → banks.shard_stack /
+    #     rejoin_stack): the stacked member input moves onto the bank
+    #     layout (an axis move) and the output stack rejoins by an
+    #     explicit bank-dim gather
+    for bk in getattr(strategy, "banks", None) or ():
+        peak = max(peak, _check_bank(report, planner, strategy, bk,
+                                     by_name, axis_sizes, _isz))
+
+    # (c) pipeline-region entry/exit (pipeline_lowering.
+    #     region_entry_transition / region_exit_transition)
+    region = getattr(strategy, "pipeline", None)
+    if region is not None:
+        peak = max(peak, _check_pipeline_region(
+            report, planner, strategy, region, layers, axis_sizes,
+            graph_inputs))
+
+    # (d) checkpoint-restore placement (reshard.place_host): a sharded
+    #     weight restores shard-by-shard, which needs the same
+    #     divisibility the op-shard check proved — attribute any
+    #     sharded-but-indivisible weight to this seam (done in
+    #     _check_op_shards via seam="checkpoint-restore").
+    return peak
+
+
+def _check_bank(report, planner, strategy, bk, by_name, axis_sizes,
+                _isz) -> float:
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.reshard import norm_spec, tensor_spec
+    name = f"bank[{'+'.join(bk.members[:2])}{'...' if len(bk.members) > 2 else ''}]"
+    missing = [m for m in bk.members if m not in by_name]
+    if missing:
+        report.add("seam", "error", name,
+                   f"bank members {missing} are not in the program",
+                   "bank-boundary")
+        return 0.0
+    bad_axes = [a for a in bk.axes if a not in axis_sizes]
+    if bad_axes:
+        report.add("seam", "error", name,
+                   f"bank axes {bad_axes} are not mesh axes "
+                   f"(mesh: {sorted(axis_sizes)})", "bank-boundary")
+        return 0.0
+    B = 1
+    for a in bk.axes:
+        B *= axis_sizes[a]
+    K = len(bk.members)
+    if K % max(B, 1) != 0:
+        report.add("seam", "error", name,
+                   f"bank degree {B} (axes {tuple(bk.axes)}) does not "
+                   f"divide the member count {K}", "bank-boundary")
+        return 0.0
+    members = [by_name[m] for m in bk.members]
+    m0 = members[0]
+    if not m0.inputs or not m0.outputs:
+        return 0.0
+    bank_spec = bk.axes[0] if len(bk.axes) == 1 else tuple(bk.axes)
+    batch_spec = None
+    ish = m0.inputs[0].shape
+    if bk.batch_axes and ish:
+        bdeg = 1
+        for a in bk.batch_axes:
+            bdeg *= axis_sizes.get(a, 1)
+        if ish[0] % bdeg == 0:
+            batch_spec = (bk.batch_axes[0] if len(bk.batch_axes) == 1
+                          else tuple(bk.batch_axes))
+    stacked = (K,) + tuple(ish)
+    # entry: member-input layout lifted one dim right → bank layout
+    mem = norm_spec(tensor_spec(strategy, m0.inputs[0]), len(ish))
+    src = P(None, *[tuple(d) if d else None for d in mem])
+    dst = P(bank_spec, batch_spec, *([None] * (len(stacked) - 2)))
+    peak = _probe_seam(report, planner, name, "bank-stack", src, dst,
+                       stacked, _isz(m0.inputs[0].dtype))
+    # exit: gather ONLY the bank dim (banks.rejoin_stack)
+    osh = (K,) + tuple(m0.outputs[0].shape)
+    pad = [None] * (len(osh) - 2)
+    peak = max(peak, _probe_seam(
+        report, planner, name, "bank-rejoin",
+        P(bank_spec, batch_spec, *pad), P(None, batch_spec, *pad),
+        osh, _isz(m0.outputs[0].dtype)))
+    return peak
+
+
+def _find_tensor(layers, graph_inputs, guid):
+    for t in graph_inputs:
+        if t.guid == guid:
+            return t
+    for l in layers:
+        for t in l.outputs:
+            if t.guid == guid:
+                return t
+    return None
+
+
+def _check_pipeline_region(report, planner, strategy, region, layers,
+                           axis_sizes, graph_inputs) -> float:
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.reshard import norm_spec, tensor_spec
+    peak = 0.0
+    rname = f"pipeline[{region.n_stages} stages]"
+    pp = getattr(region, "pp_axis", None)
+    if pp is None or pp not in axis_sizes:
+        report.add("seam", "error", rname,
+                   f"pipeline pp_axis {pp!r} is not a mesh axis "
+                   f"(mesh: {sorted(axis_sizes)})", "pipeline-entry")
+        return peak
+    if axis_sizes[pp] != region.n_stages:
+        report.add("seam", "error", rname,
+                   f"pp axis {pp!r} has size {axis_sizes[pp]} but the "
+                   f"region has {region.n_stages} stages (one stage per "
+                   f"pipeline rank)", "pipeline-entry")
+    tp = getattr(region, "tp_axis", None)
+    if tp is not None and tp not in axis_sizes:
+        report.add("seam", "error", rname,
+                   f"pipeline tp_axis {tp!r} is not a mesh axis",
+                   "pipeline-entry")
+    if getattr(region, "n_chunks", 1) > 1 \
+            and region.n_microbatches % region.n_stages != 0:
+        report.add("seam", "error", rname,
+                   f"interleaved schedule needs M % S == 0, got "
+                   f"M={region.n_microbatches} S={region.n_stages}",
+                   "pipeline-entry")
+    # entry: sharded activation gathered to replicated before the
+    # microbatch reshape (region_entry_transition)
+    entry_t = _find_tensor(layers, graph_inputs, region.entry_guid)
+    if entry_t is not None and entry_t.shape:
+        B = entry_t.shape[0]
+        M = max(region.n_microbatches, 1)
+        if B % M != 0:
+            report.add("seam", "error", rname,
+                       f"batch {B} is not divisible into {M} "
+                       f"microbatches", "pipeline-entry")
+        src = tensor_spec(strategy, entry_t)
+        if src is not None and any(norm_spec(src, len(entry_t.shape))):
+            from ..dtypes import itemsize as _isz
+            peak = max(peak, _probe_seam(
+                report, planner, rname, "pipeline-entry", src, P(),
+                entry_t.shape, _isz(entry_t.dtype)))
+    # exit: the engine's (M, mb, ...) output gathered back to
+    # replicated (region_exit_transition) — dp-sharded on dim 1
+    exit_t = _find_tensor(layers, graph_inputs, region.exit_guid)
+    dp_axes = tuple(getattr(region, "dp_axes", ()) or ())
+    if exit_t is not None and exit_t.shape and dp_axes:
+        dp = dp_axes[0] if len(dp_axes) == 1 else tuple(dp_axes)
+        M = max(region.n_microbatches, 1)
+        B = exit_t.shape[0]
+        if B % M == 0:
+            ys_shape = (M, B // M) + tuple(exit_t.shape[1:])
+            xs_spec = P(None, dp, *([None] * (len(ys_shape) - 2)))
+            from ..dtypes import itemsize as _isz
+            peak = max(peak, _probe_seam(
+                report, planner, rname, "pipeline-exit", xs_spec, P(),
+                ys_shape, _isz(exit_t.dtype)))
+    return peak
+
+
+# -- check 3: memory envelope -----------------------------------------------
+
+def _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
+                  hbm_bytes, reshard_peak) -> None:
+    from ..dtypes import itemsize as _isz
+    if hbm_bytes is None:
+        hbm_bytes = getattr(spec, "hbm_bytes", None)
+    if not hbm_bytes:
+        return
+    ops = getattr(strategy, "ops", {})
+    bank_deg = {}
+    for bk in getattr(strategy, "banks", None) or ():
+        d = 1
+        for a in bk.axes:
+            d *= axis_sizes.get(a, 1)
+        for m in bk.members:
+            bank_deg[m] = max(d, 1)
+    from ..parallel.reshard import tensor_spec
+    params_local = 0.0
+    act_peak, act_op = 0.0, ""
+    for layer in layers:
+        os_ = ops.get(layer.name)
+        wspecs = getattr(os_, "weights", {}) if os_ is not None else {}
+        for w in layer.weights or ():
+            total = float(int(np.prod(w.shape)) or 1) * _isz(w.dtype)
+            deg = _spec_degree(wspecs.get(w.name), axis_sizes)
+            deg *= bank_deg.get(layer.name, 1)
+            params_local += total / max(deg, 1)
+        local = 0.0
+        for t in list(layer.inputs) + list(layer.outputs):
+            total = float(int(np.prod(t.shape)) or 1) * _isz(t.dtype)
+            # inputs resolve through their PRODUCER's assigned spec
+            # (tensor_spec) — counting them unsharded would inflate the
+            # envelope by the sharding degree and false-fail the gate
+            sp = tensor_spec(strategy, t)
+            local += total / max(_spec_degree(sp, axis_sizes), 1)
+        if local > act_peak:
+            act_peak, act_op = local, layer.name
+    slots = _opt_slots(optimizer)
+    # params + grads + optimizer slots, live fwd/bwd activation pair,
+    # plus the largest planned reshard transient — a conservative
+    # ENVELOPE (XLA's scheduler can only do better; rematerialization
+    # and fusion shrink the activation term, never grow it)
+    total = params_local * (2 + slots) + 2 * act_peak + reshard_peak
+    report.memory = {
+        "params_bytes": params_local,
+        "grads_bytes": params_local,
+        "opt_state_bytes": params_local * slots,
+        "peak_activation_bytes": act_peak,
+        "peak_activation_op": act_op,
+        "reshard_transient_bytes": reshard_peak,
+        "envelope_bytes": total,
+        "hbm_bytes": float(hbm_bytes),
+    }
+    if total > hbm_bytes:
+        report.add(
+            "memory", "error", act_op or "<model>",
+            f"static per-device envelope {total / 2**20:.1f} MiB exceeds "
+            f"the machine model's {hbm_bytes / 2**20:.1f} MiB HBM "
+            f"(params {params_local / 2**20:.1f} MiB x (2 + {slots} opt "
+            f"slots) + 2 x peak activation "
+            f"{act_peak / 2**20:.1f} MiB [{act_op}] + reshard transient "
+            f"{reshard_peak / 2**20:.1f} MiB)", "memory-envelope")
+
+
+# -- check 4: collective-ordering consistency --------------------------------
+
+def _check_collective_order(report, strategy, layers, by_name,
+                            axis_sizes) -> None:
+    from ..ffconst import PARALLEL_OPS
+    region = getattr(strategy, "pipeline", None)
+    region_names: set = set()
+    if region is not None:
+        region_names = {l.name for l in layers[region.start:region.end]}
+        pp_axes = {a for a in (getattr(region, "pp_axis", None),
+                               getattr(region, "tp_axis", None))
+                   if a is not None}
+    else:
+        pp_axes = set()
+
+    def subset_check(kind: str, members, axes, seam: str) -> None:
+        name = f"{kind}[{'+'.join(list(members)[:2])}" \
+               f"{'...' if len(members) > 2 else ''}]"
+        overlap = set(axes) & pp_axes
+        if overlap:
+            report.add(
+                "collective-order", "error", name,
+                f"{kind} axes {sorted(overlap)} collide with the "
+                f"pipeline region's stage/tp axes — the double "
+                f"transition this composes is the banks x pipeline "
+                f"NaN-miscompile class (PR 6); place the {kind} on "
+                f"disjoint axes", seam)
+        inside = sorted(set(members) & region_names)
+        if inside:
+            report.add(
+                "collective-order", "error", name,
+                f"members {inside} lie inside the pipeline region: "
+                f"their subset lowering cannot nest in the GPipe "
+                f"shard_map (stage-divergent collective sequence = "
+                f"deadlock)", seam)
+        for m in members:
+            l = by_name.get(m)
+            if l is not None and l.op_type in PARALLEL_OPS:
+                report.add(
+                    "collective-order", "error", m,
+                    f"collective op {l.op_type.name} cannot be a {kind} "
+                    f"member: only its subset would issue the "
+                    f"collective (rank-divergent sequence = deadlock)",
+                    seam)
+
+    for bk in getattr(strategy, "banks", None) or ():
+        subset_check("bank", bk.members, bk.axes, "bank-boundary")
+    for pg in getattr(strategy, "place_groups", None) or ():
+        # (a member's OUTPUT spec may legitimately shard over the
+        # placement axis — the lowering rejoins branches with a masked
+        # full-axis psum, so the constraint applies to the rejoined
+        # value, not inside a branch)
+        subset_check("place-group", pg.members, (pg.axis,),
+                     "place-group")
+    if region is not None:
+        from ..ffconst import PARALLEL_OPS as _POPS
+        for l in list(getattr(region, "prologue", ()) or ()) \
+                + list(getattr(region, "epilogue", ()) or ()):
+            if l.op_type in _POPS:
+                report.add(
+                    "collective-order", "error", l.name,
+                    "collective op inside a ragged-pipeline prologue/"
+                    "epilogue runs under lax.cond on the stage index — "
+                    "only one stage would issue it (deadlock)",
+                    "pipeline-prologue")
+
+
+# ---------------------------------------------------------------------------
+# wiring helpers
+# ---------------------------------------------------------------------------
+
+def verify_model(model) -> PlanReport:
+    """Verify a compiled-to-the-strategy :class:`FFModel` (called from
+    ``FFModel.compile`` post-search). Raises
+    :class:`PlanVerificationError` on error findings; appends the report
+    to the strategy audit record when the search wrote one."""
+    program = model.executor.program
+    cfg = model.config
+    hbm = None
+    if getattr(cfg, "device_mem_mb", 0):
+        hbm = float(cfg.device_mem_mb) * (1 << 20)
+    report = verify_plan(
+        model.strategy, program.layers,
+        machine_spec=model.dmesh.spec,
+        graph_inputs=model.graph_inputs,
+        optimizer=model.optimizer,
+        hbm_bytes=hbm,
+        context="FFModel.compile")
+    audit_path = getattr(model, "_strategy_audit_path", None)
+    if audit_path:
+        from ..obs.audit import annotate_strategy_audit
+        annotate_strategy_audit(audit_path,
+                                {"plan_verify": report.to_json()})
+    report.raise_if_failed("the compiled strategy")
+    return report
+
+
+def verify_strategy_file(path: str, doc: Optional[Dict] = None
+                         ) -> PlanReport:
+    """Structural verification of a saved strategy JSON (``ffcheck
+    --verify-strategies``): mesh-axis soundness of every recorded spec,
+    bank/place-group divisibility, and — when the file carries the
+    searched program — full shape-level divisibility via the recorded
+    layer list. No devices are touched. ``doc`` skips re-parsing when
+    the caller already holds the loaded JSON."""
+    import json
+
+    t0 = time.perf_counter()
+    if doc is None:
+        with open(path) as f:
+            doc = json.load(f)
+    report = PlanReport()
+    axis_sizes = {str(k): int(v)
+                  for k, v in (doc.get("mesh_axes") or {}).items()}
+    if not axis_sizes:
+        report.add("op-shard", "error", path,
+                   "strategy file has no mesh_axes section")
+        report.duration_s = time.perf_counter() - t0
+        return report
+    # shapes from the serialized program, when present (output shapes
+    # re-inferred through the op registry; inputs are name-only in the
+    # wire format, so input tensors are synthesized unconstrained)
+    out_shapes: Dict[str, List[Tuple[int, ...]]] = {}
+    weight_shapes: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+    prog = doc.get("program")
+    if prog:
+        try:
+            out_shapes, weight_shapes = _program_shapes(prog)
+        except Exception as e:  # noqa: BLE001 — degrade to spec-only
+            report.add("op-shard", "warn", path,
+                       f"could not reconstruct program shapes ({e}); "
+                       f"verifying specs without divisibility")
+    for name, os_ in (doc.get("ops") or {}).items():
+        for i, sp in enumerate(os_.get("outputs") or ()):
+            if sp is None:
+                continue
+            shape = None
+            shapes = out_shapes.get(name)
+            if shapes and i < len(shapes):
+                shape = shapes[i]
+            _check_spec(report, axis_sizes, name, f"output[{i}]",
+                        _json_spec(sp), shape)
+        for wname, sp in (os_.get("weights") or {}).items():
+            if sp is None:
+                continue
+            _check_spec(report, axis_sizes, name, f"weight {wname!r}",
+                        _json_spec(sp),
+                        weight_shapes.get(name, {}).get(wname),
+                        seam="checkpoint-restore")
+    for tname, sp in (doc.get("inputs") or {}).items():
+        if sp is not None:
+            _check_spec(report, axis_sizes, tname, "input",
+                        _json_spec(sp), None)
+    for b in doc.get("banks") or ():
+        K = len(b.get("members") or ())
+        B = 1
+        bad = []
+        for a in b.get("axes") or ():
+            if a not in axis_sizes:
+                bad.append(a)
+            B *= axis_sizes.get(a, 1)
+        name = f"bank[{'+'.join((b.get('members') or ['?'])[:2])}]"
+        if bad:
+            report.add("seam", "error", name,
+                       f"bank axes {bad} are not mesh axes",
+                       "bank-boundary")
+        if K and K % max(B, 1) != 0:
+            report.add("seam", "error", name,
+                       f"bank degree {B} does not divide member count "
+                       f"{K}", "bank-boundary")
+    report.duration_s = time.perf_counter() - t0
+    return report
+
+
+def _json_spec(j):
+    """JSON spec form → PartitionSpec-like tuple (no jax import)."""
+    return tuple(tuple(e) if isinstance(e, list) else e for e in j)
+
+
+def _program_shapes(prog):
+    """Re-infer every recorded layer's output + weight shapes from a
+    serialized program (search/serialization.program_to_json form).
+    Graph inputs carry no shapes in the wire format, so layers whose
+    inputs reach back to them are skipped (shape unknown ≠ unsound)."""
+    from ..ffconst import OperatorType
+    from ..ops import get_op_def
+    out_shapes: Dict[str, List[Tuple[int, ...]]] = {}
+    out_dtypes: Dict[str, List[Any]] = {}
+    weight_shapes: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+    from ..search.serialization import _param_from_json
+    for ls in prog.get("layers", ()):
+        shapes, dtypes = [], []
+        known = True
+        for ref in ls["inputs"]:
+            if "op" in ref and ref["op"] in out_shapes:
+                src_shapes = out_shapes[ref["op"]]
+                src_dtypes = out_dtypes[ref["op"]]
+                if ref["idx"] < len(src_shapes):
+                    shapes.append(src_shapes[ref["idx"]])
+                    dtypes.append(src_dtypes[ref["idx"]])
+                    continue
+            known = False
+            break
+        if not known:
+            continue
+        try:
+            params = {k: _param_from_json(v)
+                      for k, v in ls["params"].items()}
+            op = get_op_def(OperatorType[ls["op_type"]])
+            outs = op.infer(params, shapes, dtypes)
+            out_shapes[ls["name"]] = [tuple(s) for s, _ in outs]
+            out_dtypes[ls["name"]] = [d for _, d in outs]
+            weight_shapes[ls["name"]] = {
+                w.name: tuple(w.shape)
+                for w in op.weights(params, shapes, dtypes) or ()}
+        except Exception:  # noqa: BLE001 — unknown op: skip its shapes
+            continue
+    return out_shapes, weight_shapes

@@ -85,6 +85,15 @@ class FFConfig:
     allow_tensor_op_math_conversion: bool = True   # = allow bf16 matmul accum
     computation_mode: str = "training"
     profiling: bool = False
+    # static plan verification (analysis/plan_verifier.py): compile
+    # proves the adopted strategy executable — mesh-axis soundness,
+    # shard divisibility, legal reshard lowerings at every layout seam,
+    # a static peak-memory envelope, and SPMD collective-ordering
+    # consistency — BEFORE params materialize; failures raise a typed
+    # PlanVerificationError with op/seam attribution. FF_PLAN_VERIFY=0
+    # (or this flag) disables the gate; findings land in the strategy
+    # audit record and the ff_plan_verify_* counters either way.
+    plan_verify: bool = True
     # -------- strategy import/export --------
     export_strategy_file: str = ""
     import_strategy_file: str = ""
@@ -227,6 +236,8 @@ class FFConfig:
                 cfg.search_alpha = float(take())
             elif a == "--only-data-parallel":
                 cfg.only_data_parallel = True
+            elif a == "--no-plan-verify":
+                cfg.plan_verify = False
             elif a == "--enable-parameter-parallel":
                 cfg.enable_parameter_parallel = True
             elif a == "--enable-attribute-parallel":

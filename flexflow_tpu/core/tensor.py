@@ -66,7 +66,9 @@ class Tensor:
     def set_tensor(self, value: np.ndarray):
         """Attach a host value (reference: NumPy region attach)."""
         value = np.asarray(value)
-        assert value.shape == self.shape, (value.shape, self.shape)
+        if value.shape != self.shape:
+            raise ValueError(f"value shape {value.shape} does not "
+                             f"match tensor shape {self.shape}")
         self._np_value = value
 
     def get_tensor(self):

@@ -173,7 +173,10 @@ class GraphProgram:
             ins = [env[t.guid] for t in layer.inputs]
             w = params.get(layer.name, {})
             outs = op.emit(layer.params, ins, w, ctx, layer.name)
-            assert len(outs) == len(layer.outputs), layer
+            if len(outs) != len(layer.outputs):
+                raise RuntimeError(
+                    f"op {layer.name} emitted {len(outs)} outputs, "
+                    f"expected {len(layer.outputs)}")
             for i, (o, t) in enumerate(zip(outs, layer.outputs)):
                 cast = (bf16_act and hasattr(o, "dtype")
                         and o.dtype == jnp.float32)
@@ -276,21 +279,25 @@ class GraphProgram:
         axis = pg.axis
         P_ = strategy.dmesh.axis_sizes[axis]
         K = len(members)
-        assert P_ % K == 0, \
-            f"place axis {axis} size {P_} must divide into {K} members"
+        if P_ % K != 0:
+            raise ValueError(f"place axis {axis} size {P_} must divide "
+                             f"into {K} members")
         per = P_ // K
         for m in members:
-            assert len(m.inputs) == 1 and len(m.outputs) == 1, \
-                f"place-group member {m.name} must be 1-in/1-out"
-            assert not _needs_rng(m), \
-                f"place-group member {m.name} uses rng (not supported)"
+            if len(m.inputs) != 1 or len(m.outputs) != 1:
+                raise ValueError(f"place-group member {m.name} must be "
+                                 f"1-in/1-out")
+            if _needs_rng(m):
+                raise ValueError(f"place-group member {m.name} uses "
+                                 f"rng (not supported)")
         ops = [get_op_def(m.op_type) for m in members]
         for m, op in zip(members, ops):
             ss = getattr(op, "state_spec", None)
-            assert ss is None or not ss(
-                m.params, [t.shape for t in m.inputs],
-                [t.dtype for t in m.inputs]), \
-                f"stateful op {m.name} cannot join a place group"
+            if ss is not None and ss(
+                    m.params, [t.shape for t in m.inputs],
+                    [t.dtype for t in m.inputs]):
+                raise ValueError(
+                    f"stateful op {m.name} cannot join a place group")
         xs = [env[m.inputs[0].guid] for m in members]
         ws = [params.get(m.name, {}) for m in members]
         out_sds = [jax.eval_shape(
@@ -542,8 +549,10 @@ class Executor:
                 ss = state_spec(layer.params, [t.shape for t in layer.inputs],
                                 [t.dtype for t in layer.inputs])
                 if ss:
-                    assert layer.name not in bank_names, \
-                        f"stateful op {layer.name} cannot be banked"
+                    if layer.name in bank_names:
+                        raise ValueError(
+                            f"stateful op {layer.name} cannot be "
+                            f"banked")
                     st = {}
                     for sname, (sshape, sdt) in ss.items():
                         if sname == "var":
@@ -917,8 +926,9 @@ class Executor:
             if v > 1:
                 chunk_keys = chunk_keys.reshape(v, S)
             stacked = dict(stacked, __rng__=chunk_keys)
-        assert x.shape[0] % M == 0, \
-            f"batch {x.shape[0]} not divisible into {M} microbatches"
+        if x.shape[0] % M != 0:
+            raise ValueError(f"batch {x.shape[0]} not divisible into "
+                             f"{M} microbatches")
         from .parallel.pipeline_lowering import (region_entry_transition,
                                                  region_exit_transition)
         x = region_entry_transition(x, self.strategy,
@@ -1022,8 +1032,9 @@ class Executor:
                                seq_length=ctx.seq_length)
                 self.program.emit_layers(_block, benv, p_, bctx,
                                          self.strategy, None)
-                assert not bctx.new_state and not bctx.aux_losses, \
-                    "stateful/aux op inside a rematted block"
+                if bctx.new_state or bctx.aux_losses:
+                    raise RuntimeError(
+                        "stateful/aux op inside a rematted block")
                 return benv[_exit]
 
             bp = {l.name: params[l.name] for l in block
@@ -1060,9 +1071,10 @@ class Executor:
 
         accum = max(getattr(self.config, "gradient_accumulation_steps", 1),
                     1)
-        assert self.config.batch_size % accum == 0, \
-            (f"--gradient-accumulation-steps {accum} must divide the "
-             f"batch size {self.config.batch_size}")
+        if self.config.batch_size % accum != 0:
+            raise ValueError(
+                f"--gradient-accumulation-steps {accum} must divide "
+                f"the batch size {self.config.batch_size}")
 
         def loss_fn(p, st, mb, sub_step):
             outs, new_state, aux, capture = self._forward(
@@ -1092,9 +1104,10 @@ class Executor:
                 def to_micro(v):
                     # the RUNTIME batch (fit(batch_size=...) may differ
                     # from config.batch_size) must also divide
-                    assert v.shape[0] % accum == 0, \
-                        (f"batch dim {v.shape[0]} not divisible into "
-                         f"{accum} accumulation micro-batches")
+                    if v.shape[0] % accum != 0:
+                        raise ValueError(
+                            f"batch dim {v.shape[0]} not divisible "
+                            f"into {accum} accumulation micro-batches")
                     return v.reshape((accum, v.shape[0] // accum)
                                      + v.shape[1:])
 

@@ -54,9 +54,11 @@ class VerifyMetrics(Callback):
             self.last = logs[self.metric]
 
     def on_train_end(self, logs=None):
-        assert self.last is not None, f"metric {self.metric} never reported"
-        assert self.last >= self.threshold, \
-            f"{self.metric}={self.last} < threshold {self.threshold}"
+        if self.last is None:
+            raise ValueError(f"metric {self.metric} never reported")
+        if self.last < self.threshold:
+            raise ValueError(f"{self.metric}={self.last} < threshold "
+                             f"{self.threshold}")
 
 
 class EarlyStopping(Callback):

@@ -90,7 +90,8 @@ class Model:
     # ------------------------------------------------------------------
     def fit(self, x=None, y=None, batch_size=None, epochs: int = 1,
             callbacks=None, verbose=True):
-        assert self.ffmodel is not None, "call compile() first"
+        if self.ffmodel is None:
+            raise ValueError("call compile() first")
         cbs = callbacks or []
         for cb in cbs:
             cb.set_model(self)
@@ -136,7 +137,8 @@ class Sequential(Model):
             self.inputs = [layer]
             self._last = layer.tensor
             return
-        assert self.inputs, "Sequential needs an Input layer first"
+        if not self.inputs:
+            raise ValueError("Sequential needs an Input layer first")
         self._last = layer(self._last)
         self._layers.append(layer)
         self.outputs = [self._last]

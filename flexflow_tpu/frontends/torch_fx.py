@@ -241,8 +241,9 @@ class PyTorchModel:
                     if t in inputs:
                         inputs.remove(t)
                 else:
-                    assert inputs, \
-                        f"no tensor for placeholder {node.name!r}"
+                    if not inputs:
+                        raise ValueError(
+                            f"no tensor for placeholder {node.name!r}")
                     env[node.name] = inputs.pop(0)
             elif node.op == "get_attr":
                 t = self._get_attr(gm, node.target)
@@ -821,14 +822,17 @@ class PyTorchModel:
         from ..search.serialization import program_from_json
         with open(path) as f:
             doc = json.load(f)
-        assert doc.get("format") == "flexflow-tpu-graph-v1", \
-            f"not a graph file: {path}"
-        assert len(input_tensors) == len(doc["inputs"]), \
-            (len(input_tensors), len(doc["inputs"]))
+        if doc.get("format") != "flexflow-tpu-graph-v1":
+            raise ValueError(f"not a graph file: {path}")
+        if len(input_tensors) != len(doc["inputs"]):
+            raise ValueError(
+                f"{len(input_tensors)} input tensors for "
+                f"{len(doc['inputs'])} recorded inputs")
         for t, rec in zip(input_tensors, doc["inputs"]):
-            assert tuple(t.shape) == tuple(rec["shape"]), \
-                f"input {rec['name']}: expected {rec['shape']}, " \
-                f"got {t.shape}"
+            if tuple(t.shape) != tuple(rec["shape"]):
+                raise ValueError(
+                    f"input {rec['name']}: expected {rec['shape']}, "
+                    f"got {t.shape}")
             t.name = rec["name"]
         consts = []
         if doc["consts"]:

@@ -220,13 +220,21 @@ class FFModel:
         if num_kv_heads and num_kv_heads != num_heads:
             # grouped-query attention (LLaMA-2/3 family): kv projections
             # and the KV cache carry num_kv_heads head groups
-            assert num_heads % num_kv_heads == 0, (num_heads, num_kv_heads)
+            if num_heads % num_kv_heads != 0:
+                raise ValueError(
+                    f"num_kv_heads {num_kv_heads} must divide "
+                    f"num_heads {num_heads}")
             params["num_kv_heads"] = int(num_kv_heads)
         if sliding_window:
             # Mistral-family local attention: queries see the last
             # `sliding_window` positions only (requires causal)
-            assert causal, "sliding_window requires causal attention"
-            assert sliding_window > 0, sliding_window
+            if not causal:
+                raise ValueError("sliding_window requires causal "
+                                 "attention")
+            if sliding_window <= 0:
+                raise ValueError(
+                    f"sliding_window must be positive, "
+                    f"got {sliding_window}")
             params["sliding_window"] = int(sliding_window)
         if rope:
             # in-op rotary embeddings (LLaMA family; enables the fused
@@ -580,8 +588,9 @@ class FFModel:
             # dp × pp (× tp) mesh: middle axis carries the pipeline
             # stages, trailing axis the stage-internal tensor split
             nd = spec.num_devices
-            assert nd % (pp * pp_tp) == 0, \
-                f"--pp {pp} x --pp-tp {pp_tp} does not divide {nd} devices"
+            if nd % (pp * pp_tp) != 0:
+                raise ValueError(f"--pp {pp} x --pp-tp {pp_tp} does "
+                                 f"not divide {nd} devices")
             mesh_shape = tuple(
                 d for d in (nd // (pp * pp_tp), pp, pp_tp) if d > 1)
         self.dmesh = DeviceMesh(spec, mesh_shape=mesh_shape)
@@ -606,8 +615,9 @@ class FFModel:
             # validated; otherwise a (dp, tp) mesh is built.
             from .parallel.presets import transformer_strategy
             nd = self.dmesh.num_devices
-            assert nd % tp_deg == 0, \
-                f"--tp {tp_deg} does not divide {nd} devices"
+            if nd % tp_deg != 0:
+                raise ValueError(
+                    f"--tp {tp_deg} does not divide {nd} devices")
             if mesh_shape is None:
                 self.dmesh = DeviceMesh(
                     spec, mesh_shape=tuple(
@@ -703,6 +713,20 @@ class FFModel:
             pl = ReshardPlanner(self.dmesh)
             self.strategy.resharder = pl
         pl.audit_path = getattr(self, "_strategy_audit_path", None)
+        # static plan verification (analysis/plan_verifier.py): prove
+        # the adopted strategy executable — axis soundness, shard
+        # divisibility, legal reshard lowerings at every seam, memory
+        # envelope, collective-order consistency — BEFORE params
+        # materialize; an unsound plan raises PlanVerificationError
+        # with the op/seam attributed instead of miscompiling later
+        if self.config.plan_verify \
+                and os.environ.get("FF_PLAN_VERIFY", "") != "0":
+            from .analysis.plan_verifier import verify_model
+            _t0 = time.perf_counter()
+            report = verify_model(self)
+            self.__dict__.setdefault("_compile_phases", {})["verify_s"] \
+                = round(time.perf_counter() - _t0, 6)
+            self._plan_verify_report = report
         _t0 = time.perf_counter()
         self.params, self.state = self.executor.init_params_and_state()
         if hasattr(self, "_compile_phases"):
@@ -723,6 +747,8 @@ class FFModel:
             self.executor.opt_state_constraints = \
                 state_constraints(self.opt_state)
         self._step = 0
+        self.__dict__.setdefault("_compile_phases", {})["compile_s"] = \
+            round(time.perf_counter() - _compile_t0, 6)
         obs_events.record_span("model.compile", _compile_t0,
                                time.perf_counter() - _compile_t0,
                                n_devices=self.dmesh.num_devices,
@@ -764,8 +790,9 @@ class FFModel:
         graph_inputs = getattr(self, "graph_inputs", self.input_tensors)
         if x is not None or y is not None:
             xs = x if isinstance(x, (list, tuple)) else [x]
-            assert len(xs) == len(graph_inputs), \
-                f"{len(xs)} arrays for {len(graph_inputs)} inputs"
+            if len(xs) != len(graph_inputs):
+                raise ValueError(f"{len(xs)} arrays for "
+                                 f"{len(graph_inputs)} inputs")
             for t, arr in zip(graph_inputs, xs):
                 arrays[t.name] = np.ascontiguousarray(arr)
             arrays["label"] = np.ascontiguousarray(y)
@@ -803,7 +830,8 @@ class FFModel:
         window (``config.async_dispatch_steps``) keeps the host from
         racing ahead. ``FF_SYNC_EVERY_STEP=1`` restores the old
         fetch-every-step loop for debugging."""
-        assert self.executor is not None, "call compile() first"
+        if self.executor is None:
+            raise ValueError("call compile() first")
         epochs = epochs or self.config.epochs
         loader = self._combined_loader(x, y, batch_size)
         history = []
@@ -945,22 +973,32 @@ class FFModel:
         to input_ids/position_ids), silently falling back to the exact
         full-re-forward path otherwise. True forces the KV path (raises
         when unsupported), False forces the re-forward oracle."""
-        assert self.executor is not None, "call compile() first"
+        if self.executor is None:
+            raise ValueError("call compile() first")
         ids0 = jnp.asarray(prompt_ids, jnp.int32)
         b, L = ids0.shape
         if np.ndim(prompt_len) > 0:
             # ragged prompts: one length per batch row
             prompt_len = np.asarray(prompt_len, np.int32)
-            assert prompt_len.shape == (b,), (prompt_len.shape, b)
-            assert (prompt_len >= 1).all() and \
-                (prompt_len + max_new_tokens <= L).all(), \
-                (prompt_len, max_new_tokens, L)
+            if prompt_len.shape != (b,):
+                raise ValueError(
+                    f"ragged prompt_len must have shape ({b},), got "
+                    f"{prompt_len.shape}")
+            if not ((prompt_len >= 1).all()
+                    and (prompt_len + max_new_tokens <= L).all()):
+                raise ValueError(
+                    f"each prompt_len must satisfy 1 <= len and "
+                    f"len + max_new_tokens <= {L}; got {prompt_len} "
+                    f"with max_new_tokens={max_new_tokens}")
         else:
-            assert prompt_len >= 1, \
-                "prompt_len must be >= 1 (the first token conditions " \
-                "decode)"
-            assert prompt_len + max_new_tokens <= L, \
-                (prompt_len, max_new_tokens, L)
+            if prompt_len < 1:
+                raise ValueError(
+                    "prompt_len must be >= 1 (the first token "
+                    "conditions decode)")
+            if prompt_len + max_new_tokens > L:
+                raise ValueError(
+                    f"prompt_len {prompt_len} + max_new_tokens "
+                    f"{max_new_tokens} exceeds the sequence length {L}")
         names = {t.name for t in self.graph_inputs}
         fixed = {k: jnp.asarray(v)
                  for k, v in (extra_inputs or {}).items()}
@@ -1077,17 +1115,23 @@ class FFModel:
 
         Beyond-reference: the reference has no generation path at all;
         beam completes the greedy/temperature/top-k/top-p family."""
-        assert self.executor is not None, "call compile() first"
+        if self.executor is None:
+            raise ValueError("call compile() first")
         ids0 = jnp.asarray(prompt_ids, jnp.int32)
         b, L = ids0.shape
         K = int(num_beams)
-        assert K >= 1
+        if K < 1:
+            raise ValueError(f"num_beams must be >= 1, got {K}")
         if np.ndim(prompt_len) > 0:
             raise ValueError("generate_beam needs one scalar prompt_len "
                              "(per-row prompt lengths are unsupported "
                              "for beam search)")
-        assert prompt_len >= 1
-        assert prompt_len + max_new_tokens <= L
+        if prompt_len < 1:
+            raise ValueError("prompt_len must be >= 1")
+        if prompt_len + max_new_tokens > L:
+            raise ValueError(
+                f"prompt_len {prompt_len} + max_new_tokens "
+                f"{max_new_tokens} exceeds the sequence length {L}")
         names = {t.name for t in self.graph_inputs}
         if not self._kv_decode_eligible(names, None):
             raise ValueError("generate_beam requires a KV-decode-"
@@ -1359,7 +1403,9 @@ class FFModel:
     def set_weights(self, layer_name: str, weight_name: str,
                     value: np.ndarray):
         cur = self.params[layer_name][weight_name]
-        assert cur.shape == value.shape, (cur.shape, value.shape)
+        if cur.shape != value.shape:
+            raise ValueError(f"weight {layer_name}/{weight_name} has "
+                             f"shape {cur.shape}, got {value.shape}")
         self.params[layer_name][weight_name] = jax.device_put(
             jnp.asarray(value, cur.dtype), cur.sharding)
 
@@ -1367,7 +1413,9 @@ class FFModel:
         """Overwrite one non-trainable state entry (e.g. batch-norm
         running mean/var imported from a trained torch model)."""
         cur = self.state[layer_name][key]
-        assert cur.shape == tuple(value.shape), (cur.shape, value.shape)
+        if cur.shape != tuple(value.shape):
+            raise ValueError(f"state {layer_name}/{key} has shape "
+                             f"{cur.shape}, got {tuple(value.shape)}")
         self.state[layer_name][key] = jax.device_put(
             jnp.asarray(value, cur.dtype), cur.sharding)
 

@@ -308,11 +308,12 @@ def build_llama(ff: FFModel, batch_size: int, seq_len: int,
             h = mlp_block(h, i)
         return head(h)
 
-    assert not cfg.sliding_window and not cfg.attention_bias \
-        and cfg.num_kv_heads in (0, nh), \
-        ("sliding_window/GQA/attention_bias need fused_attention=True — "
-         "the primitive build predates them and would silently compute "
-         "plain full MHA")
+    if cfg.sliding_window or cfg.attention_bias \
+            or cfg.num_kv_heads not in (0, nh):
+        raise ValueError(
+            "sliding_window/GQA/attention_bias need "
+            "fused_attention=True — the primitive build predates them "
+            "and would silently compute plain full MHA")
     cos_np, sin_np = _rope_tables(s, hd, cfg.rope_theta)
     cos_t = ff.create_tensor(cos_np.shape, create_grad=False,
                              name="rope_cos")
@@ -383,10 +384,12 @@ def llama_fuse_params(params, cfg: LlamaConfig):
     through unchanged — so HF-imported weights can serve through the
     flash/KV-decode path."""
     import numpy as np
-    assert cfg.num_kv_heads in (0, cfg.num_heads), \
-        ("llama_fuse_params converts the MHA primitive layout; a GQA "
-         "target (num_kv_heads < num_heads) has no primitive source — "
-         "load GQA checkpoints into the fused layout directly")
+    if cfg.num_kv_heads not in (0, cfg.num_heads):
+        raise ValueError(
+            "llama_fuse_params converts the MHA primitive layout; a "
+            "GQA target (num_kv_heads < num_heads) has no primitive "
+            "source — load GQA checkpoints into the fused layout "
+            "directly")
     nh = cfg.num_heads
     e = cfg.hidden_size
     hd = e // nh
@@ -455,8 +458,11 @@ def llama_load_hf_state_dict(state_dict, cfg: LlamaConfig,
         "final_norm": {"scale": take("model.norm.weight")},
         "lm_head": {"kernel": lm_w.T},
     }
-    assert params["embed_tokens"]["kernel"].shape[1] == e, \
-        (params["embed_tokens"]["kernel"].shape, e)
+    if params["embed_tokens"]["kernel"].shape[1] != e:
+        raise ValueError(
+            f"embed_tokens kernel shape "
+            f"{params['embed_tokens']['kernel'].shape} does not match "
+            f"hidden_size {e}")
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
         params[f"input_norm_{i}"] = {
@@ -470,9 +476,10 @@ def llama_load_hf_state_dict(state_dict, cfg: LlamaConfig,
         k = take(p + "self_attn.k_proj.weight").T      # (e, kvh*hd)
         v = take(p + "self_attn.v_proj.weight").T
         o = take(p + "self_attn.o_proj.weight").T      # (nh*hd, e)
-        assert q.shape == (e, nh * hd) and k.shape == (e, kvh * hd), \
-            ("checkpoint/config head mismatch", q.shape, k.shape,
-             (e, nh, kvh, hd))
+        if q.shape != (e, nh * hd) or k.shape != (e, kvh * hd):
+            raise ValueError(
+                f"checkpoint/config head mismatch: q {q.shape} "
+                f"k {k.shape} vs (e={e}, nh={nh}, kvh={kvh}, hd={hd})")
         if fused:
             attn = _fuse_qkvo(q, k, v, o, e, nh, kvh)
             if cfg.attention_bias:
@@ -636,9 +643,10 @@ def mixtral_load_hf_state_dict(state_dict, cfg: MixtralConfig):
             "scale": take(p + "post_attention_layernorm.weight")}
         q = take(p + "self_attn.q_proj.weight").T
         k = take(p + "self_attn.k_proj.weight").T
-        assert q.shape == (e, nh * hd) and k.shape == (e, kvh * hd), \
-            ("checkpoint/config head mismatch", q.shape, k.shape,
-             (e, nh, kvh, hd))
+        if q.shape != (e, nh * hd) or k.shape != (e, kvh * hd):
+            raise ValueError(
+                f"checkpoint/config head mismatch: q {q.shape} "
+                f"k {k.shape} vs (e={e}, nh={nh}, kvh={kvh}, hd={hd})")
         params[f"attn_{i}"] = _fuse_qkvo(
             q, k,
             take(p + "self_attn.v_proj.weight").T,

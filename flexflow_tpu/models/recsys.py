@@ -47,7 +47,9 @@ def build_dlrm(ff: FFModel, batch_size: int, cfg: DLRMConfig | None = None):
                        AggrMode.AGGR_MODE_SUM, name=f"emb_{i}")
           for i, (s, n) in enumerate(zip(sparse_inputs, cfg.embedding_size))]
     x = _mlp(ff, dense_input, list(cfg.mlp_bot))
-    assert cfg.arch_interaction_op == "cat", cfg.arch_interaction_op
+    if cfg.arch_interaction_op != "cat":
+        raise ValueError(f"unsupported arch_interaction_op "
+                         f"{cfg.arch_interaction_op!r} (only 'cat')")
     z = ff.concat([x] + ly, axis=-1)
     # last top-MLP layer uses sigmoid (reference dlrm.cc:165:
     # sigmoid_layer = mlp_top.size() - 2)

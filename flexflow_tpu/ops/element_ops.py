@@ -174,7 +174,9 @@ class _ReduceBase(OpDef):
         axes = tuple(a % x.ndim for a in params.get("axes", range(x.ndim)))
         keep = params.get("keepdims", False)
         if self.arg:
-            assert len(axes) == 1
+            if len(axes) != 1:
+                raise ValueError(
+                    f"arg-reduce takes exactly one axis, got {axes}")
             return [type(self).fn(x, axis=axes[0], keepdims=keep)
                     .astype(jnp.int32)]
         return [type(self).fn(x, axis=axes, keepdims=keep)]

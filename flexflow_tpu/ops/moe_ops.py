@@ -59,7 +59,9 @@ class GroupByOp(OpDef):
 
     def infer(self, params, in_shapes, in_dtypes):
         (b, d), (b2, k) = in_shapes[0], in_shapes[1]
-        assert b == b2, (in_shapes,)
+        if b != b2:
+            raise ValueError(
+                f"group_by input/assign batch dims differ: {in_shapes}")
         c = _capacity(params, b, k)
         return [((c, d), in_dtypes[0])] * params["n"]
 

@@ -223,7 +223,8 @@ class DropoutOp(OpDef):
         if not ctx.training or rate <= 0.0:
             return [x]
         rng = ctx.rng_for(name)
-        assert rng is not None, f"dropout layer {name} needs an rng"
+        if rng is None:
+            raise RuntimeError(f"dropout layer {name} needs an rng")
         keep = 1.0 - rate
         mask = jax.random.bernoulli(rng, keep, x.shape)
         return [jnp.where(mask, x / keep, jnp.zeros_like(x))]
@@ -478,10 +479,14 @@ class MultiHeadAttentionOp(OpDef):
             # half-split rotate) — positions are absolute indices, so
             # the single decode token rotates at kv_index and the cache
             # stores already-rotated keys
-            assert causal, "rope is only supported for causal attention"
-            assert qh.shape[1] == kh.shape[1], \
-                "rope=True requires self-attention (Lq == Lk); " \
-                "cross-attention has no single absolute position stream"
+            if not causal:
+                raise ValueError(
+                    "rope is only supported for causal attention")
+            if qh.shape[1] != kh.shape[1]:
+                raise ValueError(
+                    "rope=True requires self-attention (Lq == Lk); "
+                    "cross-attention has no single absolute position "
+                    "stream")
             theta = float(params.get("rope_theta", 10000.0))
             if kv_mode == "decode":
                 kvi = jnp.asarray(ctx.kv_index)
@@ -611,15 +616,17 @@ class MultiHeadAttentionOp(OpDef):
         positions <= kv_index. Exactly matches the full re-forward's row
         at kv_index (same mask, same softmax domain) — the re-forward
         path is the numerics oracle in tests/test_generate_kv.py."""
-        assert params.get("causal", False), \
-            "KV-cache decode requires causal self-attention"
+        if not params.get("causal", False):
+            raise ValueError(
+                "KV-cache decode requires causal self-attention")
         cache = ctx.kv_cache[name]
         idx = jnp.asarray(ctx.kv_index)
         ragged = idx.ndim == 1            # per-row positions (B,)
         ring = "pos" in cache
-        assert not (ring and ragged), \
-            "ragged prompts use the full cache (generate passes " \
-            "prefill_len=None for vector prompt lengths)"
+        if ring and ragged:
+            raise ValueError(
+                "ragged prompts use the full cache (generate passes "
+                "prefill_len=None for vector prompt lengths)")
         if ring:
             # sliding-window ring buffer: write slot idx % W, track the
             # stored position for the validity mask
@@ -710,8 +717,12 @@ class BatchMatmulOp(OpDef):
 
     def infer(self, params, in_shapes, in_dtypes):
         a, b = in_shapes
-        assert a[:-2] == b[:-2], (a, b)
-        assert a[-1] == b[-2], (a, b)
+        if a[:-2] != b[:-2]:
+            raise ValueError(
+                f"batch_matmul batch dims differ: {a} vs {b}")
+        if a[-1] != b[-2]:
+            raise ValueError(
+                f"batch_matmul contraction dims differ: {a} vs {b}")
         return [(tuple(a[:-1]) + (b[-1],), in_dtypes[0])]
 
     def emit(self, params, inputs, weights, ctx, name):

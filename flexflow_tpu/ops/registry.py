@@ -88,7 +88,8 @@ OPS: Dict[OperatorType, OpDef] = {}
 
 def register(cls):
     inst = cls()
-    assert inst.op_type != OperatorType.OP_INVALID, cls
+    if inst.op_type == OperatorType.OP_INVALID:
+        raise ValueError(f"{cls.__name__} does not declare an op_type")
     OPS[inst.op_type] = inst
     return cls
 

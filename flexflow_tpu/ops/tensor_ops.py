@@ -47,7 +47,10 @@ class ReshapeOp(OpDef):
         if -1 in shape:
             known = -int(np.prod(shape))
             shape = tuple(vol_in // known if s == -1 else s for s in shape)
-        assert int(np.prod(shape)) == vol_in, (in_shapes[0], shape)
+        if int(np.prod(shape)) != vol_in:
+            raise ValueError(
+                f"reshape to {shape} does not preserve the element "
+                f"count of {in_shapes[0]}")
         return [(shape, in_dtypes[0])]
 
     def emit(self, params, inputs, weights, ctx, name):
@@ -100,7 +103,10 @@ class SplitOp(OpDef):
         ish = in_shapes[0]
         axis = params["axis"] % len(ish)
         sizes = params["sizes"]
-        assert sum(sizes) == ish[axis], (sizes, ish, axis)
+        if sum(sizes) != ish[axis]:
+            raise ValueError(
+                f"split sizes {sizes} do not sum to dim {axis} of "
+                f"{ish}")
         outs = []
         for sz in sizes:
             o = list(ish)

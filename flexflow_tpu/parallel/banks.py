@@ -101,9 +101,9 @@ class BankSpec:
         names = list(dmesh.axis_sizes.keys())
         sizes = [dmesh.axis_sizes[a] for a in names]
         B = self.bank_degree(dmesh)
-        assert len(self.members) % B == 0, \
-            (f"bank degree {B} must divide member count "
-             f"{len(self.members)}")
+        if len(self.members) % B != 0:
+            raise ValueError(f"bank degree {B} must divide member "
+                             f"count {len(self.members)}")
         grid = np.arange(int(np.prod(sizes))).reshape(sizes)
         # bank coordinate of every flat device id
         coord = np.zeros_like(grid)
@@ -209,7 +209,9 @@ class PlaceGroup:
         sizes = [dmesh.axis_sizes[a] for a in names]
         P_ = dmesh.axis_sizes[self.axis]
         K = len(self.members)
-        assert P_ % K == 0, (self.axis, P_, K)
+        if P_ % K != 0:
+            raise ValueError(f"place axis {self.axis} size {P_} must "
+                             f"divide into {K} members")
         grid = np.arange(int(np.prod(sizes))).reshape(sizes)
         ax = names.index(self.axis)
         coord = np.indices(grid.shape)[ax]

@@ -139,15 +139,18 @@ class PipelinedBlocks:
     def __init__(self, mesh: Mesh, stage_fn, n_stages: int,
                  n_microbatches: int, dp_axis: str = "dp",
                  pp_axis: str = "pp", n_chunks: int = 1):
-        assert pp_axis in mesh.axis_names, (pp_axis, mesh.axis_names)
+        if pp_axis not in mesh.axis_names:
+            raise ValueError(f"pipeline axis {pp_axis!r} is not a mesh "
+                             f"axis ({mesh.axis_names})")
         pp_size = mesh.shape[pp_axis]
-        assert n_stages == pp_size, \
-            (f"n_stages ({n_stages}) must equal the '{pp_axis}' axis size "
-             f"({pp_size}): one stage per pipeline rank")
-        if n_chunks > 1:
-            assert n_microbatches % n_stages == 0, \
-                (f"interleaved schedule needs M % S == 0, got "
-                 f"M={n_microbatches} S={n_stages}")
+        if n_stages != pp_size:
+            raise ValueError(
+                f"n_stages ({n_stages}) must equal the '{pp_axis}' "
+                f"axis size ({pp_size}): one stage per pipeline rank")
+        if n_chunks > 1 and n_microbatches % n_stages != 0:
+            raise ValueError(
+                f"interleaved schedule needs M % S == 0, got "
+                f"M={n_microbatches} S={n_stages}")
         self.mesh = mesh
         self.stage_fn = stage_fn
         self.n_stages = n_stages
@@ -174,7 +177,9 @@ class PipelinedBlocks:
     def microbatch(self, x):
         """(B, ...) -> (M, B/M, ...)"""
         M = self.n_microbatches
-        assert x.shape[0] % M == 0, (x.shape, M)
+        if x.shape[0] % M != 0:
+            raise ValueError(f"batch {x.shape} not divisible into {M} "
+                             f"microbatches")
         return x.reshape((M, x.shape[0] // M) + x.shape[1:])
 
     def apply(self, stacked_params, x):

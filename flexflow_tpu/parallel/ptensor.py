@@ -28,7 +28,9 @@ class ParallelDim:
 
     @property
     def shard_size(self) -> int:
-        assert self.size % max(self.degree, 1) == 0, (self.size, self.degree)
+        if self.size % max(self.degree, 1) != 0:
+            raise ValueError(f"size {self.size} not divisible by "
+                             f"degree {self.degree}")
         return self.size // max(self.degree, 1)
 
 

@@ -320,12 +320,18 @@ class ReshardPlanner:
     """
 
     def __init__(self, dmesh, cost_model=None,
-                 cache_dir: Optional[str] = None):
+                 cache_dir: Optional[str] = None, persist: bool = True):
         self.dmesh = dmesh
         self._cm = cost_model
         self._cache_dir = cache_dir or _DEFAULT_DIR
         self._memo: Dict[Tuple, ReshardPlan] = {}
+        # persist=False: read the warm disk cache but never write it —
+        # the static plan verifier probes seam legality without seeding
+        # plans the executor would then count as ITS disk hits, while
+        # still reusing already-planned lowerings instead of re-running
+        # the candidate search on every verified compile
         self._disk: Optional[Dict[str, Any]] = None
+        self._persist = persist
         self.audit_path: Optional[str] = None
         self._audit_records: List[Dict[str, Any]] = []
         self.mesh_key = "x".join(
@@ -369,6 +375,8 @@ class ReshardPlanner:
     def _disk_put(self, key: str, doc: Dict[str, Any]) -> None:
         cache = self._disk_cache()
         cache[key] = doc
+        if not self._persist:
+            return
         try:
             os.makedirs(self._cache_dir, exist_ok=True)
             tmp = self._disk_path + ".tmp"

@@ -212,7 +212,8 @@ class Graph:
                     fresh.append(e.dst)
             if fresh:
                 ready = sorted(ready + fresh, key=lambda x: x.guid)
-        assert len(order) == self.num_nodes(), "cycle in PCG"
+        if len(order) != self.num_nodes():
+            raise RuntimeError("cycle in PCG")
         return order
 
     def hash(self) -> int:
@@ -392,7 +393,9 @@ class Graph:
                         (slot, t))
         if output_tensors:
             for t in output_tensors:
-                assert t.guid in producer, f"output {t.name} has no producer"
+                if t.guid not in producer:
+                    raise ValueError(
+                        f"output {t.name} has no producer")
                 g.outputs.append(producer[t.guid])
         else:
             for n in g.topo_order():
@@ -423,8 +426,8 @@ class Graph:
                 ins[e.dst_idx] = live[(e.src.guid, e.src_idx)]
             for slot, t in self.external_inputs.get(n.guid, ()):
                 ins[slot] = t
-            assert all(i is not None for i in ins), \
-                f"{n}: unwired input slot"
+            if any(i is None for i in ins):
+                raise RuntimeError(f"{n}: unwired input slot")
             same_inputs = len(ins) == len(orig.inputs) and all(
                 a is b for a, b in zip(ins, orig.inputs))
             if same_inputs:

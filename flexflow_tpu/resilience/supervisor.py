@@ -108,7 +108,8 @@ class Supervisor:
         as ``fit`` (a stop request ends the run after the epoch's
         checkpoint)."""
         ff = self.ff
-        assert ff.executor is not None, "call compile() first"
+        if ff.executor is None:
+            raise ValueError("call compile() first")
         epochs = epochs or ff.config.epochs
         self._run_args = (x, y, batch_size, shuffle)
         loader = ff._combined_loader(x, y, batch_size, shuffle=shuffle)
@@ -171,7 +172,7 @@ class Supervisor:
                 raise
             except Exception as e:  # noqa: BLE001 — that's the job
                 self._recover(loader, reason=type(e).__name__, err=e)
-        self._mgr.wait()
+        self._mgr.wait(timeout_s=self._mgr.WAIT_TIMEOUT_S)
         ff._current_metrics = history[-1] if history else {}
         if getattr(ff.config, "trace_export_file", ""):
             # same end-of-training export hook as fit()
@@ -287,7 +288,7 @@ class Supervisor:
 
     def _restore(self, loader) -> None:
         from ..runtime.checkpoint import restore_model_checkpoint
-        self._mgr.wait()
+        self._mgr.wait(timeout_s=self._mgr.WAIT_TIMEOUT_S)
         step, meta = restore_model_checkpoint(self.ff, self.directory,
                                               with_meta=True)
         ld = meta.get("loader")
@@ -335,7 +336,7 @@ class Supervisor:
         self._consume_restart("device_loss", err)
         self._backoff()
         from .elastic import replan_on_device_loss
-        self._mgr.wait()
+        self._mgr.wait(timeout_s=self._mgr.WAIT_TIMEOUT_S)
         replan_on_device_loss(self.ff, err.n_lost)
         self.elastic_replans += 1
         x, y, batch_size, shuffle = self._run_args
@@ -416,7 +417,9 @@ class WorldSupervisor:
                  devices_per_rank: int = 1,
                  world_timeout_s: float = 300.0,
                  poll_interval_s: float = 0.1, env=None):
-        assert policy in ("auto", "relaunch", "shrink"), policy
+        if policy not in ("auto", "relaunch", "shrink"):
+            raise ValueError(f"policy must be 'auto', 'relaunch', or "
+                             f"'shrink', got {policy!r}")
         self.worker_cmd = worker_cmd
         self.nprocs = int(nprocs)
         self.max_world_restarts = max_world_restarts
@@ -514,7 +517,15 @@ class WorldSupervisor:
                         pass
             out = []
             for rec in procs:
-                rec["proc"].wait()
+                try:
+                    # the group was SIGKILLed above; a reap that still
+                    # blocks means the kernel is wedged on the process
+                    # (e.g. uninterruptible I/O) - give up loudly
+                    rec["proc"].wait(timeout=30.0)
+                except subprocess.TimeoutExpired:
+                    log.error("supervisor: rank %s unreaped 30s after "
+                              "SIGKILL; abandoning the zombie",
+                              rec.get("rank"))
                 rec["rc"] = rec["proc"].returncode
                 rec.setdefault("killed", False)
                 for key in ("out_f", "err_f"):

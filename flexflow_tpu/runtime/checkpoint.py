@@ -280,9 +280,17 @@ class CheckpointManager:
     #: pins the steady-state async overhead at <= 5%)
     ORBAX_MIN_BYTES = 64 << 20
 
+    #: bound on joining an in-flight async writer thread (see
+    #: :meth:`wait`) — generous for slow network filesystems, finite so
+    #: wedged storage surfaces as TimeoutError instead of a hang
+    WAIT_TIMEOUT_S = 600.0
+
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_save: bool = False, writer: str = "auto"):
-        assert writer in ("auto", "orbax", "numpy"), writer
+        if writer not in ("auto", "orbax", "numpy"):
+            raise ValueError(
+                f"writer must be 'auto', 'orbax', or 'numpy', "
+                f"got {writer!r}")
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = max_to_keep
@@ -350,7 +358,9 @@ class CheckpointManager:
             host_state = jax.tree.map(_owned_blocks, state)
         else:
             host_state = _tree_to_numpy(state)
-        self.wait()  # one write in flight at a time
+        # one write in flight at a time (bounded: a wedged writer
+        # thread must surface as an error, not hang every later save)
+        self.wait(timeout_s=self.WAIT_TIMEOUT_S)
         if blocking is None:
             blocking = not self.async_save
         meta = dict(metadata or {})
@@ -368,11 +378,22 @@ class CheckpointManager:
             self._pending = t
             t.start()
 
-    def wait(self) -> None:
-        """Join an in-flight async save; re-raise its error, if any."""
+    def wait(self, timeout_s: Optional[float] = None) -> None:
+        """Join an in-flight async save; re-raise its error, if any.
+
+        Bounded: ``timeout_s`` (default :data:`WAIT_TIMEOUT_S`) caps the
+        join — a writer thread wedged on dead storage raises
+        ``TimeoutError`` instead of hanging every later save/restore
+        (and the train loop with them) forever."""
         t = self._pending
         if t is not None:
-            t.join()
+            t.join(self.WAIT_TIMEOUT_S if timeout_s is None
+                   else timeout_s)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"checkpoint writer thread {t.name!r} still running "
+                    f"after {timeout_s or self.WAIT_TIMEOUT_S:.0f}s — "
+                    f"storage wedged?")
             self._pending = None
         if self._pending_error is not None:
             err, self._pending_error = self._pending_error, None
@@ -595,7 +616,7 @@ class CheckpointManager:
         Multi-host worlds make the default restore COLLECTIVE: every
         rank must call it, and all adopt the quorum step (the newest one
         every rank verifies — see :meth:`_quorum_step`)."""
-        self.wait()
+        self.wait(timeout_s=self.WAIT_TIMEOUT_S)
         if step is not None:
             return self._load_step(step, verify=verify)
         import jax

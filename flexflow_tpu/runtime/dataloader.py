@@ -28,7 +28,8 @@ class SingleDataLoader:
                  shuffle: bool = False, seed: int = 0,
                  drop_remainder: bool = True, prefetch: int = 2):
         sizes = {k: v.shape[0] for k, v in arrays.items()}
-        assert len(set(sizes.values())) == 1, f"ragged dataset: {sizes}"
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"ragged dataset: {sizes}")
         self.arrays = arrays
         self.num_samples = next(iter(sizes.values()))
         self.batch_size = batch_size
@@ -94,16 +95,17 @@ class SingleDataLoader:
         }
 
     def load_state_dict(self, sd) -> None:
-        assert sd.get("num_samples", self.num_samples) \
-            == self.num_samples, \
-            (f"loader state for {sd.get('num_samples')} samples restored "
-             f"into a {self.num_samples}-sample dataset")
+        if sd.get("num_samples", self.num_samples) != self.num_samples:
+            raise ValueError(
+                f"loader state for {sd.get('num_samples')} samples "
+                f"restored into a {self.num_samples}-sample dataset")
         # idx counts BATCHES: a different batch size would silently
         # reposition the sample stream
-        assert sd.get("batch_size", self.batch_size) == self.batch_size, \
-            (f"loader state saved with batch_size "
-             f"{sd.get('batch_size')} restored into a loader with "
-             f"batch_size {self.batch_size}")
+        if sd.get("batch_size", self.batch_size) != self.batch_size:
+            raise ValueError(
+                f"loader state saved with batch_size "
+                f"{sd.get('batch_size')} restored into a loader with "
+                f"batch_size {self.batch_size}")
         self.idx = int(sd["idx"])
         self.epoch = int(sd.get("epoch", 0))
         self.rng.bit_generator.state = sd["rng_state"]

@@ -94,7 +94,9 @@ def optimize_strategy(ff):
               f"best {best_cost * 1e3:.3f} ms vs DP {dp_cost * 1e3:.3f} ms "
               f"({dp_cost / max(best_cost, 1e-12):.2f}x)")
     errs = strategy.validate()
-    assert not errs, errs
+    if errs:
+        raise RuntimeError(f"search produced an unsound strategy: "
+                           f"{errs}")
     if cfg.export_strategy_file:
         save_strategy(cfg.export_strategy_file, strategy, best,
                       {"best_cost": best_cost, "dp_cost": dp_cost})

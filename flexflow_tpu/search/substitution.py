@@ -319,7 +319,8 @@ class GraphXfer:
             if tx.op is not None and tx.op in mapping:
                 return ("node", mapping[tx.op], tx.idx)
             b = bindings.get(tx.uid)
-            assert b is not None, f"unbound pattern input {tx}"
+            if b is None:
+                raise RuntimeError(f"unbound pattern input {tx}")
             return b
 
         # Instantiate dst ops in dependency order.
@@ -380,7 +381,10 @@ class GraphXfer:
         for stx, dtx in self.mapped_outputs:
             src_node = mapping[stx.op]
             d = resolve(dtx)
-            assert d[0] == "node"
+            if d[0] != "node":
+                raise RuntimeError(
+                    f"substitution output resolved to {d[0]}, expected "
+                    f"a node binding")
             d_node, d_idx = d[1], d[2]
             for e in list(g.out_edges.get(src_node, ())):
                 if e.src_idx == stx.idx and e.dst not in matched:

@@ -670,7 +670,9 @@ class UnitySearch:
                 best_merged = _merge_split(best_pair[0], best_pair[1],
                                            graph,
                                            [t.guid for t in cut_tensors])
-        assert best_merged is not None
+        if best_merged is None:
+            raise RuntimeError(
+                "sequence split produced no merged graph")
         res = (best_merged, best_cost)
         self._memo[key] = res
         self._store(skey, graph, order, res)
@@ -692,8 +694,9 @@ def _merge_split(pre: Graph, post: Graph, original: Graph,
             for e in edges:
                 g.add_edge(e.src, e.dst, e.src_idx, e.dst_idx)
     # pre's declared outputs by ORIGINAL crossing-tensor guid (positional)
-    assert len(cut_guids) == len(pre.outputs), \
-        f"cut arity changed: {len(cut_guids)} vs {len(pre.outputs)}"
+    if len(cut_guids) != len(pre.outputs):
+        raise RuntimeError(f"cut arity changed: {len(cut_guids)} vs "
+                           f"{len(pre.outputs)}")
     pre_out: Dict[int, Tuple[PNode, int]] = {}
     for guid, (n, i) in zip(cut_guids, pre.outputs):
         pre_out[guid] = (n, i)

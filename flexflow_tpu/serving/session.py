@@ -31,7 +31,8 @@ class InferenceSession:
     """
 
     def __init__(self, ff, batch_buckets: Sequence[int] = (1, 4, 16, 64)):
-        assert ff.executor is not None, "compile() the model first"
+        if ff.executor is None:
+            raise ValueError("compile() the model first")
         self.ff = ff
         self.buckets = sorted(set(int(b) for b in batch_buckets))
         self._fwd = ff.executor.make_forward()

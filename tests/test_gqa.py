@@ -78,5 +78,5 @@ def test_gqa_indivisible_heads_rejected():
     lc = LlamaConfig.tiny()
     lc.num_kv_heads = 3              # 4 % 3 != 0
     ff = FFModel(FFConfig())
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         build_llama(ff, BATCH, SEQ, lc, fused_attention=True)

@@ -140,6 +140,6 @@ def test_circular_bubble_shorter_schedule():
     slots. Here: just the M % S == 0 guard."""
     devs = np.asarray(jax.devices()[:4]).reshape(1, 4)
     mesh = Mesh(devs, ("dp", "pp"))
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         PipelinedBlocks(mesh, _stage_fn, n_stages=4, n_microbatches=6,
                         n_chunks=2)

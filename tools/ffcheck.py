@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""ffcheck: static plan verifier + framework-invariant linter CLI.
+
+The command-line front end of ``flexflow_tpu.analysis`` (see
+``docs/static_analysis.md``), run by ``ci.sh``'s fast tier as a hard
+gate:
+
+    python tools/ffcheck.py --lint flexflow_tpu/ --verify-strategies
+
+  --lint PATH [PATH ...]   run the invariant linter over files/trees
+  --rules r1,r2            restrict the lint rule set
+  --verify-strategies [DIR]
+                           statically verify every strategy JSON under
+                           DIR (default: strategies/): structural
+                           mesh/spec soundness always; full shape-level
+                           verification (divisibility, seams, memory,
+                           collective order) for strategies whose
+                           workload builder is known (bert/dlrm)
+  --json                   machine-readable report on stdout
+  --verbose                print per-strategy pass lines
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# workload builders for the checked-in strategies: filename prefix →
+# the graph the strategy was searched on (regeneration commands are in
+# tests/test_strategies_repo.py)
+# ---------------------------------------------------------------------------
+
+def _build_dlrm():
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models import DLRMConfig, build_dlrm
+    ff = FFModel(FFConfig())
+    out = build_dlrm(ff, 32, DLRMConfig())
+    return ff, out
+
+
+def _build_bert():
+    # batch/seq must match the searched program (its reshapes bake the
+    # batch in); the checked-in artifact was searched at (4, 128)
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models import BertConfig, build_bert
+    ff = FFModel(FFConfig())
+    out = build_bert(ff, 4, 128, BertConfig.base())
+    return ff, out
+
+
+BUILDERS = {"dlrm": _build_dlrm, "bert": _build_bert}
+
+
+def _full_verify(path: str, doc: dict, builder):
+    """Shape-level verification: rebuild the workload graph, load the
+    saved strategy (and its serialized rewritten program) against a
+    structural mesh, and run the full plan verifier. No jax devices are
+    required — nothing executes."""
+    from flexflow_tpu.analysis.plan_verifier import (StructMesh,
+                                                     verify_plan)
+    from flexflow_tpu.search.serialization import (load_strategy,
+                                                   program_from_json)
+    ff, out = builder()
+    consumed = {t.guid for l in ff.layers for t in l.inputs}
+    graph_inputs = [t for t in ff.input_tensors
+                    if t.guid in consumed and t.get_tensor() is None]
+    const_inputs = [t for t in ff.input_tensors
+                    if t.guid in consumed and t.get_tensor() is not None]
+    dmesh = StructMesh(doc["mesh_axes"])
+    strategy = load_strategy(path, ff.layers, dmesh)
+    layers = ff.layers
+    if doc.get("program"):
+        layers, _ = program_from_json(doc["program"],
+                                      graph_inputs + const_inputs)
+    return verify_plan(strategy, layers, machine_spec=dmesh.spec,
+                       graph_inputs=graph_inputs,
+                       context=os.path.basename(path))
+
+
+def verify_strategies(directory: str, verbose: bool = False,
+                      stream=None):
+    """Verify every ``*.json`` strategy under ``directory``. Returns
+    (reports, failures) where reports is {path: PlanReport}. Progress/
+    failure lines go to ``stream`` (default stdout; ``--json`` passes
+    stderr so stdout stays one parseable document)."""
+    stream = stream or sys.stdout
+    from flexflow_tpu.analysis.plan_verifier import verify_strategy_file
+    reports = {}
+    failures = []
+    names = sorted(fn for fn in os.listdir(directory)
+                   if fn.endswith(".json"))
+    for fn in names:
+        path = os.path.join(directory, fn)
+        with open(path) as f:
+            doc = json.load(f)
+        report = verify_strategy_file(path, doc=doc)
+        builder = next((b for prefix, b in BUILDERS.items()
+                        if fn.startswith(prefix)), None)
+        if builder is not None and report.ok():
+            try:
+                full = _full_verify(path, doc, builder)
+                report.findings.extend(full.findings)
+                report.memory = full.memory
+                report.collectives = full.collectives
+                report.duration_s += full.duration_s
+            except Exception as e:  # noqa: BLE001 — surface as finding
+                report.add("seam", "error", path,
+                           f"full verification crashed: "
+                           f"{type(e).__name__}: {e}")
+        reports[path] = report
+        if report.errors:
+            failures.append(path)
+        if verbose or report.errors:
+            status = "FAIL" if report.errors else "ok"
+            print(f"ffcheck: verify {path}: {status} "
+                  f"({len(report.findings)} finding(s), "
+                  f"{report.duration_s * 1e3:.0f} ms)", file=stream)
+            for f_ in report.findings:
+                print(f"  {f_.format()}", file=stream)
+    return reports, failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ffcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--lint", nargs="+", metavar="PATH",
+                    help="lint these files/trees")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated lint rule subset")
+    ap.add_argument("--verify-strategies", nargs="?", metavar="DIR",
+                    const=os.path.join(REPO, "strategies"), default=None,
+                    help="verify strategy JSONs (default dir: "
+                         "strategies/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="JSON report on stdout")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.lint and not args.verify_strategies:
+        ap.error("nothing to do: pass --lint and/or --verify-strategies")
+
+    rc = 0
+    doc = {}
+    if args.lint:
+        from flexflow_tpu.analysis.lint import (lint_paths, render_json,
+                                                render_text)
+        rules = [r.strip() for r in args.rules.split(",")] \
+            if args.rules else None
+        findings = lint_paths(args.lint, rules=rules)
+        if args.as_json:
+            doc["lint"] = json.loads(render_json(findings))
+        else:
+            print(render_text(findings))
+        if findings:
+            rc = 1
+    if args.verify_strategies:
+        if not os.path.isdir(args.verify_strategies):
+            print(f"ffcheck: strategy directory "
+                  f"{args.verify_strategies!r} does not exist",
+                  file=sys.stderr)
+            return 2
+        reports, failures = verify_strategies(
+            args.verify_strategies, verbose=args.verbose,
+            stream=sys.stderr if args.as_json else sys.stdout)
+        if args.as_json:
+            doc["verify"] = {p: r.to_json() for p, r in reports.items()}
+        elif not failures:
+            print(f"ffcheck: {len(reports)} strategy file(s) verified")
+        if failures:
+            rc = 1
+    if args.as_json:
+        doc["ok"] = rc == 0
+        print(json.dumps(doc, indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

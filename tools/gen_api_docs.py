@@ -38,6 +38,8 @@ SECTIONS = [
      "telemetry (spans, Prometheus metrics, strategy audit records)"),
     ("flexflow_tpu.resilience",
      "fault injection, supervisor auto-resume, elastic re-plan"),
+    ("flexflow_tpu.analysis",
+     "static analysis (plan verifier, framework-invariant linter)"),
     ("flexflow_tpu.utils", "profiling, logging, compilation cache"),
 ]
 

@@ -45,6 +45,12 @@ case "${1:-fast}" in
     # BIT-IDENTICAL to the FF_NAIVE_RESHARD=1 baseline — both the raw
     # transition matrix and a pipelined model's region boundaries
     python tools/reshard_parity_smoke.py
+    # hierarchical-placement smoke: a 2-slice virtual config runs the
+    # placement-aware search end-to-end — search -> static plan verify
+    # -> one train step — and the gradient sync must lower to a
+    # multi-phase reduction tree (docs/topology.md); the heavyweight
+    # >= 1.1x gate lives in the multichip dryrun tier
+    python tools/placement_smoke.py
     # serving chaos smoke: injected inference failures must open the
     # per-model circuit breaker (fast 503 + Retry-After), the half-open
     # probe after the cooldown must restore service, and drain() must

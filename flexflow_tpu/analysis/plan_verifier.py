@@ -34,6 +34,16 @@ a step:
      epilogue (``lax.cond`` on the stage index) — must not contain
      collective ops, and subset axes must not collide with the pipeline
      axes (the banks×pipeline double transition, PR 6's NaN bug).
+  5. **placement** — hierarchical-placement soundness (arXiv
+     2110.10548, ``parallel/placement.py``): ``axis_tiers`` must map
+     real mesh axes to known hardware tiers, every serialized
+     reduction-tree phase must stay within a tier its site's tier path
+     covers (a phase whose subset crosses an uncovered tier would
+     deadlock or silently traverse the wrong fabric), and a
+     latency-bound per-op collective placed across DCN — one whose
+     payload is below the DCN bandwidth-latency product, so every step
+     pays pure inter-slice latency — is a compile-time error with the
+     offending tier attributed.
 
 ``FFModel.compile`` runs this post-search (``FFConfig.plan_verify``,
 ``FF_PLAN_VERIFY=0`` to disable); failures raise
@@ -247,6 +257,10 @@ def verify_plan(strategy, layers: Sequence, *,
     _check_collective_order(report, strategy, layers, by_name, axis_sizes)
     _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
                   hbm_bytes, reshard_peak)
+    _check_placement(report,
+                     getattr(strategy, "axis_tiers", None) or {},
+                     getattr(strategy, "collective_trees", None) or (),
+                     axis_sizes, spec)
 
     report.duration_s = time.perf_counter() - t0
     REGISTRY.counter("ff_plan_verify_runs_total",
@@ -664,6 +678,124 @@ def _check_collective_order(report, strategy, layers, by_name,
                     "pipeline-prologue")
 
 
+# -- check 5: hierarchical placement -----------------------------------------
+
+def _dcn_tier_constants(spec) -> Tuple[float, float]:
+    """(bandwidth bytes/s, latency s) of the DCN tier: the machine
+    model's tier graph when available, else the MachineSpec defaults —
+    strategy-file verification has no machine behind it but the
+    latency-bound check must still bind."""
+    try:
+        tg = spec.tier_graph
+        for t in tg.tiers:
+            if t.name == "dcn":
+                return t.bandwidth, t.latency_s
+    except Exception:  # noqa: BLE001
+        pass
+    bw = getattr(spec, "dcn_bandwidth", None) or 25e9
+    lat = (getattr(spec, "dcn_latency_us", None) or 10.0) * 1e-6
+    return float(bw), float(lat)
+
+
+def _check_placement(report, axis_tiers, collective_trees, axis_sizes,
+                     spec) -> None:
+    from ..parallel.topology import TIER_ORDER
+    for axis, tier in dict(axis_tiers).items():
+        if axis_sizes and axis not in axis_sizes:
+            report.add("placement", "error", axis,
+                       f"axis_tiers names axis {axis!r} absent from the "
+                       f"mesh (axes: {sorted(axis_sizes)})",
+                       "axis-placement")
+        if tier not in TIER_ORDER:
+            report.add("placement", "error", axis,
+                       f"axis {axis!r} is placed on unknown tier "
+                       f"{tier!r} (tiers: {list(TIER_ORDER)})",
+                       "axis-placement")
+    dcn_bw, dcn_lat = _dcn_tier_constants(spec)
+    # devices reachable WITHOUT crossing DCN: a collective whose degree
+    # fits inside this span had an inner placement available — crossing
+    # DCN anyway is a placement error; a wider collective has no choice
+    # (flagging it would reject every full-mesh reduction)
+    inner_span = 1
+    for axis, tier in dict(axis_tiers).items():
+        if tier != "dcn":
+            inner_span *= int(axis_sizes.get(axis, 1))
+    for rec in collective_trees:
+        site = str(rec.get("site", "?"))
+        coll = str(rec.get("collective", "?"))
+        name = f"{site}/{coll}"
+        path = [(str(t), int(d)) for t, d in rec.get("tier_path", ())]
+        covered = {t for t, _ in path}
+        bad_tiers = sorted(t for t in covered if t not in TIER_ORDER)
+        if bad_tiers:
+            report.add("placement", "error", name,
+                       f"tier path {path} names unknown tier(s) "
+                       f"{bad_tiers}", "reduction-tree")
+            continue
+        deg_of = dict(path)
+        total_deg = 1
+        for _t, d in path:
+            total_deg *= d
+        outermost = path[-1][0] if path else None
+        for ph in rec.get("phases", ()):
+            pt = str(ph.get("tier"))
+            ph_deg = int(ph.get("degree", 1))
+            # a single-phase ring / halving-doubling tree SPANS the
+            # whole path through its bottleneck (outermost) tier: its
+            # degree is the path's total product, which is legal there
+            spans_path = pt == outermost and ph_deg == total_deg
+            if pt not in covered:
+                report.add(
+                    "placement", "error", name,
+                    f"tree phase {ph.get('collective')}[x"
+                    f"{ph.get('degree')}] runs on tier {pt!r}, which "
+                    f"the site's tier path {path} does not cover — the "
+                    f"phase's participant subset would traverse a "
+                    f"fabric the placement never reserved",
+                    "reduction-tree")
+            elif ph_deg > deg_of.get(pt, 1) and not spans_path:
+                report.add(
+                    "placement", "error", name,
+                    f"tree phase {ph.get('collective')} degree "
+                    f"{ph.get('degree')} exceeds the {pt} tier's "
+                    f"degree {deg_of.get(pt, 1)} in path {path}",
+                    "reduction-tree")
+        # latency-bound per-op collective across DCN when an inner
+        # placement existed: the payload is below the DCN bandwidth-
+        # latency product, so the inter-slice leg is pure latency EVERY
+        # step — a placement the search must never ship. Collectives
+        # wider than the intra-slice span have no inner option and are
+        # a strategy (not placement) matter; grad sync, once per step
+        # on the whole gradient, only warns.
+        avoidable = axis_tiers and \
+            int(rec.get("degree", 0) or 0) <= inner_span
+        if "dcn" in covered and site != "grad_sync" and avoidable:
+            vol = float(rec.get("volume_bytes", 0.0) or 0.0)
+            d_dcn = deg_of.get("dcn", 1)
+            bound = dcn_bw * dcn_lat * max(d_dcn, 1)
+            if 0 < vol < bound:
+                report.add(
+                    "placement", "error", name,
+                    f"latency-bound per-step collective placed across "
+                    f"tier 'dcn': payload {vol / 1024:.1f} KiB is below "
+                    f"the DCN bandwidth-latency product "
+                    f"({bound / 1024:.0f} KiB at "
+                    f"{dcn_bw / 1e9:.0f} GB/s x {dcn_lat * 1e6:.0f} us "
+                    f"x{d_dcn}) — every step pays pure inter-slice "
+                    f"latency; place this collective on an inner tier",
+                    "latency-bound-dcn")
+        elif "dcn" in covered and site == "grad_sync":
+            vol = float(rec.get("volume_bytes", 0.0) or 0.0)
+            d_dcn = deg_of.get("dcn", 1)
+            if 0 < vol < dcn_bw * dcn_lat * max(d_dcn, 1):
+                report.add(
+                    "placement", "warn", name,
+                    f"gradient sync across DCN is latency-bound at "
+                    f"{vol / 1024:.1f} KiB — consider a larger "
+                    f"per-step gradient volume or intra-slice "
+                    f"replication", "latency-bound-dcn")
+
+
 # ---------------------------------------------------------------------------
 # wiring helpers
 # ---------------------------------------------------------------------------
@@ -767,6 +899,21 @@ def verify_strategy_file(path: str, doc: Optional[Dict] = None
             report.add("seam", "error", name,
                        f"bank degree {B} does not divide member count "
                        f"{K}", "bank-boundary")
+    # placement annotations (axis_tiers / collective_trees): tier
+    # soundness, tree-phase coverage, and the latency-bound-across-DCN
+    # rejection — the machine constants come from the file's meta block
+    # when present, else the MachineSpec defaults
+    spec = None
+    meta = doc.get("meta") or {}
+    if meta.get("machine_file"):
+        try:
+            from ..parallel.machine import MachineSpec
+            spec = MachineSpec.from_file(meta["machine_file"])
+        except Exception:  # noqa: BLE001 — fall to defaults
+            spec = None
+    _check_placement(report, doc.get("axis_tiers") or {},
+                     doc.get("collective_trees") or (), axis_sizes,
+                     spec)
     report.duration_s = time.perf_counter() - t0
     return report
 

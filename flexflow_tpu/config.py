@@ -69,6 +69,13 @@ class FFConfig:
     # memory-bandwidth/parallel-efficiency terms + persisted collective
     # tables, search/calibration.py). "auto" honors FF_CALIBRATION_V2.
     calibration_v2: str = "auto"  # "auto" | "true" | "false"
+    # hierarchical topology-aware placement (parallel/placement.py,
+    # arXiv 2110.10548): the search assigns mesh axes to hardware tiers
+    # (ici/host/dcn) and picks a reduction-tree shape per collective.
+    # "auto" enables it whenever the machine has more than one tier
+    # (multi-slice / multi-host); single-tier machines are unaffected
+    # either way. FF_HIER_PLACEMENT=0 is the env override.
+    hier_placement: str = "auto"  # "auto" | "true" | "false"
     # -------- observability (obs/) --------
     # span/counter tracing (obs/events.py): "true"/"false" force the
     # PROCESS-WIDE recorder on/off at compile (one recorder per
@@ -278,6 +285,10 @@ class FFConfig:
                 cfg.simulator_max_num_segments = int(take())
             elif a == "--calibration-v2":
                 cfg.calibration_v2 = take().lower()
+            elif a == "--hier-placement":
+                cfg.hier_placement = take().lower()
+            elif a == "--no-hier-placement":
+                cfg.hier_placement = "false"
             elif a == "--trace":
                 cfg.trace = "true"
             elif a == "--no-trace":

@@ -61,6 +61,11 @@ class MachineSpec:
     # (``--machine-model-file``, parallel/topology.py:load_machine_file)
     ici_bandwidth_override: Optional[float] = None
     peak_flops_override: Optional[float] = None
+    # cross-host-within-slice fabric override (bytes/s, us): unset on
+    # TPU pods (ICI spans hosts inside a slice), set by reference-style
+    # machine files whose inter-host fabric is a NIC
+    host_bandwidth_override: Optional[float] = None
+    host_latency_override_us: Optional[float] = None
     # explicit fabric (parallel/topology.py GraphTopology): big-switch,
     # degraded-link, or custom connection matrices — the reference's
     # NetworkedMachineModel (simulator.h:381-515). None = derive from
@@ -120,6 +125,27 @@ class MachineSpec:
             topo = TorusTopology(tuple(self.ici_shape))
         object.__setattr__(self, "_topology_cache", (key, topo))
         return topo
+
+    @property
+    def tier_graph(self):
+        """The machine's bandwidth-tier ladder
+        (:class:`~flexflow_tpu.parallel.topology.TierGraph`): ici /
+        host / dcn with per-tier bandwidth+latency — what the placement
+        search, cost model and plan verifier query instead of a single
+        flat number. Memoized per spec, keyed on every field the ladder
+        derives from (same invalidation discipline as ``topology``)."""
+        from .topology import TierGraph
+        key = (self.num_devices, self.num_slices, self.num_hosts,
+               self.ici_bandwidth, self.dcn_bandwidth,
+               self.ici_latency_us, self.dcn_latency_us,
+               self.host_bandwidth_override,
+               self.host_latency_override_us)
+        cached = self.__dict__.get("_tier_graph_cache")
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        tg = TierGraph.from_machine_spec(self)
+        object.__setattr__(self, "_tier_graph_cache", (key, tg))
+        return tg
 
     @classmethod
     def from_file(cls, path: str) -> "MachineSpec":
@@ -218,17 +244,76 @@ class DeviceMesh:
     def num_devices(self) -> int:
         return int(np.prod(list(self.axis_sizes.values()))) if self.axis_sizes else 1
 
-    def allocate_axes(self, degree: int,
-                      used: Sequence[str]) -> Optional[Tuple[str, ...]]:
+    @property
+    def axis_tiers(self) -> Dict[str, str]:
+        """Physical tier of each atomic mesh axis ("ici" / "host" /
+        "dcn"), derived from the axis block strides against the spec's
+        slice/host structure: devices are flat slice-major, host-major,
+        chip-minor, and an axis whose stride reaches past
+        ``devices_per_slice`` hops slices (DCN), past chips-per-host
+        hops hosts. Memoized — the mesh is immutable after build."""
+        cached = self.__dict__.get("_axis_tiers")
+        if cached is not None:
+            return cached
+        spec = self.spec
+        per_slice = max(1, spec.devices_per_slice)
+        hosts_per_slice = max(1, spec.num_hosts
+                              // max(1, spec.num_slices))
+        chips_per_host = max(1, per_slice // hosts_per_slice)
+        tiers: Dict[str, str] = {}
+        names = list(self.axis_sizes.keys())
+        sizes = [self.axis_sizes[a] for a in names]
+        for i, a in enumerate(names):
+            stride = 1
+            for s in sizes[i + 1:]:
+                stride *= s
+            reach = stride * sizes[i]          # devices the axis spans
+            if reach > per_slice and spec.num_slices > 1:
+                tiers[a] = "dcn"
+            elif reach > chips_per_host:
+                tiers[a] = "host"
+            else:
+                tiers[a] = "ici"
+        self.__dict__["_axis_tiers"] = tiers
+        return tiers
+
+    def axes_by_tier(self, innermost_first: bool = True
+                     ) -> List[Tuple[str, int]]:
+        """(axis, size) pairs ordered by physical tier (innermost =
+        fastest fabric first when ``innermost_first``) — the allocation
+        order placement-aware axis assignment uses."""
+        from .topology import TIER_RANK
+        tiers = self.axis_tiers
+        items = list(self.axis_sizes.items())
+        ranked = sorted(
+            range(len(items)),
+            key=lambda i: (TIER_RANK.get(tiers[items[i][0]], 99), i))
+        if not innermost_first:
+            ranked = ranked[::-1]
+        return [items[i] for i in ranked]
+
+    def allocate_axes(self, degree: int, used: Sequence[str],
+                      prefer: Optional[str] = None
+                      ) -> Optional[Tuple[str, ...]]:
         """Pick unused atomic axes whose sizes multiply to exactly `degree`.
 
         Greedy largest-first subset-product; returns None if impossible.
         This is the analog of the reference's machine-view enumeration
         (``FFModel::register_all_machine_views``) constrained to one mesh.
+
+        ``prefer`` orders candidates by physical tier: ``"inner"`` takes
+        the fastest fabric first (per-step per-op collectives belong on
+        ICI), ``"outer"`` the slowest first (once-per-step gradient sync
+        can afford the DCN axis). ``None`` keeps declaration order —
+        bit-identical to the historical behavior.
         """
         if degree == 1:
             return ()
-        avail = [(a, s) for a, s in self.axis_sizes.items() if a not in used]
+        if prefer in ("inner", "outer"):
+            items = self.axes_by_tier(innermost_first=(prefer == "inner"))
+        else:
+            items = list(self.axis_sizes.items())
+        avail = [(a, s) for a, s in items if a not in used]
         picked: List[str] = []
         rem = degree
 

@@ -1,0 +1,355 @@
+"""Hierarchical axis placement + per-collective reduction-tree selection.
+
+Following PAPERS.md "Synthesizing Optimal Parallelism Placement and
+Reduction Strategies on Hierarchical Systems" (arXiv 2110.10548), the
+search no longer scores collectives against a flat mesh: every atomic
+mesh axis has a *placement* — the hardware tier it spans (``ici`` /
+``host`` / ``dcn``, :class:`~flexflow_tpu.parallel.topology.TierGraph`)
+— and every collective gets a *reduction-tree shape* chosen per
+(collective kind, tier path, payload):
+
+  - ``ring``              — the classic flat ring, every round paying
+                            the path's bottleneck (outermost) tier;
+  - ``halving_doubling``  — recursive halving/doubling: same bandwidth
+                            term, ``log2(d)`` latency rounds instead of
+                            ``d-1`` (wins on latency-bound payloads);
+  - ``two_phase`` / ``three_phase`` — the paper's hierarchical trees:
+                            e.g. an all-reduce lowers to intra-tier
+                            reduce-scatter → inter-tier all-reduce on
+                            the tier-reduced volume → intra-tier
+                            all-gather, so only ``1/d_inner`` of the
+                            bytes ever cross the slow fabric.
+
+:class:`AxisPlacement` is the queryable placement assignment
+(axis → tier) the search state carries; :func:`choose_reduction_tree`
+is the per-collective selector the cost model calls. Per-tier costs
+answer from the calibrated tables when a tier-keyed entry exists
+(``search/calibration.py``), else from the tier's machine-model
+constants. Single-tier machines degenerate exactly to the flat-mesh
+behavior, so every existing single-slice prediction is bit-identical.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .topology import Tier, TierGraph, TIER_ORDER, TIER_RANK
+
+__all__ = ["AxisPlacement", "Phase", "TreeChoice",
+           "choose_reduction_tree", "tree_algorithms"]
+
+
+#: algorithms the selector enumerates (per-collective search space)
+TREE_ALGORITHMS = ("ring", "halving_doubling", "two_phase",
+                   "three_phase")
+
+
+def tree_algorithms() -> Tuple[str, ...]:
+    return TREE_ALGORITHMS
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One staged collective of a reduction tree: ``collective`` over
+    ``degree`` participants confined to ``tier``, moving
+    ``volume_bytes`` per group."""
+    collective: str
+    tier: str
+    degree: int
+    volume_bytes: float
+
+    def to_json(self) -> Dict:
+        return {"collective": self.collective, "tier": self.tier,
+                "degree": self.degree,
+                "volume_bytes": float(self.volume_bytes)}
+
+
+@dataclasses.dataclass
+class TreeChoice:
+    """The selected reduction tree for one collective site."""
+    algo: str                      # one of TREE_ALGORITHMS
+    phases: List[Phase]
+    cost_s: float
+    flat_cost_s: float             # the flat-ring baseline at the same site
+
+    def describe(self) -> List[str]:
+        return [f"{p.collective}[{p.tier} x{p.degree}]"
+                for p in self.phases]
+
+    def to_json(self) -> Dict:
+        return {"algo": self.algo,
+                "phases": [p.to_json() for p in self.phases],
+                "cost_s": float(self.cost_s),
+                "flat_cost_s": float(self.flat_cost_s)}
+
+
+class AxisPlacement:
+    """The search state's axis-placement assignment: mesh axis → tier,
+    plus the tier ladder to price against. Built from a
+    :class:`~flexflow_tpu.parallel.machine.DeviceMesh` (physical
+    placement) and queried as (tier, degree) *paths* for collectives."""
+
+    def __init__(self, axis_tiers: Dict[str, str],
+                 axis_sizes: Dict[str, int], tier_graph: TierGraph):
+        self.axis_tiers = dict(axis_tiers)
+        self.axis_sizes = dict(axis_sizes)
+        self.tier_graph = tier_graph
+        unknown = [t for t in self.axis_tiers.values()
+                   if t not in tier_graph.names]
+        if unknown:
+            raise ValueError(
+                f"axis placement names tiers {sorted(set(unknown))} "
+                f"absent from the machine's tier graph "
+                f"{list(tier_graph.names)}")
+
+    @classmethod
+    def from_dmesh(cls, dmesh) -> Optional["AxisPlacement"]:
+        spec = getattr(dmesh, "spec", None)
+        if spec is None:
+            return None
+        try:
+            return cls(dmesh.axis_tiers, dict(dmesh.axis_sizes),
+                       spec.tier_graph)
+        except Exception:  # noqa: BLE001 — placement is best-effort
+            return None
+
+    @property
+    def multi_tier(self) -> bool:
+        return len({t for t in self.axis_tiers.values()}) > 1
+
+    def tier_of(self, axis: str) -> str:
+        return self.axis_tiers.get(axis, self.tier_graph.tiers[0].name)
+
+    # ------------------------------------------------------------------
+    def path_for_axes(self, axes: Sequence[str]
+                      ) -> List[Tuple[Tier, int]]:
+        """(tier, degree) path of a collective spanning ``axes``,
+        ordered innermost tier first; axes of one tier fold into one
+        leg (they form one contiguous sub-torus of that fabric)."""
+        per_tier: Dict[str, int] = {}
+        for a in axes:
+            per_tier[self.tier_of(a)] = (per_tier.get(self.tier_of(a), 1)
+                                         * self.axis_sizes.get(a, 1))
+        out = []
+        for name in sorted(per_tier, key=lambda t: TIER_RANK.get(t, 99)):
+            if per_tier[name] > 1:
+                out.append((self.tier_graph.tier(name), per_tier[name]))
+        return out
+
+    def path_for_degree(self, degree: int, prefer: str = "inner"
+                        ) -> List[Tuple[Tier, int]]:
+        """The (tier, degree) path a degree-``degree`` collective takes
+        under this placement policy: axes consumed innermost-first
+        (``prefer="inner"`` — per-op collectives) or outermost-first
+        (``"outer"`` — e.g. pricing a flat/legacy allocation). When the
+        degree does not factor exactly over a prefix, the remainder
+        folds into the last consumed tier (conservative)."""
+        if degree <= 1:
+            return []
+        ranked = sorted(self.axis_sizes.items(),
+                        key=lambda kv: TIER_RANK.get(self.tier_of(kv[0]), 99))
+        if prefer == "outer":
+            ranked = ranked[::-1]
+        per_tier: Dict[str, int] = {}
+        rem = degree
+        for a, s in ranked:
+            if rem <= 1:
+                break
+            take = math.gcd(rem, s)
+            if take > 1:
+                t = self.tier_of(a)
+                per_tier[t] = per_tier.get(t, 1) * take
+                rem //= take
+        if rem > 1:                      # non-factoring remainder
+            last = (list(per_tier) or [self.tier_graph.tiers[0].name])[-1]
+            per_tier[last] = per_tier.get(last, 1) * rem
+        out = []
+        for name in sorted(per_tier, key=lambda t: TIER_RANK.get(t, 99)):
+            out.append((self.tier_graph.tier(name), per_tier[name]))
+        return out
+
+    def to_json(self) -> Dict[str, str]:
+        return dict(self.axis_tiers)
+
+
+# ----------------------------------------------------------------------
+# reduction-tree selection
+# ----------------------------------------------------------------------
+
+def bandwidth_multiplier(collective: str, degree: int) -> float:
+    """Ring-algebra bytes multiplier of one collective: the fraction of
+    ``volume`` each participant moves is ``multiplier x (d-1)/d``. THE
+    shared table — ``_leg``, ``_ring_tree`` and the legacy
+    ``OpCostModel._ring_cost`` all price from it, so the placed costs
+    and the flat baseline they are compared against can never drift."""
+    return {"all_reduce": 2.0, "all_gather": 1.0,
+            "reduce_scatter": 1.0, "all_to_all": 1.0 / max(degree, 1),
+            "permute": 1.0 / max(degree, 1)}[collective]
+
+
+def tree_bandwidth_cost(phases: Sequence[Phase],
+                        tier_graph: TierGraph) -> float:
+    """Bandwidth-only (latency-free) cost of a tree — the per-byte
+    MARGINAL a coalesced per-step collective pays, used for gradient
+    sync where XLA's combiner amortizes the per-leg latency rounds
+    across the whole step (see ``OpCostModel.weight_sync_cost``)."""
+    total = 0.0
+    for p in phases:
+        if p.degree <= 1 or p.volume_bytes <= 0:
+            continue
+        tier = tier_graph.tier(p.tier)
+        total += (bandwidth_multiplier(p.collective, p.degree)
+                  * (p.degree - 1) / p.degree
+                  * p.volume_bytes / tier.bandwidth)
+    return total
+
+
+def _leg(cost_model, collective: str, degree: int, volume: float,
+         tier: Tier, rounds: Optional[int] = None) -> float:
+    """Cost of one tree leg confined to ``tier``: the calibrated
+    tier-keyed tables answer first (``MeshCalibration.collective_time``
+    with a tier), else the analytic ring algebra at the tier's
+    bandwidth/latency. ``rounds`` overrides the latency round count
+    (halving-doubling's log2(d))."""
+    if degree <= 1 or volume <= 0:
+        return 0.0
+    calib = getattr(cost_model, "calib", None)
+    if calib is not None:
+        t = calib.collective_time(collective, degree, volume,
+                                  tier=tier.name)
+        if t is not None:
+            return float(t)
+    frac = (degree - 1) / degree
+    mult = bandwidth_multiplier(collective, degree)
+    n_lat = rounds if rounds is not None else (degree - 1)
+    return mult * frac * volume / tier.bandwidth + n_lat * tier.latency_s
+
+
+def _ring_tree(collective, volume, path) -> Tuple[float, List[Phase]]:
+    """Flat ring spanning the whole path: every round traverses the
+    bottleneck (outermost) tier; latency accumulates per participant.
+    Priced analytically (never from a single-tier calibrated entry) so
+    the baseline stays comparable across machines."""
+    total_deg = 1
+    for _, d in path:
+        total_deg *= d
+    bottleneck = path[-1][0]
+    frac = (total_deg - 1) / total_deg
+    mult = bandwidth_multiplier(collective, total_deg)
+    cost = mult * frac * volume / bottleneck.bandwidth \
+        + (total_deg - 1) * bottleneck.latency_s
+    return cost, [Phase(collective, bottleneck.name, total_deg, volume)]
+
+
+def _halving_tree(cost_model, collective, volume, path
+                  ) -> Optional[Tuple[float, List[Phase]]]:
+    """Recursive halving/doubling across the whole span: bandwidth term
+    at the bottleneck tier, latency log2(d) rounds. Only defined for
+    power-of-two degrees and the reduction collectives."""
+    total_deg = 1
+    for _, d in path:
+        total_deg *= d
+    if total_deg & (total_deg - 1) or collective not in (
+            "all_reduce", "all_gather", "reduce_scatter"):
+        return None
+    bottleneck = path[-1][0]
+    cost = _leg(cost_model, collective, total_deg, volume, bottleneck,
+                rounds=max(1, int(math.log2(total_deg))))
+    return cost, [Phase(collective, bottleneck.name, total_deg, volume)]
+
+
+def _hier_tree(cost_model, collective, volume, path
+               ) -> Optional[Tuple[float, List[Phase]]]:
+    """The paper's hierarchical tree over a 2- or 3-tier path.
+
+    ``all_reduce``: reduce-scatter innermost → (recursive) all-reduce on
+    the tier-reduced volume per outer tier → all-gather innermost — the
+    DCN leg carries ``1/d_inner`` of the bytes. ``all_gather`` /
+    ``reduce_scatter`` / ``all_to_all``: per-tier staged legs, each
+    outer leg on the already-aggregated (or not-yet-inflated) volume.
+    """
+    if len(path) < 2:
+        return None
+    phases: List[Phase] = []
+    cost = 0.0
+    if collective == "all_reduce":
+        # recursive: rs@inner on V → all-reduce of the REMAINING path on
+        # V/d_inner (itself hierarchical on 3-tier paths) → ag@inner on
+        # V.  Only 1/d_inner of the bytes ever reach each outer tier.
+        (t_in, d_in) = path[0]
+        cost += _leg(cost_model, "reduce_scatter", d_in, volume, t_in)
+        phases.append(Phase("reduce_scatter", t_in.name, d_in, volume))
+        v = volume / d_in
+        rest = path[1:]
+        if len(rest) > 1:
+            inner = _hier_tree(cost_model, "all_reduce", v, rest)
+            cost += inner[0]
+            phases.extend(inner[1])
+        else:
+            (t, d) = rest[0]
+            cost += _leg(cost_model, "all_reduce", d, v, t)
+            phases.append(Phase("all_reduce", t.name, d, v))
+        cost += _leg(cost_model, "all_gather", d_in, volume, t_in)
+        phases.append(Phase("all_gather", t_in.name, d_in, volume))
+        return cost, phases
+    if collective == "all_gather":
+        # staged OUTERMOST first: the slow tier gathers while shards
+        # are smallest, so it moves (d_out - 1) x shard bytes instead
+        # of the flat ring's (total - 1) x shard. This is GSPMD's
+        # hierarchical all-gather on real pods (the partitioner owns
+        # the concat order); the repo's OWN tiled-suffix lowering
+        # (reshard._tier_staged) cannot realize it and is therefore
+        # priced separately and conservatively — see
+        # ReshardPlanner._score's bottleneck-ring rule.
+        total = 1
+        for _, d in path:
+            total *= d
+        v_local = volume / total
+        for (t, d) in path[::-1]:
+            group_v = v_local * d       # the leg's gathered payload
+            cost += _leg(cost_model, "all_gather", d, group_v, t)
+            phases.append(Phase("all_gather", t.name, d, group_v))
+            v_local = group_v
+        return cost, phases
+    if collective == "reduce_scatter":
+        # staged INNERMOST first (the all-gather tree's mirror): each
+        # outer leg scatters the already-reduced, shrunken payload
+        v = volume
+        for (t, d) in path:
+            cost += _leg(cost_model, "reduce_scatter", d, v, t)
+            phases.append(Phase("reduce_scatter", t.name, d, v))
+            v = v / d
+        return cost, phases
+    if collective in ("all_to_all", "permute"):
+        for (t, d) in path:
+            cost += _leg(cost_model, "all_to_all", d, volume, t)
+            phases.append(Phase("all_to_all", t.name, d, volume))
+        return cost, phases
+    return None
+
+
+def choose_reduction_tree(cost_model, collective: str, volume: float,
+                          path: Sequence[Tuple[Tier, int]]
+                          ) -> Optional[TreeChoice]:
+    """Pick the cheapest reduction-tree shape for one collective over a
+    (tier, degree) path. Returns None for empty/degenerate paths —
+    callers keep their flat-mesh pricing (single-tier machines stay
+    bit-identical to the historical model through that fallback)."""
+    path = [p for p in path if p[1] > 1]
+    if not path or volume <= 0:
+        return None
+    flat_cost, flat_phases = _ring_tree(collective, volume, path)
+    cands: List[Tuple[float, str, List[Phase]]] = [
+        (flat_cost, "ring", flat_phases)]
+    hd = _halving_tree(cost_model, collective, volume, path)
+    if hd is not None:
+        cands.append((hd[0], "halving_doubling", hd[1]))
+    hier = _hier_tree(cost_model, collective, volume, path)
+    if hier is not None:
+        name = "two_phase" if len(path) == 2 else "three_phase"
+        cands.append((hier[0], name, hier[1]))
+    cands.sort(key=lambda c: (c[0], TREE_ALGORITHMS.index(c[1])))
+    cost, algo, phases = cands[0]
+    return TreeChoice(algo=algo, phases=phases, cost_s=cost,
+                      flat_cost_s=flat_cost)

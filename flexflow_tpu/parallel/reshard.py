@@ -246,6 +246,38 @@ def _candidate_steps(src, dst, priority: Sequence[str]
     return steps
 
 
+def _tier_staged(steps: Sequence[Step],
+                 axis_tiers: Dict[str, str]) -> Optional[List[Step]]:
+    """Hierarchical lowering of a candidate: split every gather whose
+    axes span more than one hardware tier into per-tier staged gathers
+    (minor-most run first — the only order ``all_gather(tiled)``
+    realizes), so each leg is ONE portable collective confined to one
+    fabric and the cost model prices it at that tier's bandwidth
+    (arXiv 2110.10548's per-tier reduction phases). Returns None when
+    nothing splits (single-tier plans stay byte-identical)."""
+    out: List[Step] = []
+    changed = False
+    for st in steps:
+        if st.kind != "gather" or len(st.axes) < 2:
+            out.append(st)
+            continue
+        # group the axis tuple (major→minor) into consecutive same-tier
+        # runs; emit minor-most run first
+        runs: List[List[str]] = [[st.axes[0]]]
+        for a in st.axes[1:]:
+            if axis_tiers.get(a) == axis_tiers.get(runs[-1][-1]):
+                runs[-1].append(a)
+            else:
+                runs.append([a])
+        if len(runs) == 1:
+            out.append(st)
+            continue
+        changed = True
+        for run in runs[::-1]:
+            out.append(Step("gather", dim=st.dim, axes=tuple(run)))
+    return out if changed else None
+
+
 def _naive_steps(src, dst) -> List[Step]:
     """The generic gather/scatter lowering: fully replicate, then slice
     to the destination — what GSPMD's 'full rematerialization' does."""
@@ -336,6 +368,14 @@ class ReshardPlanner:
         self._audit_records: List[Dict[str, Any]] = []
         self.mesh_key = "x".join(
             f"{a}{s}" for a, s in dmesh.axis_sizes.items())
+        # multi-tier meshes key their plans per tier layout: a plan
+        # chosen for a flat mesh (or before the hierarchy existed) must
+        # not be replayed where tier-staged lowering applies;
+        # single-tier meshes keep their warm cache entries verbatim
+        tiers = self.axis_tiers
+        if tiers:
+            self.mesh_key += "|" + ",".join(
+                f"{a}={tiers[a]}" for a in sorted(tiers))
 
     # -- cost model (lazy: most transitions are planned at first trace)
     @property
@@ -355,8 +395,25 @@ class ReshardPlanner:
                     table=CalibrationTable(self._cache_dir))
             except Exception:  # noqa: BLE001 — calibration optional
                 pass
+            try:
+                from .placement import AxisPlacement
+                pl = AxisPlacement.from_dmesh(self.dmesh)
+                if pl is not None and pl.multi_tier:
+                    cm.attach_placement(pl, "hier")
+            except Exception:  # noqa: BLE001 — placement optional
+                pass
             self._cm = cm
         return self._cm
+
+    @property
+    def axis_tiers(self) -> Dict[str, str]:
+        """Mesh-axis → tier map for hierarchical step staging; empty on
+        single-tier machines and duck-typed meshes without one."""
+        try:
+            tiers = dict(self.dmesh.axis_tiers)
+            return tiers if len(set(tiers.values())) > 1 else {}
+        except Exception:  # noqa: BLE001
+            return {}
 
     # -- disk plan cache ------------------------------------------------
     @property
@@ -414,16 +471,31 @@ class ReshardPlanner:
                 deg *= sizes[a]
         local = global_bytes / max(deg, 1)
         peak, t = local, 0.0
+
+        def step_cost(kind: str, g: int, vol: float, axes) -> float:
+            # a step whose axes CROSS tiers executes as one XLA
+            # collective whose decomposition we do not control — price
+            # it conservatively as a flat ring at the bottleneck tier
+            # (the tier-staged candidate, one fabric per step, gets the
+            # per-tier pricing and wins whenever hierarchy pays)
+            pl = getattr(cm, "placement", None)
+            if pl is not None and axes:
+                path = pl.path_for_axes(axes)
+                if len(path) > 1:
+                    from .placement import _ring_tree
+                    return _ring_tree(kind, vol, path)[0]
+            return cm.reshard_step_cost(kind, g, vol, axes=axes)
+
         for st in steps:
             g = 1
             for a in st.axes:
                 g *= sizes[a]
             if st.kind == "gather":
                 out_local = local * g
-                t += cm.reshard_step_cost("all_gather", g, out_local)
+                t += step_cost("all_gather", g, out_local, st.axes)
             elif st.kind == "alltoall":
                 out_local = local
-                t += cm.reshard_step_cost("all_to_all", g, local * g)
+                t += step_cost("all_to_all", g, local * g, st.axes)
             else:
                 out_local = local / g
                 t += cm.reshard_step_cost("slice", g, local)
@@ -487,6 +559,7 @@ class ReshardPlanner:
         with obs_events.span("reshard.plan", src=layout_key(src),
                              dst=layout_key(dst)):
             candidates: List[Tuple[float, float, List[Step], str]] = []
+            tiers = self.axis_tiers
             for prio in (("alltoall", "slice", "gather"),
                          ("alltoall", "gather", "slice"),
                          ("gather", "slice", "alltoall")):
@@ -494,13 +567,35 @@ class ReshardPlanner:
                 if steps is not None:
                     t, peak = self._score(steps, shape, itemsize, src)
                     candidates.append((t, peak, steps, "searched"))
+                    if tiers:
+                        # hierarchical variant: tier-crossing gathers
+                        # staged per fabric (one portable collective
+                        # per tier leg — the executor-side lowering of
+                        # the searched reduction trees)
+                        staged = _tier_staged(steps, tiers)
+                        if staged is not None:
+                            t2, p2 = self._score(staged, shape,
+                                                 itemsize, src)
+                            candidates.append((t2, p2, staged,
+                                               "searched"))
             candidates.append((naive_t, naive_peak, naive, "naive"))
             # fastest plan whose peak transient memory never exceeds
             # the naive baseline's (every candidate qualifies by
-            # construction, but keep the guard explicit)
+            # construction, but keep the guard explicit); at equal
+            # predicted cost, prefer the plan with the FEWEST
+            # tier-crossing steps — an unstaged tier-crossing gather
+            # leaves the hierarchical decomposition to XLA, the staged
+            # variant pins it (one portable collective per fabric leg)
+            def crossing(steps: Sequence[Step]) -> int:
+                if not tiers:
+                    return 0
+                return sum(1 for st in steps
+                           if len({tiers.get(a) for a in st.axes}) > 1)
+
             ok = [c for c in candidates if c[1] <= naive_peak + 1e-9] \
                 or candidates
-            ok.sort(key=lambda c: (round(c[0], 9), c[1], len(c[2])))
+            ok.sort(key=lambda c: (round(c[0], 9), c[1],
+                                   crossing(c[2]), len(c[2])))
             t, peak, steps, kind = ok[0]
         plan = ReshardPlan(src, dst, steps, est_time_s=t,
                            peak_bytes=peak, naive_peak_bytes=naive_peak,

@@ -64,6 +64,17 @@ class ShardingStrategy:
         # PlaceGroup list): mixed op types on disjoint axis blocks,
         # lowered as a lax.switch shard_map region (MPMD-inside-SPMD)
         self.place_groups: List = []
+        # hierarchical placement annotations (parallel/placement.py,
+        # arXiv 2110.10548), set by a placement-aware search:
+        #   axis_tiers       — mesh axis -> hardware tier ("ici"/"host"/
+        #                      "dcn") the adopted placement assigned;
+        #   collective_trees — per-collective-site chosen reduction-tree
+        #                      records ({site, collective, degree,
+        #                      tier_path, algo, phases, cost_s, ...})
+        # Both serialize with the strategy and are statically checked by
+        # analysis/plan_verifier's placement pass.
+        self.axis_tiers: Dict[str, str] = {}
+        self.collective_trees: List[Dict] = []
 
     # ------------------------------------------------------------------
     def set_op(self, layer_name: str, outputs: Sequence[Optional[P]],
@@ -133,6 +144,13 @@ class ShardingStrategy:
 
     def describe(self) -> str:
         lines = [f"mesh axes: {dict(self.dmesh.axis_sizes)}"]
+        if self.axis_tiers:
+            lines.append(f"axis tiers: {dict(self.axis_tiers)}")
+        for ct in self.collective_trees:
+            lines.append(
+                f"  tree {ct.get('site')}/{ct.get('collective')}"
+                f" x{ct.get('degree')}: {ct.get('algo')} over "
+                f"{ct.get('tier_path')}")
         for name, os in self.ops.items():
             lines.append(f"  {name}: out={os.outputs} w={os.weights}")
         for bk in self.banks:

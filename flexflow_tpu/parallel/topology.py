@@ -37,6 +37,131 @@ Link = Tuple[int, int, int]  # (device, dim, direction ±1) — outgoing port
 # accumulate routes without limit across searches
 _RING_ROUTE_CACHE_CAP = 4096
 
+# shared Dijkstra cache bound: distance maps are keyed by the topology's
+# LINK TABLE fingerprint (+ node, direction), so rebuilt-but-identical
+# fabrics (MachineSpec memo invalidation, per-test topologies) reuse one
+# another's sweeps while a degraded() copy — different link table,
+# different fingerprint — can never alias a healthy fabric's distances
+_DIST_CACHE_CAP = 4096
+_SHARED_DIST_CACHE: Dict[Tuple, Dict[int, float]] = {}
+_ROUTES_CACHE_CAP = 8192
+
+
+# ----------------------------------------------------------------------
+# hardware tiers (arXiv 2110.10548: hierarchical placement + reduction)
+# ----------------------------------------------------------------------
+
+#: canonical tier names, innermost (fastest) first
+TIER_ORDER = ("ici", "host", "dcn")
+#: tier name -> rank (innermost = 0); THE ordering map every consumer
+#: shares (placement paths, axis allocation, calibration tier keys)
+TIER_RANK = {t: i for i, t in enumerate(TIER_ORDER)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Tier:
+    """One bandwidth/latency level of the machine hierarchy.
+
+    ``span`` is the number of devices reachable without leaving the
+    tier's domain (chips per host for ``ici``, devices per slice for
+    ``host``, the whole machine for ``dcn``) — the quantity placement
+    search compares collective degrees against."""
+    name: str            # "ici" | "host" | "dcn"
+    bandwidth: float     # bytes/s per link, one direction
+    latency_s: float     # per-hop latency in seconds
+    span: int            # devices reachable inside one tier domain
+
+    def rank(self) -> int:
+        return TIER_ORDER.index(self.name) \
+            if self.name in TIER_ORDER else len(TIER_ORDER)
+
+
+class TierGraph:
+    """First-class description of the machine's bandwidth tiers —
+    ICI-within-host / ICI-or-NIC-across-hosts / DCN-across-slices —
+    queryable by the placement search, cost model, plan verifier and
+    executor lowering (arXiv 2110.10548 models exactly this hierarchy).
+
+    Tiers are ordered innermost (fastest, smallest span) first. A
+    machine may collapse to a single tier (one host, one slice): every
+    consumer must then degenerate to flat-mesh behavior.
+    """
+
+    def __init__(self, tiers: Sequence[Tier]):
+        if not tiers:
+            raise ValueError("TierGraph needs at least one tier")
+        self.tiers: Tuple[Tier, ...] = tuple(
+            sorted(tiers, key=lambda t: (t.span, t.rank())))
+        names = [t.name for t in self.tiers]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tier names: {names}")
+
+    def __repr__(self) -> str:
+        return "TierGraph(" + ", ".join(
+            f"{t.name}: span={t.span} bw={t.bandwidth / 1e9:.3g}GB/s "
+            f"lat={t.latency_s * 1e6:.3g}us" for t in self.tiers) + ")"
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(t.name for t in self.tiers)
+
+    @property
+    def multi_tier(self) -> bool:
+        return len(self.tiers) > 1
+
+    def tier(self, name: str) -> Tier:
+        for t in self.tiers:
+            if t.name == name:
+                return t
+        raise ValueError(f"unknown tier {name!r} "
+                         f"(tiers: {list(self.names)})")
+
+    def innermost(self) -> Tier:
+        return self.tiers[0]
+
+    def outermost(self) -> Tier:
+        return self.tiers[-1]
+
+    def tier_for_span(self, span: int) -> Tier:
+        """The innermost tier whose domain covers ``span`` devices — the
+        tier a collective of that reach must traverse."""
+        for t in self.tiers:
+            if span <= t.span:
+                return t
+        return self.tiers[-1]
+
+    @classmethod
+    def from_machine_spec(cls, spec) -> "TierGraph":
+        """Derive the tier ladder from a ``MachineSpec``:
+
+          - ``ici``  — chips of one host (always present);
+          - ``host`` — crossing hosts inside a slice (present when a
+            slice spans several hosts; ICI bandwidth on TPU pods, the
+            host-fabric override — e.g. a reference INI's NIC — when
+            ``host_bandwidth_override`` is set);
+          - ``dcn``  — crossing slices over per-host NICs (present when
+            ``num_slices > 1``).
+        """
+        n = max(1, spec.num_devices)
+        per_slice = max(1, spec.devices_per_slice)
+        hosts_per_slice = max(1, spec.num_hosts // max(1, spec.num_slices))
+        chips_per_host = max(1, per_slice // hosts_per_slice)
+        ici_bw = spec.ici_bandwidth
+        ici_lat = spec.ici_latency_us * 1e-6
+        tiers = [Tier("ici", ici_bw, ici_lat, chips_per_host)]
+        if per_slice > chips_per_host:
+            host_bw = getattr(spec, "host_bandwidth_override", None)
+            host_lat = getattr(spec, "host_latency_override_us", None)
+            tiers.append(Tier(
+                "host",
+                float(host_bw) if host_bw is not None else ici_bw,
+                float(host_lat) * 1e-6 if host_lat is not None
+                else ici_lat, per_slice))
+        if spec.num_slices > 1 and n > per_slice:
+            tiers.append(Tier("dcn", spec.dcn_bandwidth,
+                              spec.dcn_latency_us * 1e-6, n))
+        return cls(tiers)
+
 
 def flat_ring_links(topo, devices: Tuple[int, ...]):
     """Flattened ring-collective routes over ``devices``, cached on the
@@ -181,6 +306,15 @@ class GraphTopology:
         self._routes_cache: Dict[Tuple[int, int, int], List[List[Link]]] = {}
         self._dist_cache: Dict[int, Dict[int, float]] = {}
         self._rdist_cache: Dict[int, Dict[int, float]] = {}
+        # link-table fingerprint: keys the SHARED Dijkstra cache, so
+        # identical fabrics (rebuilt per search/test) reuse sweeps while
+        # degraded() copies — different table, different key — never
+        # alias (the per-instance dicts above stay as the L1 memo).
+        # The FULL tuple is the key, not its hash: a 64-bit hash
+        # collision between distinct fabrics would silently serve wrong
+        # distances; equality comparison rules that out
+        self._conn_key = (num_devices,
+                          tuple(sorted(self.conn.items())))
         # Dijkstra weight: dimensionless time factor max_bw/bw (>= 1 per
         # hop, the same normalization as link_factor). Raw per-byte
         # weights (1/bw ~ 1e-11 for real ICI bandwidths) would sit at
@@ -313,17 +447,28 @@ class GraphTopology:
             raise ValueError(f"no route {src} -> {dst} in topology")
         out = [[(p[i], 0, p[i + 1]) for i in range(len(p) - 1)]
                for p in paths]
+        if len(self._routes_cache) >= _ROUTES_CACHE_CAP:
+            self._routes_cache.clear()     # hot pairs repopulate
         self._routes_cache[(src, dst, k)] = out
         return out
 
     def _dist_from(self, node: int, rev: bool = False) -> Dict[int, float]:
-        """Cached full Dijkstra distance map from ``node`` (forward or
+        """Memoized full Dijkstra distance map from ``node`` (forward or
         reverse graph) — ring_links issues a route per device pair, so
-        per-node caching turns 2P sweeps into at most 2V."""
+        per-node caching turns 2P sweeps into at most 2V. Two-level:
+        per-instance dict first, then the module-level bounded cache
+        keyed on the link-table fingerprint (``_conn_key``), so a fresh
+        but identical topology object reuses earlier sweeps while a
+        ``degraded()`` copy's different table can never alias."""
         cache = self._rdist_cache if rev else self._dist_cache
         hit = cache.get(node)
         if hit is not None:
             return hit
+        skey = (self._conn_key, node, rev)
+        shared = _SHARED_DIST_CACHE.get(skey)
+        if shared is not None:
+            cache[node] = shared
+            return shared
         import heapq
         adj = self._radj if rev else self._adj
         dist = {node: 0.0}
@@ -338,6 +483,9 @@ class GraphTopology:
                     dist[v] = nd
                     heapq.heappush(pq, (nd, v))
         cache[node] = dist
+        if len(_SHARED_DIST_CACHE) >= _DIST_CACHE_CAP:
+            _SHARED_DIST_CACHE.clear()     # hot fabrics repopulate
+        _SHARED_DIST_CACHE[skey] = dist
         return dist
 
     def route(self, src: int, dst: int) -> List[Link]:
@@ -386,26 +534,66 @@ class GraphTopology:
 
 def _parse_ini(text: str) -> Dict[str, str]:
     """``key = value`` lines, ``#`` comments — the reference's
-    ``machine_config_example`` format."""
+    ``machine_config_example`` format. Lines that look like
+    assignments but don't parse raise a typed ``ValueError`` naming
+    the offending line instead of being silently dropped."""
     out: Dict[str, str] = {}
-    for line in text.splitlines():
+    for ln, line in enumerate(text.splitlines(), start=1):
         line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
         m = re.match(r"([A-Za-z0-9_]+)\s*=\s*(.+)", line)
-        if m:
-            out[m.group(1)] = m.group(2).strip()
+        if not m:
+            raise ValueError(
+                f"machine file line {ln}: {line!r} is not a "
+                f"'key = value' entry")
+        out[m.group(1)] = m.group(2).strip()
     return out
+
+
+def _cfg_get(cfg: Dict, key: str, conv, default=None, path: str = ""):
+    """Typed machine-file field access: a malformed value raises
+    ``ValueError`` naming the offending key (never a bare
+    ``KeyError``/``TypeError`` from deep inside the parser)."""
+    if key not in cfg or cfg[key] is None:
+        return default
+    try:
+        return conv(cfg[key])
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"machine file {path or '<config>'}: invalid value "
+            f"{cfg[key]!r} for key '{key}': {e}") from e
+
+
+def _shape_conv(v) -> Tuple[int, ...]:
+    """ici_shape in JSON list form or INI text form ('4x8', '4 8',
+    '4,8')."""
+    if isinstance(v, str):
+        parts = [p for p in re.split(r"[x,\s]+", v.strip()) if p]
+        return tuple(int(p) for p in parts)
+    return tuple(int(x) for x in v)
+
+
+#: keys marking a TPU-native description (JSON or INI); their absence
+#: from an INI file selects the reference machine_config_example schema
+_TPU_KEYS = ("generation", "ici_shape", "num_slices", "num_devices",
+             "dcn_bandwidth_gbps", "ici_bandwidth_gbps")
 
 
 def load_machine_file(path: str):
     """Parse a machine description into a ``MachineSpec``.
 
-    Two formats:
+    Formats:
       - JSON (TPU-native): ``{"generation": "v5e", "ici_shape": [4, 8],
         "num_hosts": 4, "num_slices": 1, "dcn_bandwidth_gbps": 25, ...}``
+      - INI with the same TPU-native keys (``ici_shape = 4x8``) — e.g.
+        ``machine_configs/v5e-2slice.ini``;
       - reference-style INI (``machine_config_example``): ``num_nodes``,
         ``num_gpus_per_socket`` x ``num_sockets_per_node`` -> devices,
         ``nvlink_bandwidth`` -> ICI GB/s, ``nic_bandwidth`` -> DCN,
         latencies in ms.
+
+    Malformed entries raise ``ValueError`` naming the offending key.
     """
     from .machine import MachineSpec
 
@@ -418,48 +606,75 @@ def load_machine_file(path: str):
         cfg = _parse_ini(text)
         is_json = False
 
-    if is_json:
+    if is_json or any(k in cfg for k in _TPU_KEYS):
+        ici_shape = _cfg_get(cfg, "ici_shape", _shape_conv, None, path)
+        num_slices = _cfg_get(cfg, "num_slices", int, 1, path)
+        num_devices = _cfg_get(cfg, "num_devices", int, None, path)
+        if num_devices is None:
+            num_devices = _prod(ici_shape or [1]) * num_slices
         spec = MachineSpec(
-            num_devices=int(cfg.get("num_devices") or
-                            _prod(cfg.get("ici_shape", [1])) *
-                            int(cfg.get("num_slices", 1))),
-            generation=cfg.get("generation", "v5e"),
-            ici_shape=tuple(cfg["ici_shape"]) if "ici_shape" in cfg
-            else None,
-            num_slices=int(cfg.get("num_slices", 1)),
-            dcn_bandwidth_gbps=float(cfg.get("dcn_bandwidth_gbps", 25.0)),
-            ici_latency_us=float(cfg.get("ici_latency_us", 1.0)),
-            dcn_latency_us=float(cfg.get("dcn_latency_us", 10.0)),
+            num_devices=num_devices,
+            generation=_cfg_get(cfg, "generation", str, "v5e", path),
+            ici_shape=ici_shape,
+            num_slices=num_slices,
+            dcn_bandwidth_gbps=_cfg_get(cfg, "dcn_bandwidth_gbps",
+                                        float, 25.0, path),
+            ici_latency_us=_cfg_get(cfg, "ici_latency_us", float, 1.0,
+                                    path),
+            dcn_latency_us=_cfg_get(cfg, "dcn_latency_us", float, 10.0,
+                                    path),
         )
-        spec.num_hosts = int(cfg.get("num_hosts", spec.num_slices))
-        if "ici_bandwidth_gbps" in cfg:
-            spec.ici_bandwidth_override = \
-                float(cfg["ici_bandwidth_gbps"]) * 1e9
-        if "peak_tflops" in cfg:
-            spec.peak_flops_override = float(cfg["peak_tflops"]) * 1e12
+        spec.num_hosts = _cfg_get(cfg, "num_hosts", int,
+                                  spec.num_slices, path)
+        ici_bw = _cfg_get(cfg, "ici_bandwidth_gbps", float, None, path)
+        if ici_bw is not None:
+            spec.ici_bandwidth_override = ici_bw * 1e9
+        host_bw = _cfg_get(cfg, "host_bandwidth_gbps", float, None, path)
+        if host_bw is not None:
+            spec.host_bandwidth_override = host_bw * 1e9
+        host_lat = _cfg_get(cfg, "host_latency_us", float, None, path)
+        if host_lat is not None:
+            spec.host_latency_override_us = host_lat
+        tflops = _cfg_get(cfg, "peak_tflops", float, None, path)
+        if tflops is not None:
+            spec.peak_flops_override = tflops * 1e12
+        from .machine import TPU_GENERATIONS
+        if spec.generation not in TPU_GENERATIONS:
+            raise ValueError(
+                f"machine file {path}: invalid value "
+                f"{spec.generation!r} for key 'generation'")
         if "topology" in cfg:
+            if not isinstance(cfg["topology"], dict):
+                raise ValueError(
+                    f"machine file {path}: invalid value for key "
+                    f"'topology': expected an object, got "
+                    f"{type(cfg['topology']).__name__}")
             spec.topology_override = topology_from_json(cfg["topology"],
                                                         spec)
         return spec
 
     # reference INI: nodes x sockets x gpus-per-socket accelerators;
     # nvlink ≙ intra-node fabric (ICI), nic ≙ inter-node (DCN)
-    nodes = int(cfg.get("num_nodes", 1))
-    sockets = int(cfg.get("num_sockets_per_node", 1))
-    per_socket = int(cfg.get("num_gpus_per_socket", 1))
+    nodes = _cfg_get(cfg, "num_nodes", int, 1, path)
+    sockets = _cfg_get(cfg, "num_sockets_per_node", int, 1, path)
+    per_socket = _cfg_get(cfg, "num_gpus_per_socket", int, 1, path)
     per_node = sockets * per_socket
     spec = MachineSpec(
         num_devices=nodes * per_node,
         num_slices=nodes if nodes > 1 else 1,
-        dcn_bandwidth_gbps=float(cfg.get("nic_bandwidth", 25.0)),
+        dcn_bandwidth_gbps=_cfg_get(cfg, "nic_bandwidth", float, 25.0,
+                                    path),
         # reference latencies are in ms
-        ici_latency_us=float(cfg.get("nvlink_latency", 0.001)) * 1e3,
-        dcn_latency_us=float(cfg.get("nic_latency", 0.01)) * 1e3,
+        ici_latency_us=_cfg_get(cfg, "nvlink_latency", float, 0.001,
+                                path) * 1e3,
+        dcn_latency_us=_cfg_get(cfg, "nic_latency", float, 0.01,
+                                path) * 1e3,
     )
     spec.num_hosts = nodes
     spec.ici_shape = (per_node,)
-    if "nvlink_bandwidth" in cfg:
-        spec.ici_bandwidth_override = float(cfg["nvlink_bandwidth"]) * 1e9
+    nvlink = _cfg_get(cfg, "nvlink_bandwidth", float, None, path)
+    if nvlink is not None:
+        spec.ici_bandwidth_override = nvlink * 1e9
     return spec
 
 

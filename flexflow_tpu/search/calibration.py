@@ -305,11 +305,18 @@ class MeshCalibration:
     _pts: Dict = dataclasses.field(default_factory=dict, repr=False)
     _degs: Dict = dataclasses.field(default_factory=dict, repr=False)
 
-    def _points(self, coll: str, degree: int) -> List[Tuple[int, float]]:
-        key = (coll, degree)
+    def _points(self, coll: str, degree: int,
+                tier: Optional[str] = None) -> List[Tuple[int, float]]:
+        """Measured (shape_class, seconds) points for one collective at
+        one degree. ``tier`` selects the tier-keyed rows
+        (``coll_<kind>@<tier>``, written by :func:`calibrate_mesh` on
+        multi-tier meshes); flat rows remain the fallback so warm
+        pre-tier tables keep answering without re-measurement."""
+        kind = f"{coll}@{tier}" if tier else coll
+        key = (kind, degree)
         hit = self._pts.get(key)
         if hit is None:
-            hit = self.table.entries(self.backend, f"coll_{coll}",
+            hit = self.table.entries(self.backend, f"coll_{kind}",
                                      self.dtype, axis_size=degree)
             self._pts[key] = hit
         return hit
@@ -347,11 +354,24 @@ class MeshCalibration:
             self._degs[coll] = hit
         return hit
 
-    def collective_time(self, coll: str, degree: int,
-                        nbytes: float) -> Optional[float]:
+    def collective_time(self, coll: str, degree: int, nbytes: float,
+                        tier: Optional[str] = None) -> Optional[float]:
         if self.table is None or degree <= 1 or nbytes <= 0:
             return None
-        pts = self._points(coll, degree)
+        if tier is not None:
+            # STRICT: a tier-scoped query answers only from rows
+            # measured for that tier. Falling back to the flat rows
+            # here would price a DCN leg at the innermost fabric's
+            # measured speed (~20x under on the virtual 2-slice config)
+            # — the caller's fallback is the tier's machine-model
+            # constants, not a wrong measurement. Flat (tier=None)
+            # queries keep the whole warm table, so pre-tier caches
+            # still answer with zero re-measurement.
+            pts = self._points(coll, degree, tier)
+            if not pts:
+                return None
+        else:
+            pts = self._points(coll, degree)
         if not pts:
             # nearest measured degree (log distance): a degree-3 query
             # on a mesh measured at {2, 4, 8} answers from the closest
@@ -464,16 +484,43 @@ def _calibrate_mesh(backend, dmesh, cache_dir, collectives, sizes,
             keep = {0, len(degrees) - 1,
                     len(degrees) // 3, 2 * len(degrees) // 3}
             degrees = [d for i, d in enumerate(degrees) if i in keep]
+        # tier annotation of each measured degree prefix: the outermost
+        # tier the prefix axes touch (dmesh.axis_tiers; None when the
+        # machine is single-tier — flat keys only, as before)
+        axis_names = list(mesh.shape.keys())
+        try:
+            axis_tiers = dict(dmesh.axis_tiers)
+            multi_tier = len(set(axis_tiers.values())) > 1
+        except Exception:  # noqa: BLE001 — tiers are best-effort
+            axis_tiers, multi_tier = {}, False
         for coll in collectives:
             for deg, n_axes in degrees:
                 if deg <= 1:
                     continue
+                prefix_tiers = {axis_tiers.get(a, "ici")
+                                for a in axis_names[:n_axes]}
+                # mirror ONLY pure single-tier prefixes: a mixed-tier
+                # prefix's measurement filed under the outermost tier
+                # would later answer a pure-tier query of a differently
+                # shaped mesh sharing this table (the entries carry no
+                # mesh identity) — the exact mispricing the strict tier
+                # lookup exists to prevent
+                tier = next(iter(prefix_tiers)) \
+                    if multi_tier and len(prefix_tiers) == 1 else None
                 for nbytes in sizes:
-                    tab.get_or_measure(
+                    v = tab.get_or_measure(
                         backend, f"coll_{coll}", "float32",
                         shape_class(nbytes), deg,
                         lambda c=coll, s=nbytes, k=n_axes:
                             _bench_collective(mesh, c, s, n_axes=k))
+                    # mirror the measurement under the tier key (no
+                    # re-measurement): tier-aware lookups answer from
+                    # coll_<kind>@<tier> first, flat stays the fallback
+                    if v is not None and tier is not None and tab.get(
+                            backend, f"coll_{coll}@{tier}", "float32",
+                            shape_class(nbytes), deg) is None:
+                        tab.put(backend, f"coll_{coll}@{tier}",
+                                "float32", shape_class(nbytes), deg, v)
     return calib
 
 

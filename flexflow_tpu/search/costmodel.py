@@ -85,6 +85,19 @@ class OpCostModel:
         # and per-collective tables measured on the live backend. None =
         # analytic terms only (unchanged legacy behavior).
         self.calib = None
+        # hierarchical placement (parallel/placement.py, arXiv
+        # 2110.10548): when attached, collectives are priced against
+        # the (tier, degree) path their mesh axes span and the cheapest
+        # reduction-tree shape is chosen per site. None = flat-mesh
+        # pricing (bit-identical legacy behavior); policy "flat" keeps
+        # the placement but scores every collective as a flat ring at
+        # its bottleneck tier (the searched-vs-flat baseline).
+        self.placement = None
+        self.placement_policy: Optional[str] = None
+        # per-site chosen trees, for the strategy audit record and the
+        # adopted strategy's serialized tree shapes (bounded)
+        self.algo_choices: Dict[Tuple, Dict[str, Any]] = {}
+        self._tree_memo: Dict[Tuple, Any] = {}
         # on-device measurement (reference measure_operator_cost analog)
         self.measure_on_device = False
         self.measure_budget_s = 120.0   # total wall budget for microbenches
@@ -123,6 +136,112 @@ class OpCostModel:
             os.replace(tmp, self._disk_path)
         except Exception:
             pass
+
+    # ------------------------------------------------------------------
+    def attach_placement(self, placement, policy: str = "hier") -> None:
+        """Attach an :class:`~flexflow_tpu.parallel.placement.
+        AxisPlacement`: collective costs become (tier-path, algorithm)-
+        aware. ``policy`` is the axis-consumption model — ``"hier"``
+        (per-op collectives innermost-first, gradient sync on the
+        complement, best tree per site) or ``"flat"`` (flat-ring
+        scoring at the bottleneck tier — the baseline the search is
+        compared against). Clears every cached cost priced under the
+        previous placement."""
+        if policy not in ("hier", "flat"):
+            raise ValueError(f"unknown placement policy {policy!r}")
+        self.placement = placement
+        self.placement_policy = policy if placement is not None else None
+        self.cache.clear()
+        self._tree_memo.clear()
+        self.algo_choices.clear()
+
+    def _placed_collective(self, volume_bytes: float, collective: str,
+                           degree: int, axes: Optional[Tuple[str, ...]],
+                           prefer: str, site: str) -> Optional[float]:
+        """Tier-path pricing of one collective under the attached
+        placement. Returns None when the path stays within one tier —
+        the caller keeps its flat-mesh pricing, so single-tier machines
+        are bit-identical to the historical model."""
+        pl = self.placement
+        if pl is None or degree <= 1 or volume_bytes <= 0:
+            return None
+        if self.placement_policy == "flat" and axes is None:
+            # the legacy greedy allocator consumed axes in declaration
+            # order — DCN first — so the flat baseline's per-op groups
+            # land outermost and its sync group on what remains
+            prefer = "outer" if prefer == "inner" else "inner"
+        path = pl.path_for_axes(axes) if axes \
+            else pl.path_for_degree(degree, prefer=prefer)
+        if not path:
+            return None
+        if len(path) == 1 and \
+                path[0][0].name == pl.tier_graph.innermost().name:
+            # confined to the innermost fabric: the legacy (flat-mesh)
+            # pricing IS that tier's pricing — keep it bit-identical,
+            # calibrated fast paths included
+            return None
+        from ..parallel.placement import (_ring_tree, TreeChoice,
+                                          choose_reduction_tree,
+                                          tree_bandwidth_cost)
+        # memo key carries the EXACT volume: a shape-class bucket here
+        # made cost non-monotonic in volume (same-band payloads up to
+        # ~2x apart returned the first-seen absolute cost)
+        memo_key = (site, collective, degree,
+                    tuple((t.name, d) for t, d in path),
+                    int(volume_bytes), self.placement_policy)
+        choice = self._tree_memo.get(memo_key)
+        if choice is None:
+            if self.placement_policy == "flat":
+                cost, phases = _ring_tree(collective, volume_bytes, path)
+                choice = TreeChoice(algo="ring", phases=phases,
+                                    cost_s=cost, flat_cost_s=cost)
+            else:
+                choice = choose_reduction_tree(self, collective,
+                                               volume_bytes, path)
+            if choice is None:
+                return None
+            if site == "grad_sync":
+                # MARGINAL (bandwidth-only) pricing, the placed analog
+                # of collective_marginal: XLA's all-reduce combiner
+                # coalesces per-layer gradient reductions, so the
+                # per-leg latency rounds are paid once per step, not
+                # once per layer — charging them per op inverted the
+                # searched-vs-DP ranking on dense tower models (see
+                # weight_sync_cost). Applied to BOTH policies so the
+                # searched-vs-flat audit ratio stays apples-to-apples.
+                choice = TreeChoice(
+                    algo=choice.algo, phases=choice.phases,
+                    cost_s=tree_bandwidth_cost(choice.phases,
+                                               pl.tier_graph),
+                    flat_cost_s=choice.flat_cost_s)
+            if len(self._tree_memo) > 4096:
+                self._tree_memo.clear()
+            self._tree_memo[memo_key] = choice
+            self._record_choice(site, collective, degree, path, choice,
+                                volume_bytes)
+        return float(choice.cost_s)
+
+    def _record_choice(self, site, collective, degree, path, choice,
+                       volume_bytes) -> None:
+        if self.placement_policy == "hier":
+            # only genuine selections count: the flat-policy baseline
+            # re-pricing (searched-vs-flat audit) must not inflate the
+            # algorithm counters with phantom ring "choices"
+            from ..obs.metrics_registry import REGISTRY
+            REGISTRY.counter(
+                "ff_collective_algo_total",
+                "Reduction-tree algorithms chosen by the "
+                "placement-aware cost model").inc(algo=choice.algo)
+            obs_events.counter(f"placement.algo_{choice.algo}")
+        key = (site, collective, degree,
+               tuple((t.name, d) for t, d in path))
+        if len(self.algo_choices) > 512:
+            self.algo_choices.clear()
+        self.algo_choices[key] = {
+            "site": site, "collective": collective, "degree": degree,
+            "tier_path": [[t.name, d] for t, d in path],
+            "volume_bytes": float(volume_bytes),
+            **choice.to_json()}
 
     # ------------------------------------------------------------------
     def attach_calibration(self, calib) -> None:
@@ -442,17 +561,29 @@ class OpCostModel:
 
     # ------------------------------------------------------------------
     def xfer_cost(self, volume_bytes: float, collective: str,
-                  degree: int) -> float:
+                  degree: int,
+                  axes: Optional[Tuple[str, ...]] = None) -> float:
         """Collective time (ring algorithms): all-gather/reduce-scatter
         move (d-1)/d of the volume; all-reduce 2(d-1)/d; all-to-all
         (d-1)/d with per-hop latency.
 
-        Multi-slice machines: a collective whose degree exceeds
-        ``devices_per_slice`` necessarily crosses DCN; its cost is the
-        standard hierarchical decomposition — intra-slice leg over ICI
-        plus an inter-slice leg on the slice-reduced volume over DCN
-        (reference analog: per-link-type simulation in
-        ``src/runtime/network.cc`` / ``simulator.h:381-499``).
+        Hierarchical placement (``attach_placement``): when the
+        collective's mesh axes (``axes``, or the placement policy's
+        axis consumption for a bare degree) span more than one hardware
+        tier, the cost is the cheapest reduction-tree shape over that
+        (tier, degree) path — ring vs recursive halving vs two/three-
+        phase hierarchical trees (``parallel/placement.py``,
+        arXiv 2110.10548) — and the choice is recorded for the audit
+        record. Single-tier paths (and no placement) keep the exact
+        historical pricing below.
+
+        Multi-slice machines without a placement: a collective whose
+        degree exceeds ``devices_per_slice`` necessarily crosses DCN;
+        its cost is the standard hierarchical decomposition —
+        intra-slice leg over ICI plus an inter-slice leg on the
+        slice-reduced volume over DCN (reference analog: per-link-type
+        simulation in ``src/runtime/network.cc`` /
+        ``simulator.h:381-499``).
 
         Calibration v2: a persisted measured table for this
         (backend, collective, degree) answers first — real XLA
@@ -460,6 +591,13 @@ class OpCostModel:
         shape classes; degrees never measured fall through to the
         fitted/analytic ring model."""
         obs_events.counter("costmodel.xfer_queries")
+        placed = self._placed_collective(volume_bytes, collective,
+                                         degree, axes, "inner",
+                                         "op_collective")
+        if placed is not None:
+            floor = (self.calib.dispatch_s or 0.0) \
+                if self.calib is not None else 0.0
+            return max(floor, placed)
         floor = 0.0
         if self.calib is not None:
             kind = "all_to_all" if collective == "permute" else collective
@@ -494,18 +632,22 @@ class OpCostModel:
                    bw: float, lat: float) -> float:
         if degree <= 1 or volume_bytes <= 0:
             return 0.0
+        from ..parallel.placement import bandwidth_multiplier
         frac = (degree - 1) / degree
-        mult = {"all_reduce": 2.0, "all_gather": 1.0, "reduce_scatter": 1.0,
-                "all_to_all": 1.0 / degree, "permute": 1.0 / degree}[collective]
+        mult = bandwidth_multiplier(collective, degree)
         return mult * frac * volume_bytes / bw + (degree - 1) * lat
 
     def reshard_step_cost(self, kind: str, degree: int,
-                          volume_bytes: float) -> float:
+                          volume_bytes: float,
+                          axes: Optional[Tuple[str, ...]] = None
+                          ) -> float:
         """Cost of ONE step of a reshard lowering plan
         (``parallel/reshard.py``): ``all_gather`` / ``all_to_all`` price
         through ``xfer_cost`` — the calibrated collective tables answer
-        first — while ``slice`` is a local block copy (no traffic),
-        priced at measured memory bandwidth plus one dispatch."""
+        first, and with a placement attached the step's actual mesh
+        ``axes`` select its tier path — while ``slice`` is a local block
+        copy (no traffic), priced at measured memory bandwidth plus one
+        dispatch."""
         if degree <= 1 or volume_bytes <= 0:
             return 0.0
         if kind == "slice":
@@ -517,7 +659,7 @@ class OpCostModel:
                 if self.calib.dispatch_s:
                     dispatch = self.calib.dispatch_s
             return volume_bytes / max(mem_bw, 1.0) + dispatch
-        return self.xfer_cost(volume_bytes, kind, degree)
+        return self.xfer_cost(volume_bytes, kind, degree, axes=axes)
 
     def resharding_cost(self, tensor_bytes: float,
                         src_degrees: Dict[int, int],
@@ -541,14 +683,27 @@ class OpCostModel:
         return self.xfer_cost(tensor_bytes, "all_to_all",
                               max(src_total, dst_total))
 
-    def weight_sync_cost(self, weight_bytes: float, dp_degree: int) -> float:
+    def weight_sync_cost(self, weight_bytes: float, dp_degree: int,
+                         axes: Optional[Tuple[str, ...]] = None) -> float:
         """Per-step gradient all-reduce (reference NCCL optimizer path).
 
-        Calibrated: priced at the measured curve's MARGINAL (per-byte)
-        cost — XLA's all-reduce combiner coalesces per-layer gradient
-        reductions into a few large collectives, so the fixed dispatch
-        floor is paid once per step, not once per op
+        Hierarchical placement: the data-parallel group lives on the
+        axes the per-op groups did NOT consume — outermost tiers
+        included — so a tier-crossing sync is priced as the best
+        reduction tree over its path (e.g. intra-slice reduce-scatter →
+        inter-slice all-reduce over hosts → intra-slice all-gather)
+        instead of one flat DCN-bottlenecked ring.
+
+        Calibrated (single-tier): priced at the measured curve's
+        MARGINAL (per-byte) cost — XLA's all-reduce combiner coalesces
+        per-layer gradient reductions into a few large collectives, so
+        the fixed dispatch floor is paid once per step, not once per op
         (calibration.MeshCalibration.collective_marginal)."""
+        placed = self._placed_collective(weight_bytes, "all_reduce",
+                                         dp_degree, axes, "outer",
+                                         "grad_sync")
+        if placed is not None:
+            return placed
         if self.calib is not None and dp_degree > 1 and weight_bytes > 0:
             t = self.calib.collective_marginal("all_reduce", dp_degree,
                                                weight_bytes)

@@ -42,6 +42,7 @@ def optimize_strategy(ff):
     cost_model = OpCostModel(spec)
     cost_model.segment_size = max(1, cfg.simulator_segment_size)
     cost_model.max_segments = max(1, cfg.simulator_max_num_segments)
+    _attach_placement(cfg, cost_model, dmesh)
     import jax
     with obs_events.span("search.calibrate"):
         if jax.devices()[0].platform != "cpu":
@@ -89,6 +90,16 @@ def optimize_strategy(ff):
     _write_mcmc_audit(ff, sim, best, dp)
     strategy = assignment_to_strategy(ff.layers, ff.graph_inputs, best,
                                       dmesh, sim)
+    if cost_model.placement is not None:
+        # re-price ONLY the adopted assignment with cleared memos so the
+        # recorded tree choices are its collective sites (the MCMC walk
+        # recorded every candidate's); axis_tiers travels with the
+        # trees — the verifier's latency-bound check keys on it
+        cost_model.attach_placement(cost_model.placement, "hier")
+        sim.evaluate(best)
+        strategy.collective_trees = list(
+            cost_model.algo_choices.values())
+        strategy.axis_tiers = cost_model.placement.to_json()
     if cfg.profiling:
         print(f"search: {time.perf_counter() - t0:.2f}s, "
               f"best {best_cost * 1e3:.3f} ms vs DP {dp_cost * 1e3:.3f} ms "
@@ -103,6 +114,93 @@ def optimize_strategy(ff):
     return _apply_floor_guard(
         ff, _maybe_banks(ff, cost_model, _maybe_pipeline(
             ff, cost_model, best_cost, (strategy, None))))
+
+
+def _placement_enabled(cfg) -> bool:
+    """Resolve the hierarchical-placement opt-out: config "true"/"false"
+    wins; "auto" (the default) honors FF_HIER_PLACEMENT, defaulting ON
+    — single-tier machines degenerate to flat behavior anyway."""
+    import os
+    mode = str(getattr(cfg, "hier_placement", "auto") or "auto").lower()
+    if mode in ("true", "on", "1", "yes"):
+        return True
+    if mode in ("false", "off", "0", "no"):
+        return False
+    return os.environ.get("FF_HIER_PLACEMENT", "1").lower() \
+        not in ("0", "false", "no", "off")
+
+
+def _attach_placement(cfg, cost_model, dmesh) -> None:
+    """Attach the axis→tier placement to the cost model when the
+    machine has more than one hardware tier (multi-slice/multi-host).
+    Single-tier machines skip it entirely — every prediction stays
+    bit-identical to the flat model."""
+    if not _placement_enabled(cfg):
+        return
+    from ..obs.metrics_registry import REGISTRY
+    from ..parallel.placement import AxisPlacement
+    placement = AxisPlacement.from_dmesh(dmesh)
+    if placement is None or not placement.multi_tier:
+        return
+    cost_model.attach_placement(placement, "hier")
+    REGISTRY.counter(
+        "ff_placement_searches_total",
+        "Searches run with hierarchical placement attached").inc()
+
+
+def _placement_audit(ff, cost_model, graph, dmesh, evaluator_cls=None):
+    """Searched-vs-flat placement comparison for the strategy audit
+    record: re-price the ADOPTED graph under the hierarchical policy
+    (recording each collective site's chosen tree) and under the
+    flat-ring baseline policy, so a placement regression is diagnosable
+    from artifacts alone. Returns (trees, record) — ``trees`` is what
+    the adopted strategy serializes as ``collective_trees``."""
+    if cost_model.placement is None:
+        return [], None
+    from ..obs.metrics_registry import REGISTRY
+    from .unity import GraphCostEvaluator
+    ev_cls = evaluator_cls or GraphCostEvaluator
+    t0 = time.perf_counter()
+    try:
+        try:
+            with obs_events.span("placement.search"):
+                # fresh evaluator + cleared memos: the recorded choices
+                # are exactly the adopted graph's collective sites
+                cost_model.attach_placement(cost_model.placement, "hier")
+                hier_total = ev_cls(cost_model,
+                                    dmesh).graph_cost(graph).total
+                trees = list(cost_model.algo_choices.values())
+                cost_model.attach_placement(cost_model.placement, "flat")
+                flat_total = ev_cls(cost_model,
+                                    dmesh).graph_cost(graph).total
+        finally:
+            # the flat policy must NEVER leak past the audit: later
+            # evaluations (dp-prediction fallback, pipeline scoring)
+            # share this cost model
+            cost_model.attach_placement(cost_model.placement, "hier")
+        multi = [t for t in trees if len(t.get("phases", ())) > 1]
+        record = {
+            "policy": "hier",
+            "axis_tiers": cost_model.placement.to_json(),
+            "searched_total_s": hier_total,
+            "flat_total_s": flat_total,
+            "flat_over_searched": flat_total / max(hier_total, 1e-12),
+            "n_collective_sites": len(trees),
+            "n_multi_phase_trees": len(multi),
+            "collectives": trees,
+            "duration_s": time.perf_counter() - t0,
+        }
+        REGISTRY.counter(
+            "ff_placement_adopted_total",
+            "Adopted strategies by placement policy").inc(policy="hier")
+        REGISTRY.gauge(
+            "ff_placement_flat_over_searched",
+            "Predicted flat-placement / searched-placement step-time "
+            "ratio of the last search").set(
+                record["flat_over_searched"])
+        return trees, record
+    except Exception:  # noqa: BLE001 — audit must never kill compile
+        return [], None
 
 
 def _write_unity_audit(ff, cost_model, graph, gc, info):
@@ -493,6 +591,22 @@ def _unity(ff, cost_model: OpCostModel, t0: float):
             base_optimize_threshold=max(cfg.base_optimize_threshold, 2),
             xfers=xfers, evaluator_cls=evaluator_cls)
     _write_unity_audit(ff, cost_model, graph, gc, info)
+    trees, placement_rec = _placement_audit(ff, cost_model, graph, dmesh,
+                                            evaluator_cls=evaluator_cls)
+    if trees:
+        strategy.collective_trees = trees
+    if placement_rec is not None:
+        _audit_path = getattr(ff, "_strategy_audit_path", None)
+        if _audit_path:
+            obs_audit.annotate_strategy_audit(
+                _audit_path, {"placement": placement_rec})
+        ff._placement_record = placement_rec
+        if cfg.profiling:
+            print(f"placement: flat/searched predicted "
+                  f"{placement_rec['flat_over_searched']:.2f}x, "
+                  f"{placement_rec['n_multi_phase_trees']} multi-phase "
+                  f"tree(s) over "
+                  f"{placement_rec['n_collective_sites']} site(s)")
     try:
         # predicted searched-vs-DP ratio, recorded so A/B harnesses can
         # correlate the cost model's prediction with measurement; the

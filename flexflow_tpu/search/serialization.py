@@ -50,6 +50,10 @@ def save_strategy(path: str, strategy: ShardingStrategy,
         "assignment": {k: list(v) for k, v in (assignment or {}).items()},
         "meta": meta or {},
     }
+    if getattr(strategy, "axis_tiers", None):
+        doc["axis_tiers"] = dict(strategy.axis_tiers)
+    if getattr(strategy, "collective_trees", None):
+        doc["collective_trees"] = list(strategy.collective_trees)
     banks_doc = banks_to_json(strategy)
     if banks_doc:
         doc["banks"] = banks_doc
@@ -457,6 +461,11 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
             [_spec_from_json(s) for s in os.get("outputs", [])],
             {w: _spec_from_json(s) for w, s in os.get("weights", {}).items()
              if s is not None})
+    if doc.get("axis_tiers"):
+        st.axis_tiers = {str(k): str(v)
+                         for k, v in doc["axis_tiers"].items()}
+    if doc.get("collective_trees"):
+        st.collective_trees = list(doc["collective_trees"])
     if doc.get("banks"):
         from ..parallel.banks import BankSpec
         st.banks = [BankSpec(list(b["members"]), tuple(b["axes"]),

@@ -10,6 +10,13 @@ the native event-driven simulator (``flexflow_tpu/native/src/ffruntime.cc``). Th
 captures queueing and compute/comm overlap that the additive
 ``GraphCostEvaluator`` cannot; it is selected with
 ``machine_model_version >= 1`` (the reference's ``--machine-model-version``).
+
+Hierarchical placement (``parallel/placement.py``): every collective's
+seconds come from ``OpCostModel.xfer_cost`` / ``weight_sync_cost``, so
+when a placement is attached the durations already reflect the chosen
+reduction-tree shape over the (tier, degree) path; link-level DCN
+contention is additionally modeled by the ``GraphTopology`` fabric's
+per-link factors (a DCN hop serializes ``link_factor``x longer).
 """
 from __future__ import annotations
 

@@ -770,11 +770,39 @@ def graph_optimize_with_memory(graph: Graph, xfers: Sequence[GraphXfer],
 # ---------------------------------------------------------------------------
 # Strategy extraction: optimized PCG -> executable program + shardings
 # ---------------------------------------------------------------------------
-def _allocate_group_axes(graph: Graph, dmesh: DeviceMesh
+def _group_tier_prefs(graph: Graph) -> Dict[str, str]:
+    """Per-group axis-tier preference for placement-aware allocation:
+    groups that shard weights or carry partial sums (tensor/reduce
+    parallelism — per-op, per-layer collectives) belong on the fastest
+    fabric (``"inner"``); pure output-sharding groups (data parallel —
+    one gradient sync per step, lowered as a hierarchical tree) can
+    afford the outermost tiers (``"outer"``)."""
+    prefs: Dict[str, str] = {}
+    for n in graph.in_edges:
+        ann = n.ann
+        for _w, _d, g in ann.weights:
+            prefs[g] = "inner"
+        if ann.reduce is not None:
+            prefs[ann.reduce] = "inner"
+        if ann.replicate is not None:
+            prefs.setdefault(ann.replicate, "inner")
+        for g, _d in ann.groups:
+            prefs.setdefault(g, "outer")
+    return prefs
+
+
+def _allocate_group_axes(graph: Graph, dmesh: DeviceMesh,
+                         placement_policy: Optional[str] = None
                          ) -> Dict[str, Tuple[str, ...]]:
     """Assign disjoint-where-needed atomic mesh axes to each annotation
     group, consistently across the whole graph (the analog of the
-    reference's per-op MachineView assignment)."""
+    reference's per-op MachineView assignment).
+
+    With ``placement_policy="hier"`` the assignment is tier-aware: each
+    group's axes are taken innermost- or outermost-first per
+    :func:`_group_tier_prefs` — the axis→tier placement half of the
+    arXiv 2110.10548 search space. ``None`` keeps the historical
+    declaration-order greedy (the flat baseline)."""
     co: Dict[str, set] = {}
     degrees: Dict[str, int] = {}
     for n in graph.in_edges:
@@ -782,26 +810,45 @@ def _allocate_group_axes(graph: Graph, dmesh: DeviceMesh
         for g, d in n.ann.groups:
             degrees[g] = d
             co.setdefault(g, set()).update(x for x in gs if x != g)
+    prefs = _group_tier_prefs(graph) if placement_policy == "hier" \
+        else {}
     assign: Dict[str, Tuple[str, ...]] = {}
-    for g in sorted(degrees, key=lambda g: (-degrees[g], g)):
+    # inner-preferring (tp/reduce) groups allocate FIRST so the fast
+    # axes are still free when they ask; ties keep the legacy
+    # biggest-degree-first order
+    def alloc_rank(g: str) -> Tuple:
+        return (0 if prefs.get(g) == "inner" else 1, -degrees[g], g)
+
+    for g in sorted(degrees, key=alloc_rank):
         used: List[str] = []
         for other in co.get(g, ()):
             used.extend(assign.get(other, ()))
-        axes = dmesh.allocate_axes(degrees[g], used)
+        prefer = prefs.get(g)
+        axes = dmesh.allocate_axes(degrees[g], used, prefer=prefer)
         if axes is None:
-            axes = dmesh.allocate_axes(degrees[g], [])
+            axes = dmesh.allocate_axes(degrees[g], [], prefer=prefer)
         assign[g] = axes or ()
     return assign
 
 
 def extract_strategy(graph: Graph, info: GraphProgramInfo,
-                     dmesh: DeviceMesh) -> ShardingStrategy:
-    """Convert the optimized PCG into the executable ShardingStrategy."""
+                     dmesh: DeviceMesh,
+                     placement_policy: Optional[str] = None
+                     ) -> ShardingStrategy:
+    """Convert the optimized PCG into the executable ShardingStrategy.
+    ``placement_policy="hier"`` makes the group→axis assignment
+    tier-aware (see :func:`_allocate_group_axes`) and records the
+    adopted axis→tier placement on the strategy."""
     from jax.sharding import PartitionSpec as P
 
     st = ShardingStrategy(dmesh)
-    axes_of = _allocate_group_axes(graph, dmesh)
+    axes_of = _allocate_group_axes(graph, dmesh, placement_policy)
     lay = propagate_layouts(graph)
+    if placement_policy == "hier":
+        try:
+            st.axis_tiers = dict(dmesh.axis_tiers)
+        except Exception:  # noqa: BLE001 — annotation is best-effort
+            pass
 
     # group axes by (dim -> axes) for a node's layout: we need group names,
     # so rebuild specs from annotations for compute nodes and from layouts
@@ -822,8 +869,14 @@ def extract_strategy(graph: Graph, info: GraphProgramInfo,
     def axes_for_layout(layout: Layout) -> Dict[int, Tuple[str, ...]]:
         used: List[str] = []
         placements: Dict[int, Tuple[str, ...]] = {}
+        # under hierarchical placement, batch (dim 0) layouts take the
+        # outer tiers and feature/interior layouts the inner — matching
+        # the group allocation above
         for dim, deg in layout:
-            ax = dmesh.allocate_axes(deg, used)
+            prefer = None
+            if placement_policy == "hier":
+                prefer = "outer" if dim == 0 else "inner"
+            ax = dmesh.allocate_axes(deg, used, prefer=prefer)
             if ax is None:
                 continue
             used.extend(ax)
@@ -885,7 +938,9 @@ def extract_strategy(graph: Graph, info: GraphProgramInfo,
         L = first_layouts.get(t.guid, ())
         d0 = dict(L).get(0)
         if d0 and t.shape and t.shape[0] % d0 == 0:
-            ax = dmesh.allocate_axes(d0, [])
+            ax = dmesh.allocate_axes(
+                d0, [], prefer="outer" if placement_policy == "hier"
+                else None)
             if ax:
                 st.inputs[t.name] = P(ax[0] if len(ax) == 1 else tuple(ax))
     errs = st.validate()
@@ -1068,5 +1123,7 @@ def unity_search(layers: Sequence[Layer], input_tensors: Sequence[Tensor],
     # predicted DP-baseline cost (already computed for the DP floor in
     # the non-memory branch) — consumed by optimizer reporting
     info.dp_predicted_total = dp_predicted_total
-    strategy = extract_strategy(g, info, dmesh)
+    strategy = extract_strategy(
+        g, info, dmesh,
+        placement_policy=getattr(cost_model, "placement_policy", None))
     return info, strategy, gc, g

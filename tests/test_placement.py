@@ -1,0 +1,519 @@
+"""Hierarchical topology-aware placement (ISSUE 9, arXiv 2110.10548):
+TierGraph / AxisPlacement, per-collective reduction-tree selection,
+tier-aware cost-model pricing and axis allocation, strategy
+serialization of placement + tree shapes, the plan verifier's placement
+check (incl. the pinned latency-bound-across-DCN rejection), tier-keyed
+calibration fallbacks, typed machine-file errors, and the tier-staged
+reshard lowering."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.parallel.machine import DeviceMesh, MachineSpec
+from flexflow_tpu.parallel.placement import (AxisPlacement,
+                                             choose_reduction_tree)
+from flexflow_tpu.parallel.topology import TierGraph, load_machine_file
+from flexflow_tpu.search.costmodel import OpCostModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+
+def _two_slice_spec(n=8, dcn_gbps=1.0):
+    spec = MachineSpec(num_devices=n, generation="cpu-sim",
+                      ici_shape=(2, n // 4), num_slices=2, num_hosts=2)
+    spec.dcn_bandwidth_gbps = dcn_gbps
+    spec.dcn_latency_us = 20.0
+    return spec
+
+
+# ----------------------------------------------------------------------
+# TierGraph + AxisPlacement
+# ----------------------------------------------------------------------
+
+def test_tier_graph_ladder():
+    spec = MachineSpec(num_devices=32, generation="v5e",
+                       ici_shape=(4, 4), num_slices=2, num_hosts=4)
+    tg = spec.tier_graph
+    assert tg.names == ("ici", "host", "dcn")
+    assert tg.multi_tier
+    ici, host, dcn = tg.tiers
+    assert ici.span == 8 and host.span == 16 and dcn.span == 32
+    assert dcn.bandwidth == spec.dcn_bandwidth
+    assert host.bandwidth == spec.ici_bandwidth   # TPU: ICI spans hosts
+    assert tg.tier_for_span(4).name == "ici"
+    assert tg.tier_for_span(12).name == "host"
+    assert tg.tier_for_span(20).name == "dcn"
+
+
+def test_tier_graph_single_tier_machine():
+    spec = MachineSpec(num_devices=8, generation="v5e", ici_shape=(2, 4))
+    tg = spec.tier_graph
+    assert tg.names == ("ici",)
+    assert not tg.multi_tier
+
+
+def test_tier_graph_memo_invalidates_on_field_change():
+    spec = _two_slice_spec()
+    tg1 = spec.tier_graph
+    assert spec.tier_graph is tg1
+    spec.dcn_bandwidth_gbps = 2.5
+    tg2 = spec.tier_graph
+    assert tg2 is not tg1
+    assert tg2.tier("dcn").bandwidth == 2.5e9
+
+
+def test_axis_tiers_from_mesh_strides():
+    dm = DeviceMesh(_two_slice_spec())
+    assert dm.axis_tiers == {"dcn": "dcn", "x0": "ici", "x1": "ici"}
+    pl = AxisPlacement.from_dmesh(dm)
+    assert pl is not None and pl.multi_tier
+    # degree-4 inner-first stays on ICI; degree-8 must cross DCN
+    assert [(t.name, d) for t, d in pl.path_for_degree(4, "inner")] \
+        == [("ici", 4)]
+    assert [(t.name, d) for t, d in pl.path_for_degree(8, "inner")] \
+        == [("ici", 4), ("dcn", 2)]
+    # outer-first consumes the DCN axis immediately
+    assert [(t.name, d) for t, d in pl.path_for_degree(2, "outer")] \
+        == [("dcn", 2)]
+
+
+def test_axis_placement_rejects_unknown_tier():
+    dm = DeviceMesh(_two_slice_spec())
+    with pytest.raises(ValueError):
+        AxisPlacement({"x0": "warp-fabric"}, dict(dm.axis_sizes),
+                      dm.spec.tier_graph)
+
+
+def test_allocate_axes_tier_preference():
+    dm = DeviceMesh(_two_slice_spec())
+    # historical behavior (prefer=None): declaration order -> dcn first
+    assert dm.allocate_axes(2, [])[0] == "dcn"
+    # inner preference: the ICI axes first
+    assert dm.allocate_axes(2, [], prefer="inner")[0] in ("x0", "x1")
+    assert set(dm.allocate_axes(4, [], prefer="inner")) == {"x0", "x1"}
+    # outer preference: DCN first
+    assert dm.allocate_axes(2, [], prefer="outer") == ("dcn",)
+
+
+# ----------------------------------------------------------------------
+# reduction-tree selection
+# ----------------------------------------------------------------------
+
+def test_two_phase_tree_beats_flat_ring_over_dcn():
+    spec = _two_slice_spec()
+    cm = OpCostModel(spec)
+    pl = AxisPlacement.from_dmesh(DeviceMesh(spec))
+    path = pl.path_for_degree(8, "inner")
+    choice = choose_reduction_tree(cm, "all_reduce", 20e6, path)
+    assert choice.algo == "two_phase"
+    assert choice.cost_s < choice.flat_cost_s
+    kinds = [p.collective for p in choice.phases]
+    tiers = [p.tier for p in choice.phases]
+    assert kinds == ["reduce_scatter", "all_reduce", "all_gather"]
+    assert tiers == ["ici", "dcn", "ici"]
+    # the DCN phase carries the tier-reduced volume
+    assert choice.phases[1].volume_bytes == pytest.approx(20e6 / 4)
+
+
+def test_three_phase_tree_on_three_tier_path():
+    spec = MachineSpec(num_devices=16, generation="v5e",
+                       ici_shape=(2, 4), num_slices=2, num_hosts=4)
+    spec.host_bandwidth_override = 20e9     # NIC-ish inter-host fabric
+    tg = spec.tier_graph
+    assert tg.names == ("ici", "host", "dcn")
+    path = [(tg.tier("ici"), 2), (tg.tier("host"), 4),
+            (tg.tier("dcn"), 2)]
+    choice = choose_reduction_tree(OpCostModel(spec), "all_reduce",
+                                   50e6, path)
+    assert choice.algo == "three_phase"
+    tiers = [p.tier for p in choice.phases]
+    # recursive: rs(ici) rs(host) ar(dcn) ag(host) ag(ici)
+    assert tiers == ["ici", "host", "dcn", "host", "ici"]
+
+
+def test_halving_doubling_wins_latency_bound():
+    """Tiny payload, big degree: log2(d) latency rounds beat d-1."""
+    spec = _two_slice_spec(n=32)
+    tg = spec.tier_graph
+    path = [(tg.tier("dcn"), 16)]
+    choice = choose_reduction_tree(OpCostModel(spec), "all_reduce",
+                                   1024.0, path)
+    assert choice.algo == "halving_doubling"
+    assert choice.cost_s < choice.flat_cost_s
+
+
+def test_staged_all_gather_moves_fewest_bytes_on_dcn():
+    spec = _two_slice_spec()
+    tg = spec.tier_graph
+    path = [(tg.tier("ici"), 4), (tg.tier("dcn"), 2)]
+    choice = choose_reduction_tree(OpCostModel(spec), "all_gather",
+                                   8e6, path)
+    assert choice.algo == "two_phase"
+    # outer (DCN) leg first, on the smallest shards
+    assert choice.phases[0].tier == "dcn"
+    assert choice.phases[0].volume_bytes < choice.phases[1].volume_bytes
+
+
+# ----------------------------------------------------------------------
+# cost-model integration
+# ----------------------------------------------------------------------
+
+def test_single_tier_pricing_bit_identical():
+    spec = MachineSpec(num_devices=8, generation="v5e", ici_shape=(2, 4))
+    dm = DeviceMesh(spec)
+    cm = OpCostModel(spec)
+    before = [cm.xfer_cost(16 << 20, c, 8)
+              for c in ("all_reduce", "all_gather", "all_to_all")]
+    cm.attach_placement(AxisPlacement.from_dmesh(dm), "hier")
+    after = [cm.xfer_cost(16 << 20, c, 8)
+             for c in ("all_reduce", "all_gather", "all_to_all")]
+    assert before == after
+    assert not cm.algo_choices        # nothing recorded on one tier
+
+
+def test_placed_sync_cheaper_than_flat_policy():
+    spec = _two_slice_spec()
+    dm = DeviceMesh(spec)
+    pl = AxisPlacement.from_dmesh(dm)
+    cm = OpCostModel(spec)
+    cm.attach_placement(pl, "hier")
+    hier = cm.weight_sync_cost(20e6, 8)
+    rec = list(cm.algo_choices.values())
+    assert any(r["site"] == "grad_sync" and len(r["phases"]) > 1
+               for r in rec), rec
+    cm.attach_placement(pl, "flat")
+    flat = cm.weight_sync_cost(20e6, 8)
+    assert flat > hier * 1.2, (flat, hier)
+
+
+def test_op_collectives_priced_inner_under_hier():
+    """A degree-2 per-op collective lands on ICI under the hierarchical
+    policy and on DCN under the flat (legacy allocation) policy."""
+    spec = _two_slice_spec()
+    dm = DeviceMesh(spec)
+    pl = AxisPlacement.from_dmesh(dm)
+    cm = OpCostModel(spec)
+    cm.attach_placement(pl, "hier")
+    inner = cm.xfer_cost(4 << 20, "all_gather", 2)
+    cm.attach_placement(pl, "flat")
+    outer = cm.xfer_cost(4 << 20, "all_gather", 2)
+    assert outer > inner * 2, (outer, inner)
+
+
+def test_placed_cost_monotonic_in_volume():
+    """Same shape-class band, different volumes: the placed cost must
+    track the actual payload (the tree memo once keyed on the band and
+    replayed the first-seen absolute cost)."""
+    spec = _two_slice_spec()
+    cm = OpCostModel(spec)
+    cm.attach_placement(AxisPlacement.from_dmesh(DeviceMesh(spec)),
+                        "hier")
+    small = cm.xfer_cost(1.6e6, "all_reduce", 8)
+    big = cm.xfer_cost(2.9e6, "all_reduce", 8)   # same pow-2 band
+    assert big > small * 1.5, (small, big)
+
+
+def test_reshard_step_cost_uses_step_axes():
+    spec = _two_slice_spec()
+    dm = DeviceMesh(spec)
+    cm = OpCostModel(spec)
+    cm.attach_placement(AxisPlacement.from_dmesh(dm), "hier")
+    on_ici = cm.reshard_step_cost("all_gather", 2, 4 << 20,
+                                  axes=("x0",))
+    on_dcn = cm.reshard_step_cost("all_gather", 2, 4 << 20,
+                                  axes=("dcn",))
+    assert on_ici < on_dcn
+
+
+def test_calibration_tier_key_strict_with_flat_intact(tmp_path):
+    """Tier-scoped queries answer ONLY from tier rows (a DCN leg must
+    never be priced from an innermost-fabric measurement — the caller's
+    fallback is the tier's machine-model constants); flat queries keep
+    the whole warm table, so pre-tier caches never re-measure."""
+    from flexflow_tpu.search.calibration import (CalibrationTable,
+                                                 MeshCalibration)
+    tab = CalibrationTable(str(tmp_path))
+    tab.put("cpu", "coll_all_reduce", "float32", 1 << 20, 4, 0.5)
+    calib = MeshCalibration(backend="cpu", table=tab)
+    flat = calib.collective_time("all_reduce", 4, 1 << 20)
+    assert flat == 0.5
+    # no tier row: the tier query is a MISS, not a wrong answer
+    assert calib.collective_time("all_reduce", 4, 1 << 20,
+                                 tier="dcn") is None
+    # a tier row answers once present; the flat row is untouched
+    tab.put("cpu", "coll_all_reduce@dcn", "float32", 1 << 20, 4, 2.0)
+    calib2 = MeshCalibration(backend="cpu", table=tab)
+    assert calib2.collective_time("all_reduce", 4, 1 << 20,
+                                  tier="dcn") == 2.0
+    assert calib2.collective_time("all_reduce", 4, 1 << 20) == flat
+
+
+# ----------------------------------------------------------------------
+# search + strategy artifacts
+# ----------------------------------------------------------------------
+
+def _search_two_slice(hier="auto"):
+    import jax
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device mesh")
+    spec = MachineSpec.detect()
+    spec.num_devices = 8
+    spec.num_slices = 2
+    spec.num_hosts = 2
+    spec.dcn_bandwidth_gbps = 1.0
+    spec.dcn_latency_us = 20.0
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.search_budget = 4
+    cfg.search_floor_guard = "false"
+    cfg.hier_placement = hier
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 32, in_dim=64, hidden=(256, 256),
+                    num_classes=10)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+               [], machine_spec=spec, output_tensor=out)
+    return ff
+
+
+def test_search_adopts_hier_placement_and_serializes(tmp_path):
+    ff = _search_two_slice()
+    st = ff.strategy
+    assert st.axis_tiers.get("dcn") == "dcn"
+    assert st.collective_trees
+    gs = [c for c in st.collective_trees if c["site"] == "grad_sync"]
+    assert gs and any(len(c["phases"]) > 1 for c in gs), gs
+    # round-trip through save/load
+    from flexflow_tpu.search.serialization import (load_strategy,
+                                                   save_strategy)
+    p = str(tmp_path / "st.json")
+    save_strategy(p, st)
+    st2 = load_strategy(p, ff.layers, ff.dmesh)
+    assert st2.axis_tiers == st.axis_tiers
+    assert st2.collective_trees == json.loads(
+        json.dumps(st.collective_trees))
+    # one train step executes under the adopted placement
+    rng = np.random.default_rng(0)
+    b = {"input": rng.normal(size=(32, 64)).astype(np.float32),
+         "label": rng.integers(0, 10, size=(32, 1)).astype(np.int32)}
+    bm = ff._run_train_step(ff.executor.make_train_step(), b)
+    assert np.isfinite(float(np.asarray(bm["loss"])))
+
+
+def test_hier_placement_flag_off_keeps_legacy():
+    ff = _search_two_slice(hier="false")
+    st = ff.strategy
+    assert not getattr(st, "axis_tiers", {})
+    assert not getattr(st, "collective_trees", [])
+
+
+def test_checked_in_placement_artifact_verifies():
+    from flexflow_tpu.analysis.plan_verifier import verify_strategy_file
+    path = os.path.join(REPO, "strategies", "mlp_searched_2slice8.json")
+    assert os.path.exists(path), path
+    report = verify_strategy_file(path)
+    assert report.ok(), [f.format() for f in report.errors]
+    doc = json.load(open(path))
+    assert doc["axis_tiers"]
+    assert any(len(c["phases"]) > 1 for c in doc["collective_trees"])
+
+
+# ----------------------------------------------------------------------
+# plan verifier placement check
+# ----------------------------------------------------------------------
+
+def test_badplan_dcn_latency_fixture_rejected():
+    from flexflow_tpu.analysis.plan_verifier import verify_strategy_file
+    path = os.path.join(FIXTURES, "badplan_dcn_latency.json")
+    report = verify_strategy_file(path)
+    assert not report.ok()
+    msgs = [f.format() for f in report.errors]
+    assert any("dcn" in m and "latency-bound" in m for m in msgs), msgs
+    # tier attribution: the finding's seam names the failing rule
+    assert any(f.seam == "latency-bound-dcn" for f in report.errors)
+
+
+def test_placement_check_phase_outside_tier_path():
+    from flexflow_tpu.analysis.plan_verifier import (PlanReport,
+                                                     _check_placement)
+    report = PlanReport()
+    trees = [{"site": "grad_sync", "collective": "all_reduce",
+              "degree": 8, "tier_path": [["ici", 4], ["dcn", 2]],
+              "volume_bytes": 1e7,
+              "phases": [
+                  {"collective": "reduce_scatter", "tier": "ici",
+                   "degree": 4, "volume_bytes": 1e7},
+                  {"collective": "all_reduce", "tier": "host",
+                   "degree": 2, "volume_bytes": 2.5e6}]}]
+    _check_placement(report, {"dcn": "dcn", "x0": "ici", "x1": "ici"},
+                     trees, {"dcn": 2, "x0": 2, "x1": 2}, None)
+    msgs = [f.format() for f in report.errors]
+    assert any("does not cover" in m for m in msgs), msgs
+
+
+def test_placement_check_unknown_tier_and_axis():
+    from flexflow_tpu.analysis.plan_verifier import (PlanReport,
+                                                     _check_placement)
+    report = PlanReport()
+    _check_placement(report, {"zz": "ici", "x0": "hyperlane"}, (),
+                     {"x0": 8}, None)
+    msgs = [f.format() for f in report.errors]
+    assert any("absent from the mesh" in m for m in msgs)
+    assert any("unknown tier" in m for m in msgs)
+
+
+def test_ring_tree_spanning_whole_path_not_flagged():
+    """A single-phase ring / halving-doubling tree spans the whole path
+    through its bottleneck tier — its degree is the path's total
+    product, which is legal there (a searched strategy whose payload
+    picked ring must not fail compile)."""
+    from flexflow_tpu.analysis.plan_verifier import (PlanReport,
+                                                     _check_placement)
+    report = PlanReport()
+    trees = [{"site": "grad_sync", "collective": "all_reduce",
+              "degree": 8, "tier_path": [["ici", 4], ["dcn", 2]],
+              "volume_bytes": 5e7,
+              "algo": "halving_doubling",
+              "phases": [{"collective": "all_reduce", "tier": "dcn",
+                          "degree": 8, "volume_bytes": 5e7}]}]
+    _check_placement(report, {"dcn": "dcn", "x0": "ici", "x1": "ici"},
+                     trees, {"dcn": 2, "x0": 2, "x1": 2}, None)
+    assert report.ok(), [f.format() for f in report.errors]
+
+
+def test_full_mesh_collective_not_flagged():
+    """A collective wider than the intra-slice span has no inner
+    placement option — crossing DCN must NOT be an error."""
+    from flexflow_tpu.analysis.plan_verifier import (PlanReport,
+                                                     _check_placement)
+    report = PlanReport()
+    trees = [{"site": "op_collective", "collective": "all_reduce",
+              "degree": 8, "tier_path": [["ici", 4], ["dcn", 2]],
+              "volume_bytes": 4096.0,
+              "phases": [{"collective": "all_reduce", "tier": "dcn",
+                          "degree": 2, "volume_bytes": 4096.0},
+                         {"collective": "all_reduce", "tier": "ici",
+                          "degree": 4, "volume_bytes": 4096.0}]}]
+    _check_placement(report, {"dcn": "dcn", "x0": "ici", "x1": "ici"},
+                     trees, {"dcn": 2, "x0": 2, "x1": 2}, None)
+    assert report.ok(), [f.format() for f in report.errors]
+
+
+# ----------------------------------------------------------------------
+# machine files (.ini forms + typed errors)
+# ----------------------------------------------------------------------
+
+def test_load_v5e_2slice_ini():
+    spec = load_machine_file(os.path.join(REPO, "machine_configs",
+                                          "v5e-2slice.ini"))
+    assert spec.generation == "v5e"
+    assert spec.ici_shape == (2, 4)
+    assert spec.num_slices == 2 and spec.num_hosts == 4
+    assert spec.num_devices == 16
+    assert spec.tier_graph.names == ("ici", "host", "dcn")
+
+
+def test_load_v5p_4host_ini():
+    spec = load_machine_file(os.path.join(REPO, "machine_configs",
+                                          "v5p-4host.ini"))
+    assert spec.generation == "v5p"
+    assert spec.num_devices == 16 and spec.num_slices == 1
+    assert spec.num_hosts == 4
+    assert spec.ici_bandwidth == pytest.approx(100e9)
+    assert spec.tier_graph.names == ("ici", "host")
+
+
+@pytest.mark.parametrize("body,key", [
+    ("generation = v5e\nici_shape = banana\n", "ici_shape"),
+    ("generation = v5e\nici_shape = 2x4\nnum_slices = two\n",
+     "num_slices"),
+    ("generation = q9000\nici_shape = 2x4\n", "generation"),
+    ("num_nodes = one\n", "num_nodes"),
+])
+def test_malformed_machine_file_names_key(tmp_path, body, key):
+    p = tmp_path / "machine.ini"
+    p.write_text(body)
+    with pytest.raises(ValueError) as ei:
+        load_machine_file(str(p))
+    assert key in str(ei.value)
+
+
+def test_malformed_ini_line_rejected(tmp_path):
+    p = tmp_path / "machine.ini"
+    p.write_text("generation v5e\n")        # no '=': not an assignment
+    with pytest.raises(ValueError) as ei:
+        load_machine_file(str(p))
+    assert "key = value" in str(ei.value)
+
+
+def test_malformed_json_value_names_key(tmp_path):
+    p = tmp_path / "machine.json"
+    p.write_text(json.dumps({"generation": "v5e",
+                             "ici_shape": [2, 4],
+                             "dcn_bandwidth_gbps": "fast"}))
+    with pytest.raises(ValueError) as ei:
+        load_machine_file(str(p))
+    assert "dcn_bandwidth_gbps" in str(ei.value)
+
+
+# ----------------------------------------------------------------------
+# tier-staged reshard lowering
+# ----------------------------------------------------------------------
+
+def test_reshard_plan_tier_staged_gather(tmp_path):
+    """On a multi-tier mesh, a BANDWIDTH-BOUND gather over
+    tier-crossing axes lowers to per-tier staged steps (one portable
+    collective per fabric leg); a clean cache dir keeps the scoring
+    analytic so the assertion is environment-independent."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from flexflow_tpu.parallel.reshard import ReshardPlanner
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device mesh")
+    dm = DeviceMesh(_two_slice_spec())
+    planner = ReshardPlanner(dm, cache_dir=str(tmp_path),
+                             persist=False)
+    shape = (4096, 512)                       # 8 MiB float32
+    # dst keeps dim0 sharded by x1: the naive gather-then-slice peak
+    # dominates, so the staged variant wins on time WITHOUT exceeding
+    # the PR 6 peak<=naive memory gate
+    plan = planner.plan(P(("dcn", "x0", "x1")), P("x1"), shape, 4)
+    assert plan.peak_bytes <= plan.naive_peak_bytes + 1e-9
+    kinds = [(s.kind, s.axes) for s in plan.steps]
+    gathers = [axes for k, axes in kinds if k == "gather"]
+    assert len(gathers) >= 2, kinds           # staged, not one lump
+    tiers = dm.axis_tiers
+    for axes in gathers:
+        assert len({tiers[a] for a in axes}) == 1, kinds
+    # staged execution stays bit-exact vs the unsharded truth
+    x = np.arange(int(np.prod(shape)),
+                  dtype=np.float32).reshape(shape)
+    from jax.sharding import NamedSharding
+    xd = jax.device_put(x, NamedSharding(dm.mesh,
+                                         P(("dcn", "x0", "x1"))))
+    out = planner.execute(xd, plan)
+    np.testing.assert_array_equal(np.asarray(out), x)
+    # gather-to-REPLICATED stays unstaged: the staged intermediate
+    # would exceed the naive transient peak (memory gate holds)
+    plan2 = planner.plan(P(("dcn", "x0", "x1")), P(), shape, 4)
+    assert plan2.peak_bytes <= plan2.naive_peak_bytes + 1e-9
+
+
+def test_reshard_single_tier_unchanged():
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from flexflow_tpu.parallel.reshard import ReshardPlanner
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device mesh")
+    spec = MachineSpec(num_devices=8, generation="cpu-sim",
+                       ici_shape=(2, 2, 2))
+    dm = DeviceMesh(spec)
+    planner = ReshardPlanner(dm, persist=False)
+    axes = tuple(dm.axis_sizes)
+    plan = planner.plan(P(axes), P(), (64, 32), 4)
+    gathers = [s for s in plan.steps if s.kind == "gather"]
+    assert len(gathers) == 1 and gathers[0].axes == axes

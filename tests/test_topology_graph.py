@@ -48,6 +48,90 @@ def test_multi_slice_dcn_factor():
     assert all(g.link_factor(l) == 1.0 for l in g.route(0, 3))
 
 
+def test_multi_slice_routes_cross_via_host_nic():
+    """Routes that cross the DCN edge pick a host-NIC (gateway) link:
+    only each host's first chip carries a DCN port, so a cross-slice
+    route from a non-gateway chip must first hop to its gateway."""
+    g = GraphTopology.multi_slice_torus((2, 2), 2, ici_bw=50e9,
+                                        dcn_bw=5e9, hosts_per_slice=2)
+    # hosts_per_slice=2 on a 4-chip slice -> gateways at chips {0, 2}
+    # (and {4, 6} in slice 1); DCN links connect gateway pairs only
+    dcn_links = {(a, b) for (a, b), bw in g.conn.items() if bw == 5e9}
+    assert dcn_links, "no DCN links in the fabric"
+    assert all(a in (0, 2, 4, 6) and b in (0, 2, 4, 6)
+               for a, b in dcn_links), dcn_links
+    for src, dst in [(1, 5), (3, 4), (0, 7)]:
+        r = g.route(src, dst)
+        crossing = [(l[0], l[2]) for l in r if g.link_factor(l) == 10.0]
+        assert len(crossing) == 1, (src, dst, r)
+        assert crossing[0] in dcn_links, (src, dst, crossing)
+    # hop distances: a cross-slice pair is never closer than the DCN
+    # hop itself and includes the intra-slice legs to/from gateways
+    assert g.hop_distance(0, 4) == 1          # gateway -> gateway
+    assert g.hop_distance(1, 4) >= 2          # non-gateway detours
+
+
+def test_multi_slice_ring_links_mixed_set_well_formed():
+    """ring_links over a device set mixing intra- and inter-slice
+    members returns one hop list per participant, every hop is a real
+    link of the fabric, and consecutive hops chain src -> dst."""
+    g = GraphTopology.multi_slice_torus((2, 2), 2, ici_bw=50e9,
+                                        dcn_bw=5e9, hosts_per_slice=1)
+    devices = [0, 1, 4, 5]                    # two per slice
+    routes = g.ring_links(devices)
+    assert len(routes) == len(devices)
+    for i, hops in enumerate(routes):
+        assert hops, f"participant {i} has an empty route"
+        cur = devices[i]
+        for (src, _z, dst) in hops:
+            assert src == cur, (i, hops)
+            assert (src, dst) in g.conn, (src, dst)
+            cur = dst
+        assert cur == devices[(i + 1) % len(devices)], (i, hops)
+    # the two cross-slice participants traverse DCN, the intra ones not
+    cross = [any(g.link_factor(l) > 1.0 for l in routes[i])
+             for i in range(len(devices))]
+    assert cross == [False, True, False, True], cross
+
+
+def test_degraded_composes_with_multi_slice():
+    """degraded() over a multi-slice fabric slows exactly the listed
+    link and reroutes around it when alternatives exist."""
+    base = GraphTopology.multi_slice_torus((2, 2), 2, ici_bw=50e9,
+                                           dcn_bw=5e9,
+                                           hosts_per_slice=2)
+    # degrade one of the two DCN gateway links by 8x
+    dcn = sorted((a, b) for (a, b), bw in base.conn.items()
+                 if bw == 5e9)
+    victim = dcn[0]
+    deg = GraphTopology.degraded(base, [victim], 8.0)
+    assert deg.conn[victim] == base.conn[victim] / 8.0
+    # unrelated links untouched; max_bw recomputed consistently
+    other = next(l for l in dcn if l != victim
+                 and l != (victim[1], victim[0]))
+    assert deg.conn[other] == base.conn[other]
+    # a cross-slice route now avoids the degraded gateway when the
+    # healthy gateway is reachable
+    r = deg.route(victim[0], victim[1])
+    assert victim not in [(l[0], l[2]) for l in r], r
+    # and the degraded copy's distances are NOT aliased with base's
+    # (the shared Dijkstra cache keys on the link table)
+    assert deg._dist_from(0) is not base._dist_from(0)
+
+
+def test_shared_dijkstra_cache_keyed_on_link_table():
+    """Two topologies with identical link tables share Dijkstra sweeps
+    through the module-level bounded cache; different tables never do."""
+    a = GraphTopology.from_torus((2, 4), 50e9)
+    b = GraphTopology.from_torus((2, 4), 50e9)
+    assert a._conn_key == b._conn_key
+    da = a._dist_from(3)
+    assert b._dist_from(3) is da          # shared, not recomputed
+    c = GraphTopology.degraded(a, [(0, 1)], 4.0)
+    assert c._conn_key != a._conn_key
+    assert c._dist_from(3) is not da
+
+
 def test_topology_from_json_kinds():
     spec = MachineSpec(num_devices=8, generation="v5e")
     for doc in (

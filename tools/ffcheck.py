@@ -56,7 +56,19 @@ def _build_bert():
     return ff, out
 
 
-BUILDERS = {"dlrm": _build_dlrm, "bert": _build_bert}
+def _build_mlp():
+    # the placement-annotated 2-slice artifact (strategies/
+    # mlp_searched_2slice8.json) was searched at batch 32
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models import build_mlp
+    ff = FFModel(FFConfig())
+    out = build_mlp(ff, 32, in_dim=64, hidden=(256, 256),
+                    num_classes=10)
+    return ff, out
+
+
+BUILDERS = {"dlrm": _build_dlrm, "bert": _build_bert,
+            "mlp": _build_mlp}
 
 
 def _full_verify(path: str, doc: dict, builder):

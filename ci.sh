@@ -51,6 +51,11 @@ case "${1:-fast}" in
     # multi-phase reduction tree (docs/topology.md); the heavyweight
     # >= 1.1x gate lives in the multichip dryrun tier
     python tools/placement_smoke.py
+    # per-parameter ZeRO parity smoke: a searched optimizer-state
+    # sharding assignment must be BIT-IDENTICAL to replicated training
+    # (sharding is placement, not math), and a checkpoint saved under
+    # it must restore into a shrunken 4-device world at the same loss
+    python tools/zero_parity_smoke.py
     # serving chaos smoke: injected inference failures must open the
     # per-model circuit breaker (fast 503 + Retry-After), the half-open
     # probe after the cooldown must restore service, and drain() must

@@ -44,6 +44,14 @@ a step:
      payload is below the DCN bandwidth-latency product, so every step
      pays pure inter-slice latency — is a compile-time error with the
      offending tier attributed.
+  6. **zero** — per-parameter optimizer-state sharding soundness
+     (``strategy.zero``, arXiv 2004.13336): every sharded moment's
+     spec must name real mesh axes, divide its weight's shape, and
+     never reuse an axis the weight's own placement consumes (the
+     collision that turns the reduce-scatter update into GSPMD
+     generic resharding). The memory envelope (check 3) prices the
+     optimizer slots per-parameter against the same assignment, so a
+     plan that only fits *because* of ZeRO verifies.
 
 ``FFModel.compile`` runs this post-search (``FFConfig.plan_verify``,
 ``FF_PLAN_VERIFY=0`` to disable); failures raise
@@ -63,8 +71,8 @@ from ..obs import events as obs_events
 from ..obs.metrics_registry import REGISTRY
 
 __all__ = ["Finding", "PlanReport", "PlanVerificationError",
-           "StructMesh", "verify_plan", "verify_model",
-           "verify_strategy_file"]
+           "StructMesh", "memory_envelope", "verify_plan",
+           "verify_model", "verify_strategy_file"]
 
 
 # ---------------------------------------------------------------------------
@@ -202,25 +210,29 @@ def _check_spec(report: PlanReport, axis_sizes: Dict[str, int], op: str,
 
 
 def _spec_degree(spec, axis_sizes: Dict[str, int]) -> int:
-    deg = 1
-    for axes in _spec_entries(spec):
-        for a in axes:
-            deg *= axis_sizes.get(a, 1)
-    return deg
+    """Total shard degree of a spec (shared definition:
+    ``runtime/zero.spec_degree``)."""
+    from ..runtime.zero import spec_degree
+    return spec_degree(spec, axis_sizes)
 
 
 def _opt_slots(optimizer) -> int:
-    """Optimizer-state leaves per parameter for the memory envelope:
-    Adam-family keeps two moments, momentum-SGD one, plain SGD none.
-    Unknown optimizers are costed at two (conservative)."""
-    if optimizer is None:
-        return 2
-    name = type(optimizer).__name__.lower()
-    if "adam" in name or "lamb" in name:
-        return 2
-    if "sgd" in name:
-        return 1 if getattr(optimizer, "momentum", 0.0) else 0
-    return 2
+    """Optimizer-state leaves per parameter for the memory envelope
+    (shared definition: ``runtime/zero.opt_slots``)."""
+    from ..runtime.zero import opt_slots
+    return opt_slots(optimizer)
+
+
+def _zero_of(strategy, zero=None):
+    """Normalize a per-parameter ZeRO assignment: the explicit ``zero``
+    argument wins, else the strategy's own ``.zero`` attribute; JSON
+    dicts are lifted to :class:`~flexflow_tpu.runtime.zero.
+    ZeroAssignment`. None = fully replicated optimizer state."""
+    from ..runtime.zero import ZeroAssignment
+    z = zero if zero is not None else getattr(strategy, "zero", None)
+    if z is None or isinstance(z, ZeroAssignment):
+        return z
+    return ZeroAssignment.from_json(z)
 
 
 # ---------------------------------------------------------------------------
@@ -261,6 +273,15 @@ def verify_plan(strategy, layers: Sequence, *,
                      getattr(strategy, "axis_tiers", None) or {},
                      getattr(strategy, "collective_trees", None) or (),
                      axis_sizes, spec)
+    unaddressable = _zero_unaddressable(strategy, layers)
+    _check_zero(report, _zero_of(strategy),
+                {name: getattr(os_, "weights", {}) or {}
+                 for name, os_ in getattr(strategy, "ops", {}).items()},
+                {name: {w.name: tuple(w.shape)
+                        for w in (l.weights or ())}
+                 for name, l in by_name.items()},
+                axis_sizes, have_layers=bool(by_name),
+                unaddressable=unaddressable)
 
     report.duration_s = time.perf_counter() - t0
     REGISTRY.counter("ff_plan_verify_runs_total",
@@ -548,14 +569,28 @@ def _check_pipeline_region(report, planner, strategy, region, layers,
 
 # -- check 3: memory envelope -----------------------------------------------
 
-def _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
-                  hbm_bytes, reshard_peak) -> None:
+def memory_envelope(strategy, layers, axis_sizes, optimizer, *,
+                    reshard_peak: float = 0.0,
+                    zero=None) -> Dict[str, float]:
+    """Conservative static per-device memory envelope of one plan:
+    params + grads + optimizer slots + live fwd/bwd activation pair +
+    the largest planned reshard transient.
+
+    The optimizer-slot term is **per-parameter**: a leaf the ZeRO
+    assignment shards (``strategy.zero`` / the ``zero`` argument)
+    counts at ``slots x bytes / (weight degree x zero degree)`` instead
+    of the flat ``params x slots`` — so a plan that only fits *because*
+    of optimizer-state sharding verifies (and the ZeRO planner adopts
+    against the same arithmetic the verifier will enforce). With no
+    assignment the numbers are bit-identical to the historical flat
+    formula. Shared by ``_check_memory`` and
+    ``search/zero_plan.plan_zero_assignment``."""
     from ..dtypes import itemsize as _isz
-    if hbm_bytes is None:
-        hbm_bytes = getattr(spec, "hbm_bytes", None)
-    if not hbm_bytes:
-        return
+    from ..parallel.reshard import tensor_spec
     ops = getattr(strategy, "ops", {})
+    zero_a = _zero_of(strategy, zero)
+    unaddressable = _zero_unaddressable(strategy, layers) \
+        if zero_a is not None else {}
     bank_deg = {}
     for bk in getattr(strategy, "banks", None) or ():
         d = 1
@@ -563,8 +598,10 @@ def _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
             d *= axis_sizes.get(a, 1)
         for m in bk.members:
             bank_deg[m] = max(d, 1)
-    from ..parallel.reshard import tensor_spec
+    slots = _opt_slots(optimizer)
     params_local = 0.0
+    opt_local = 0.0
+    n_zero_sharded = 0
     act_peak, act_op = 0.0, ""
     for layer in layers:
         os_ = ops.get(layer.name)
@@ -573,7 +610,18 @@ def _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
             total = float(int(np.prod(w.shape)) or 1) * _isz(w.dtype)
             deg = _spec_degree(wspecs.get(w.name), axis_sizes)
             deg *= bank_deg.get(layer.name, 1)
-            params_local += total / max(deg, 1)
+            local = total / max(deg, 1)
+            params_local += local
+            # unaddressable layers (bank/place-group/pipeline state
+            # lives under group keys) can never realize zero savings
+            # at runtime — counting them would make the envelope
+            # optimistic (the zero check errors on them separately)
+            zdeg = 1
+            if zero_a is not None and layer.name not in unaddressable:
+                zdeg = zero_a.degree_for(layer.name, w.name)
+            if zdeg > 1:
+                n_zero_sharded += 1
+            opt_local += slots * local / max(zdeg, 1)
         local = 0.0
         for t in list(layer.inputs) + list(layer.outputs):
             total = float(int(np.prod(t.shape)) or 1) * _isz(t.dtype)
@@ -584,31 +632,152 @@ def _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
             local += total / max(_spec_degree(sp, axis_sizes), 1)
         if local > act_peak:
             act_peak, act_op = local, layer.name
-    slots = _opt_slots(optimizer)
-    # params + grads + optimizer slots, live fwd/bwd activation pair,
-    # plus the largest planned reshard transient — a conservative
-    # ENVELOPE (XLA's scheduler can only do better; rematerialization
-    # and fusion shrink the activation term, never grow it)
-    total = params_local * (2 + slots) + 2 * act_peak + reshard_peak
-    report.memory = {
+    total = params_local * 2 + opt_local + 2 * act_peak + reshard_peak
+    return {
         "params_bytes": params_local,
         "grads_bytes": params_local,
-        "opt_state_bytes": params_local * slots,
+        "opt_state_bytes": opt_local,
+        "opt_slots": float(slots),
+        "zero_sharded_params": float(n_zero_sharded),
         "peak_activation_bytes": act_peak,
         "peak_activation_op": act_op,
         "reshard_transient_bytes": reshard_peak,
         "envelope_bytes": total,
-        "hbm_bytes": float(hbm_bytes),
     }
+
+
+def _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
+                  hbm_bytes, reshard_peak) -> None:
+    if hbm_bytes is None:
+        hbm_bytes = getattr(spec, "hbm_bytes", None)
+    if not hbm_bytes:
+        return
+    env = memory_envelope(strategy, layers, axis_sizes, optimizer,
+                          reshard_peak=reshard_peak)
+    # (XLA's scheduler can only do better than this ENVELOPE;
+    # rematerialization and fusion shrink the activation term, never
+    # grow it)
+    report.memory = {**env, "hbm_bytes": float(hbm_bytes)}
+    total = env["envelope_bytes"]
+    act_op = env["peak_activation_op"]
     if total > hbm_bytes:
+        zero_note = ""
+        if env["zero_sharded_params"]:
+            zero_note = (f", with {env['zero_sharded_params']:.0f} "
+                         f"ZeRO-sharded opt leaves already counted")
         report.add(
             "memory", "error", act_op or "<model>",
             f"static per-device envelope {total / 2**20:.1f} MiB exceeds "
             f"the machine model's {hbm_bytes / 2**20:.1f} MiB HBM "
-            f"(params {params_local / 2**20:.1f} MiB x (2 + {slots} opt "
-            f"slots) + 2 x peak activation "
-            f"{act_peak / 2**20:.1f} MiB [{act_op}] + reshard transient "
-            f"{reshard_peak / 2**20:.1f} MiB)", "memory-envelope")
+            f"(params {env['params_bytes'] / 2**20:.1f} MiB x 2 + opt "
+            f"state {env['opt_state_bytes'] / 2**20:.1f} MiB"
+            f"{zero_note} + 2 x peak activation "
+            f"{env['peak_activation_bytes'] / 2**20:.1f} MiB [{act_op}] "
+            f"+ reshard transient {reshard_peak / 2**20:.1f} MiB)",
+            "memory-envelope")
+
+
+# -- check 3.5: per-parameter ZeRO assignment ---------------------------------
+
+def _zero_unaddressable(strategy, layers) -> Dict[str, str]:
+    """Layers whose optimizer state the per-layer assignment CANNOT
+    address at runtime: bank / place-group members (state stacked
+    under the group key on device subsets) and layers inside a
+    pipeline region (state stacked under template keys). The planner
+    excludes them; an imported assignment that shards one would claim
+    envelope savings the runtime can't realize — flagged as an error
+    instead of letting an optimistic plan verify and OOM at step 1."""
+    out: Dict[str, str] = {}
+    for bk in getattr(strategy, "banks", None) or ():
+        for m in bk.members:
+            out[m] = "bank"
+    for pg in getattr(strategy, "place_groups", None) or ():
+        for m in pg.members:
+            out[m] = "place-group"
+    region = getattr(strategy, "pipeline", None)
+    if region is not None:
+        for l in list(layers)[region.start:region.end]:
+            out[l.name] = "pipeline-region"
+    return out
+
+
+def _check_zero(report, zero_a, weight_specs, weight_shapes, axis_sizes,
+                have_layers: bool = True,
+                unaddressable: Optional[Dict[str, str]] = None) -> None:
+    """Soundness of a per-parameter optimizer-state sharding assignment
+    (``strategy.zero``): every sharded moment's spec must name real
+    mesh axes, divide its weight's shape, and — the invariant that
+    makes the GSPMD lowering a reduce-scatter instead of a resharding
+    storm — must NOT reuse a mesh axis the weight's own placement
+    already consumes. A colliding assignment is a typed compile-time
+    error (:class:`PlanVerificationError`), not a runtime surprise."""
+    if zero_a is None:
+        return
+    from ..runtime.zero import spec_axes
+    unaddressable = unaddressable or {}
+    for lname, ws in zero_a.decisions.items():
+        lw_specs = weight_specs.get(lname, {})
+        lw_shapes = weight_shapes.get(lname, {})
+        if lname in unaddressable \
+                and any(rec.get("spec") is not None
+                        for rec in ws.values()):
+            report.add(
+                "zero", "error", lname,
+                f"zero assignment shards optimizer state of "
+                f"{unaddressable[lname]} member {lname!r}, whose state "
+                f"is stacked under a group key the per-layer "
+                f"assignment cannot address — the runtime would leave "
+                f"it replicated while the memory envelope counted it "
+                f"sharded (an optimistic plan that OOMs at step 1)",
+                "zero-assignment")
+            continue
+        if have_layers and lname not in weight_shapes:
+            if any(rec.get("spec") is not None for rec in ws.values()):
+                report.add("zero", "error", lname,
+                           f"zero assignment shards state of op "
+                           f"{lname!r}, which is not in the program",
+                           "zero-assignment")
+            continue
+        for wname, rec in ws.items():
+            sp = rec.get("spec")
+            if sp is None:
+                continue
+            sp = _json_spec(sp) if isinstance(sp, list) else sp
+            shape = lw_shapes.get(wname)
+            if have_layers and lw_shapes and wname not in lw_shapes:
+                report.add("zero", "error", lname,
+                           f"zero assignment shards unknown weight "
+                           f"{wname!r} (weights: {sorted(lw_shapes)})",
+                           "zero-assignment")
+                continue
+            _check_spec(report, axis_sizes, lname,
+                        f"opt-state for weight {wname!r}", sp, shape,
+                        seam="zero-assignment")
+            wspec = lw_specs.get(wname)
+            # the moment FOLLOWS the weight's own placement on the
+            # weight's sharded dims (m/v are zeros_like the param);
+            # the ZeRO axes proper are the EXTRA ones. A weight axis
+            # re-used on a DIFFERENT dim is the collision that turns
+            # the reduce-scatter update into generic resharding.
+            z_entries = _spec_entries(sp)
+            w_entries = _spec_entries(wspec)
+            w_entries += [()] * (len(z_entries) - len(w_entries))
+            w_axes = set(spec_axes(wspec))
+            overlap = sorted(
+                a for d, axes in enumerate(z_entries)
+                for a in axes
+                if a in w_axes and a not in w_entries[d])
+            if overlap:
+                report.add(
+                    "zero", "error", lname,
+                    f"zero assignment shards the {wname!r} optimizer "
+                    f"state over mesh axis(es) {overlap} that the "
+                    f"weight's own placement {wspec} already consumes "
+                    f"on a different dim — the moment must shard over "
+                    f"the axes the weight is REPLICATED on "
+                    f"(reduce-scatter group), or the update "
+                    f"degenerates to GSPMD generic resharding",
+                    "zero-assignment")
 
 
 # -- check 4: collective-ordering consistency --------------------------------
@@ -914,6 +1083,28 @@ def verify_strategy_file(path: str, doc: Optional[Dict] = None
     _check_placement(report, doc.get("axis_tiers") or {},
                      doc.get("collective_trees") or (), axis_sizes,
                      spec)
+    # per-parameter ZeRO assignment (doc["zero"]): axis soundness,
+    # divisibility (when the program's weight shapes are known), and
+    # the weight-axis-overlap rejection
+    zdoc = doc.get("zero")
+    if zdoc:
+        from ..runtime.zero import ZeroAssignment
+        w_specs = {
+            name: {w: _json_spec(s)
+                   for w, s in (os_.get("weights") or {}).items()
+                   if s is not None}
+            for name, os_ in (doc.get("ops") or {}).items()}
+        unaddr = {}
+        for b in doc.get("banks") or ():
+            for m in b.get("members") or ():
+                unaddr[m] = "bank"
+        for g in doc.get("place_groups") or ():
+            for m in g.get("members") or ():
+                unaddr[m] = "place-group"
+        _check_zero(report, ZeroAssignment.from_json(zdoc), w_specs,
+                    weight_shapes, axis_sizes,
+                    have_layers=bool(weight_shapes),
+                    unaddressable=unaddr)
     report.duration_s = time.perf_counter() - t0
     return report
 

@@ -128,8 +128,27 @@ class FFConfig:
     tensor_parallel: int = 1
     sequence_parallel: bool = False
     # ZeRO-1: shard optimizer moments over the replicated mesh axes
-    # (runtime/zero.py); the reference keeps full state per replica
+    # (runtime/zero.py); the reference keeps full state per replica.
+    # This is the legacy UNIFORM flag (every shardable leaf, no
+    # scoring) — pinned bit-identical across releases.
     shard_optimizer_states: bool = False
+    # per-parameter ZeRO in the search space (search/zero_plan.py,
+    # arXiv 2004.13336): the cost model scores each parameter's update
+    # path (replicated all-reduce vs reduce-scatter + sharded update +
+    # all-gather over the placed tier path) and the stack honors the
+    # per-parameter assignment end to end (strategy serialization,
+    # plan verifier, executor state pins, checkpoint meta).
+    #   "off"    — never plan (default);
+    #   "auto"   — shard the predicted-free parameters, plus whatever
+    #              the device-memory envelope needs;
+    #   "memory" — shard only what the envelope needs to fit;
+    #   "all"    — shard everything shardable (the uniform assignment,
+    #              scored and audited).
+    zero_policy: str = "off"
+    # "auto" slack: a parameter shards when its predicted marginal
+    # collective overhead is within this fraction of its replicated
+    # update cost
+    zero_overhead_frac: float = 0.05
     # rematerialization: "none" | "blocks" (jax.checkpoint around each
     # repeated block — HBM-for-FLOPs; executor._emit_remat)
     remat: str = "none"
@@ -350,6 +369,12 @@ class FFConfig:
                 cfg.bf16_activations = True
             elif a in ("--zero", "--shard-optimizer-states"):
                 cfg.shard_optimizer_states = True
+            elif a == "--zero-policy":
+                cfg.zero_policy = take().lower()
+            elif a == "--zero-search":
+                cfg.zero_policy = "auto"
+            elif a == "--zero-overhead-frac":
+                cfg.zero_overhead_frac = float(take())
             elif a == "--remat":
                 cfg.remat = "blocks"
             elif a in ("--gradient-accumulation-steps", "--accum"):

@@ -713,6 +713,15 @@ class FFModel:
             pl = ReshardPlanner(self.dmesh)
             self.strategy.resharder = pl
         pl.audit_path = getattr(self, "_strategy_audit_path", None)
+        # per-parameter ZeRO (search/zero_plan.py, arXiv 2004.13336):
+        # score each parameter's update path (replicated all-reduce vs
+        # reduce-scatter + sharded update + all-gather over the placed
+        # tier path) and adopt an assignment under the device-memory
+        # envelope. Runs BEFORE plan verification so the verifier's
+        # memory envelope and zero-soundness checks bind on the
+        # assignment the run will actually use. The uniform --zero flag
+        # bypasses this entirely (pinned legacy behavior below).
+        self._plan_zero()
         # static plan verification (analysis/plan_verifier.py): prove
         # the adopted strategy executable — axis soundness, shard
         # divisibility, legal reshard lowerings at every seam, memory
@@ -746,6 +755,17 @@ class FFModel:
                                                    self.dmesh)
             self.executor.opt_state_constraints = \
                 state_constraints(self.opt_state)
+        elif self.opt_state and getattr(self.strategy, "zero", None):
+            # per-parameter searched assignment: only the leaves the
+            # plan shards move; the executor pins the updated state to
+            # the assigned specs in-jit so GSPMD lowers the update to
+            # reduce-scatter + sharded math + all-gather per leaf
+            from .runtime.zero import (shard_optimizer_state,
+                                       state_constraints)
+            self.opt_state = shard_optimizer_state(
+                self.opt_state, self.dmesh, self.strategy.zero)
+            self.executor.opt_state_constraints = \
+                state_constraints(self.opt_state)
         self._step = 0
         self.__dict__.setdefault("_compile_phases", {})["compile_s"] = \
             round(time.perf_counter() - _compile_t0, 6)
@@ -773,6 +793,86 @@ class FFModel:
                 self.layers, self.graph_inputs, self.dmesh), None
         from .search.optimizer import optimize_strategy
         return optimize_strategy(self)
+
+    def _plan_zero(self):
+        """Adopt a per-parameter optimizer-state sharding assignment
+        (``FFConfig.zero_policy``, search/zero_plan.py). An assignment
+        already on the strategy (``--import`` round-trip) is honored
+        as-is; the legacy uniform ``--zero`` flag bypasses planning
+        entirely (its behavior is pinned bit-identical)."""
+        cfg = self.config
+        if self.strategy is None:
+            return
+        if self.config.shard_optimizer_states:
+            self.strategy.zero = None
+            return
+        if getattr(self.strategy, "zero", None) is not None:
+            return  # imported with the strategy: honor it verbatim
+        policy = str(getattr(cfg, "zero_policy", "off") or "off").lower()
+        if policy in ("off", "false", "no", ""):
+            return
+        if policy not in ("auto", "memory", "all"):
+            raise ValueError(
+                f"unknown zero_policy {policy!r} "
+                f"(expected off/auto/memory/all)")
+        from .runtime.zero import opt_slots
+        if self.dmesh.num_devices <= 1 \
+                or opt_slots(self.optimizer) <= 0:
+            return
+        if getattr(self.strategy, "pipeline", None) is not None:
+            # pipelined regions stack their parameters (and state)
+            # under template keys the per-layer assignment cannot
+            # address — claiming savings the runtime can't realize
+            # would make the memory envelope optimistic; skip
+            return
+        from .search.zero_plan import audit_record, plan_zero_assignment
+        cost_model = getattr(self, "_search_cost_model", None)
+        if cost_model is None or cost_model.spec is not self.dmesh.spec:
+            # non-searched paths (DP preset, --tp, pipeline presets):
+            # a bare cost model over the machine spec, placement-aware
+            # on multi-tier machines so the collectives price against
+            # their real fabric tier (PR 9)
+            from .search.costmodel import OpCostModel
+            from .search.optimizer import _attach_placement
+            cost_model = OpCostModel(self.dmesh.spec)
+            _attach_placement(cfg, cost_model, self.dmesh)
+        hbm = float(cfg.device_mem_mb) * (1 << 20) \
+            if getattr(cfg, "device_mem_mb", 0) \
+            else getattr(self.dmesh.spec, "hbm_bytes", None)
+        assignment = plan_zero_assignment(
+            self.strategy, self.executor.program.layers, self.dmesh,
+            cost_model, self.optimizer, policy=policy,
+            overhead_frac=getattr(cfg, "zero_overhead_frac", 0.05),
+            hbm_bytes=hbm)
+        self.strategy.zero = assignment
+        if assignment is None:
+            return
+        record = audit_record(assignment)
+        self._zero_record = record
+        audit_path = getattr(self, "_strategy_audit_path", None)
+        if audit_path:
+            from .obs.audit import annotate_strategy_audit
+            annotate_strategy_audit(audit_path, {"zero": record})
+        if cfg.export_strategy_file:
+            # the search exported before the assignment existed (same
+            # ordering as banks): rewrite the zero section so --import
+            # round-trips the per-parameter decision
+            try:
+                import json as _json
+                with open(cfg.export_strategy_file) as f:
+                    doc = _json.load(f)
+                doc["zero"] = assignment.to_json()
+                with open(cfg.export_strategy_file, "w") as f:
+                    _json.dump(doc, f, indent=1)
+            except Exception:  # noqa: BLE001 — export is best-effort
+                pass
+        if cfg.profiling:
+            s = assignment.summary()
+            print(f"zero plan ({policy}): {s['n_sharded']}/"
+                  f"{s['n_params']} opt states sharded, "
+                  f"{s['bytes_saved_total'] / 2**20:.2f} MiB/device "
+                  f"saved, predicted overhead "
+                  f"{s['overhead_s_total'] * 1e3:.3f} ms/step")
 
     # ------------------------------------------------------------------
     def create_data_loader(self, tensor: Tensor, data: np.ndarray):

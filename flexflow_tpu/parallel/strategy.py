@@ -75,6 +75,14 @@ class ShardingStrategy:
         # analysis/plan_verifier's placement pass.
         self.axis_tiers: Dict[str, str] = {}
         self.collective_trees: List[Dict] = []
+        # per-parameter optimizer-state sharding (runtime/zero.py
+        # ZeroAssignment, planned by search/zero_plan.py per arXiv
+        # 2004.13336): layer -> weight -> {spec, degree, bytes_saved,
+        # overhead_s}. None = fully replicated optimizer state (or the
+        # legacy uniform --zero flag, which bypasses the assignment).
+        # Serializes with the strategy and is statically checked by
+        # analysis/plan_verifier's zero pass.
+        self.zero = None
 
     # ------------------------------------------------------------------
     def set_op(self, layer_name: str, outputs: Sequence[Optional[P]],
@@ -151,6 +159,12 @@ class ShardingStrategy:
                 f"  tree {ct.get('site')}/{ct.get('collective')}"
                 f" x{ct.get('degree')}: {ct.get('algo')} over "
                 f"{ct.get('tier_path')}")
+        if self.zero is not None:
+            s = self.zero.summary()
+            lines.append(
+                f"zero: {s['n_sharded']}/{s['n_params']} opt states "
+                f"sharded ({s['policy']}), "
+                f"{s['bytes_saved_total'] / 2**20:.1f} MiB/device saved")
         for name, os in self.ops.items():
             lines.append(f"  {name}: out={os.outputs} w={os.weights}")
         for bk in self.banks:

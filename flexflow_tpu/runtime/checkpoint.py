@@ -761,6 +761,22 @@ def save_model_checkpoint(ff, directory: str, step: Optional[int] = None,
                                for k, v in os_.weights.items()}}
             for name, os_ in ff.strategy.ops.items()}
     meta = {"strategy": strategy_doc, "batch_size": ff.config.batch_size}
+    # per-leaf optimizer-state shardings + the per-parameter ZeRO
+    # assignment (runtime/zero.py): the manifest-level record of what
+    # placement each opt leaf was saved under. Restore re-places onto
+    # the LIVE model's shardings (so a partially-sharded state restores
+    # into ANY world size or assignment — elastic shrink included);
+    # this record is the audit trail that makes that round-trip
+    # inspectable without loading a byte of state.
+    if getattr(ff, "opt_state", None):
+        from .zero import state_sharding_doc
+        try:
+            meta["opt_shardings"] = state_sharding_doc(ff.opt_state)
+        except Exception:  # noqa: BLE001 — metadata is best-effort
+            pass
+    zero_a = getattr(getattr(ff, "strategy", None), "zero", None)
+    if zero_a is not None:
+        meta["zero"] = zero_a.to_json()
     if extra_metadata:
         meta.update(extra_metadata)
     mgr.save(step,

@@ -9,12 +9,27 @@ all-gather(param delta) automatically — no hand-written partitioning of
 the optimizer loop.
 
 Memory effect: Adam's m/v (2x params) and SGD momentum (1x) shrink by the
-data-parallel degree. Enabled by ``FFConfig.shard_optimizer_states``
-(flag ``--shard-optimizer-states`` / ``--zero``).
+data-parallel degree.
+
+Two entry modes (PAPERS.md, arXiv 2004.13336):
+
+  - **uniform** (``FFConfig.shard_optimizer_states``, flag
+    ``--shard-optimizer-states`` / ``--zero``): every leaf takes its
+    :func:`zero_sharding` spec — the pre-search-era all-or-nothing
+    behavior, pinned bit-identical;
+  - **per-parameter** (``FFConfig.zero_policy``, ``search/zero_plan.py``):
+    the cost model scores each parameter's update path (replicated
+    all-reduce vs reduce-scatter + sharded update + all-gather) and the
+    adopted :class:`ZeroAssignment` names exactly which leaves shard and
+    onto which axes. The assignment serializes with the strategy, is
+    statically checked by ``analysis/plan_verifier`` (a moment sharded
+    over its weight's own mesh axis is a compile-time error), and rides
+    the checkpoint manifest so a partially-sharded state round-trips
+    restores into any world size or assignment.
 """
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -27,18 +42,62 @@ def _spec_tuple(x) -> list:
     return out
 
 
-def zero_sharding(x, axis_sizes) -> "P | None":
-    """ZeRO spec for one state leaf: shard the largest dim that is not
-    already sharded over the largest free (unused-by-this-leaf) mesh
-    axes that divide it. None when nothing can be (or need be) sharded."""
-    if getattr(x, "ndim", 0) == 0:
+def _entries_of(spec, rank: int) -> List[Optional[Tuple[str, ...]]]:
+    """Normalize a PartitionSpec / tuple / JSON-list spec to per-dim
+    axis tuples (None = unsharded), padded to ``rank``."""
+    out: List[Optional[Tuple[str, ...]]] = []
+    for e in tuple(spec or ()):
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append(tuple(e))
+        else:
+            out.append((e,))
+    out += [None] * (rank - len(out))
+    return out[:rank]
+
+
+def spec_axes(spec) -> Tuple[str, ...]:
+    """Every mesh axis a spec consumes (flattened, in order)."""
+    axes: List[str] = []
+    for e in _entries_of(spec, len(tuple(spec or ()))):
+        if e:
+            axes.extend(e)
+    return tuple(axes)
+
+
+def spec_degree(spec, axis_sizes: Dict[str, int]) -> int:
+    """Total shard degree of a spec (product of its axes' sizes) —
+    THE shared definition (analysis/plan_verifier and search/zero_plan
+    both price from it)."""
+    deg = 1
+    for a in spec_axes(spec):
+        deg *= axis_sizes.get(a, 1)
+    return deg
+
+
+def zero_spec(shape: Sequence[int], weight_spec,
+              axis_sizes: Dict[str, int]) -> Optional[P]:
+    """ZeRO spec for one state leaf of ``shape`` whose weight is placed
+    by ``weight_spec``: shard the dim that absorbs the LARGEST total
+    degree from the free (unused-by-this-leaf) mesh axes that divide it.
+    None when nothing can be (or need be) sharded.
+
+    Shape-level core of :func:`zero_sharding` — usable at search/verify
+    time with no live array behind it. By construction the returned
+    spec never reuses an axis the weight's own spec consumes (the
+    invariant ``analysis/plan_verifier``'s zero check enforces on
+    serialized assignments).
+    """
+    shape = tuple(int(s) for s in shape)
+    ndim = len(shape)
+    if ndim == 0:
         return None
-    spec = _spec_tuple(x)
-    used = set()
-    for s in spec:
-        if s is None:
-            continue
-        used.update((s,) if isinstance(s, str) else tuple(s))
+    spec = _entries_of(weight_spec, ndim)
+    used: set = set()
+    for e in spec:
+        if e:
+            used.update(e)
     free = sorted(((a, sz) for a, sz in axis_sizes.items()
                    if a not in used and sz > 1),
                   key=lambda t: -t[1])
@@ -48,38 +107,212 @@ def zero_sharding(x, axis_sizes) -> "P | None":
     # axes (not just the largest dim — e.g. shape (12, 8) with free
     # {4, 2} shards dim 1 by 8, not dim 0 by 4)
     best_dim, best_axes, best_deg = None, None, 1
-    for d in range(x.ndim):
+    for d in range(ndim):
         if spec[d] is not None:
             continue
-        axes, rem, deg = [], x.shape[d], 1
+        axes, rem, deg = [], shape[d], 1
         for a, sz in free:
             if rem % sz == 0:
                 axes.append(a)
                 rem //= sz
                 deg *= sz
         if deg > best_deg or (deg == best_deg and best_dim is not None
-                              and x.shape[d] > x.shape[best_dim]):
+                              and shape[d] > shape[best_dim]):
             best_dim, best_axes, best_deg = d, axes, deg
     if best_dim is None or not best_axes:
         return None
-    spec[best_dim] = tuple(best_axes) if len(best_axes) > 1 \
+    out = [e if e is None else (e[0] if len(e) == 1 else tuple(e))
+           for e in spec]
+    out[best_dim] = tuple(best_axes) if len(best_axes) > 1 \
         else best_axes[0]
-    return P(*spec)
+    return P(*out)
 
 
-def shard_optimizer_state(opt_state: Any, dmesh) -> Any:
-    """Re-place every optimizer-state leaf with its ZeRO sharding (leaves
-    with no free axis or no divisible dim stay as initialized)."""
+def zero_sharding(x, axis_sizes) -> "P | None":
+    """ZeRO spec for one live state leaf: shard the largest dim that is
+    not already sharded over the largest free (unused-by-this-leaf) mesh
+    axes that divide it. None when nothing can be (or need be) sharded."""
+    if getattr(x, "ndim", 0) == 0:
+        return None
+    return zero_spec(x.shape, tuple(_spec_tuple(x)), axis_sizes)
+
+
+def opt_slots(optimizer) -> int:
+    """Optimizer-state leaves per parameter: Adam-family keeps two
+    moments, momentum-SGD one, plain SGD none. Unknown optimizers are
+    costed at two (conservative). Shared by the ZeRO planner
+    (``search/zero_plan.py``) and the plan verifier's memory envelope."""
+    if optimizer is None:
+        return 2
+    name = type(optimizer).__name__.lower()
+    if "adam" in name or "lamb" in name:
+        return 2
+    if "sgd" in name:
+        return 1 if getattr(optimizer, "momentum", 0.0) else 0
+    return 2
+
+
+# ---------------------------------------------------------------------------
+# per-parameter assignment (arXiv 2004.13336 in the search space)
+# ---------------------------------------------------------------------------
+class ZeroAssignment:
+    """Per-parameter optimizer-state sharding decisions.
+
+    ``decisions`` maps layer name -> weight name -> a record dict::
+
+        {"spec": <PartitionSpec JSON form or None>,   # None = replicate
+         "degree": int,            # total absorbed shard degree
+         "bytes_saved": float,     # per-device opt-state bytes saved
+         "overhead_s": float,      # predicted marginal collective cost
+         "replicated_s": float}    # predicted replicated-update cost
+
+    The uniform ``--zero`` flag is representable as the "all" assignment
+    (:meth:`uniform`), which reproduces :func:`zero_sharding` leaf for
+    leaf — the pre-per-parameter behavior. Serializes with the strategy
+    (``search/serialization.py``) and into the checkpoint meta.
+    """
+
+    def __init__(self, decisions: Optional[Dict[str, Dict[str, Dict]]]
+                 = None, policy: str = "auto"):
+        self.decisions: Dict[str, Dict[str, Dict]] = decisions or {}
+        self.policy = policy
+
+    # -- queries -------------------------------------------------------
+    def spec_for(self, layer: str, wname: str) -> Optional[P]:
+        rec = self.decisions.get(layer, {}).get(wname)
+        if rec is None or rec.get("spec") is None:
+            return None
+        return P(*[tuple(e) if isinstance(e, list) else e
+                   for e in rec["spec"]])
+
+    def degree_for(self, layer: str, wname: str) -> int:
+        rec = self.decisions.get(layer, {}).get(wname)
+        return int(rec.get("degree", 1)) if rec else 1
+
+    def sharded_params(self) -> List[Tuple[str, str]]:
+        return [(l, w) for l, ws in self.decisions.items()
+                for w, rec in ws.items() if rec.get("spec") is not None]
+
+    def __len__(self) -> int:
+        return sum(len(ws) for ws in self.decisions.values())
+
+    def __bool__(self) -> bool:
+        return len(self.sharded_params()) > 0
+
+    def is_uniform(self) -> bool:
+        """True when every recorded parameter shards (no per-parameter
+        trade was made) — the audit record distinguishes a genuinely
+        non-uniform searched assignment from an all-shard one."""
+        return len(self.sharded_params()) == len(self)
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def uniform(cls, params_meta: Dict[str, Dict[str, Tuple]],
+                strategy, axis_sizes: Dict[str, int]) -> "ZeroAssignment":
+        """The "all" assignment: every leaf takes its :func:`zero_spec`
+        against its weight's strategy placement (bit-identical to the
+        uniform ``--zero`` flag's per-leaf :func:`zero_sharding`)."""
+        out: Dict[str, Dict[str, Dict]] = {}
+        for lname, ws in params_meta.items():
+            os_ = getattr(strategy, "ops", {}).get(lname)
+            for wname, shape in ws.items():
+                wspec = os_.weights.get(wname) if os_ is not None else None
+                sp = zero_spec(shape, wspec, axis_sizes)
+                deg = 1
+                if sp is not None:
+                    for a in spec_axes(sp):
+                        if a not in spec_axes(wspec):
+                            deg *= axis_sizes.get(a, 1)
+                out.setdefault(lname, {})[wname] = {
+                    "spec": None if sp is None else
+                    [list(e) if isinstance(e, tuple) else e for e in sp],
+                    "degree": deg, "bytes_saved": 0.0,
+                    "overhead_s": 0.0, "replicated_s": 0.0}
+        return cls(out, policy="all")
+
+    # -- serialization -------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        return {"policy": self.policy, "decisions": self.decisions}
+
+    @classmethod
+    def from_json(cls, doc: Optional[Dict[str, Any]]
+                  ) -> Optional["ZeroAssignment"]:
+        if not doc:
+            return None
+        return cls(dict(doc.get("decisions", {})),
+                   policy=str(doc.get("policy", "auto")))
+
+    def summary(self) -> Dict[str, Any]:
+        sharded = self.sharded_params()
+        return {
+            "policy": self.policy,
+            "n_params": len(self),
+            "n_sharded": len(sharded),
+            "uniform": self.is_uniform(),
+            "bytes_saved_total": sum(
+                rec.get("bytes_saved", 0.0)
+                for ws in self.decisions.values() for rec in ws.values()),
+            "overhead_s_total": sum(
+                rec.get("overhead_s", 0.0)
+                for ws in self.decisions.values()
+                for rec in ws.values() if rec.get("spec") is not None),
+        }
+
+
+# ---------------------------------------------------------------------------
+# state placement
+# ---------------------------------------------------------------------------
+def _map_state_leaves(opt_state: Any, fn):
+    """Apply ``fn(layer, wname, leaf)`` to every optimizer-state leaf.
+    State trees are ``{slot: {layer: {wname: leaf}}}`` (Adam m/v, SGD v);
+    unrecognized structures fall back to identity on the odd leaves."""
+    if not isinstance(opt_state, dict):
+        return opt_state
+    out = {}
+    for slot, layers in opt_state.items():
+        if not isinstance(layers, dict):
+            out[slot] = layers
+            continue
+        new_layers = {}
+        for lname, ws in layers.items():
+            if not isinstance(ws, dict):
+                new_layers[lname] = ws
+                continue
+            new_layers[lname] = {w: fn(lname, w, leaf)
+                                 for w, leaf in ws.items()}
+        out[slot] = new_layers
+    return out
+
+
+def shard_optimizer_state(opt_state: Any, dmesh,
+                          assignment: Optional[ZeroAssignment] = None
+                          ) -> Any:
+    """Re-place optimizer-state leaves with their ZeRO shardings.
+
+    ``assignment=None`` is the uniform path (the ``--zero`` flag,
+    pinned): every leaf takes its :func:`zero_sharding` spec; leaves
+    with no free axis or no divisible dim stay as initialized. With an
+    assignment, only the leaves it shards move — everything else keeps
+    its replicated placement."""
     mesh = dmesh.mesh
     axis_sizes = dict(dmesh.axis_sizes)
 
-    def reshard(x):
-        spec = zero_sharding(x, axis_sizes)
-        if spec is None:
-            return x
-        return jax.device_put(x, NamedSharding(mesh, spec))
+    if assignment is None:
+        def reshard(x):
+            spec = zero_sharding(x, axis_sizes)
+            if spec is None:
+                return x
+            return jax.device_put(x, NamedSharding(mesh, spec))
 
-    return jax.tree.map(reshard, opt_state)
+        return jax.tree.map(reshard, opt_state)
+
+    def place(lname, wname, leaf):
+        spec = assignment.spec_for(lname, wname)
+        if spec is None:
+            return leaf
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return _map_state_leaves(opt_state, place)
 
 
 def state_constraints(opt_state: Any):
@@ -87,3 +320,20 @@ def state_constraints(opt_state: Any):
     executor pins the updated state to these inside the jitted step so
     XLA cannot silently replicate it back."""
     return jax.tree.map(lambda x: x.sharding, opt_state)
+
+
+def state_sharding_doc(opt_state: Any) -> Dict[str, Any]:
+    """Per-leaf sharding record for the checkpoint meta: key-path ->
+    PartitionSpec JSON form (None = replicated / unsharded host leaf).
+    Restore re-places onto the LIVE model's shardings — this record is
+    the audit trail proving what placement the state was saved under,
+    and lets tooling reason about a partially-sharded checkpoint
+    without loading a byte of it."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+    leaves, _ = tree_flatten_with_path(opt_state)
+    out: Dict[str, Any] = {}
+    for path, leaf in leaves:
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        out[keystr(path)] = None if spec is None else [
+            list(e) if isinstance(e, tuple) else e for e in spec]
+    return out

@@ -43,6 +43,10 @@ def optimize_strategy(ff):
     cost_model.segment_size = max(1, cfg.simulator_segment_size)
     cost_model.max_segments = max(1, cfg.simulator_max_num_segments)
     _attach_placement(cfg, cost_model, dmesh)
+    # the ZeRO planner (FFModel._plan_zero) re-prices per-parameter
+    # update paths against the SAME calibrated, placement-aware model
+    # the search scored the strategy with
+    ff._search_cost_model = cost_model
     import jax
     with obs_events.span("search.calibrate"):
         if jax.devices()[0].platform != "cpu":

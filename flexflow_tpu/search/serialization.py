@@ -54,6 +54,8 @@ def save_strategy(path: str, strategy: ShardingStrategy,
         doc["axis_tiers"] = dict(strategy.axis_tiers)
     if getattr(strategy, "collective_trees", None):
         doc["collective_trees"] = list(strategy.collective_trees)
+    if getattr(strategy, "zero", None) is not None:
+        doc["zero"] = strategy.zero.to_json()
     banks_doc = banks_to_json(strategy)
     if banks_doc:
         doc["banks"] = banks_doc
@@ -466,6 +468,9 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
                          for k, v in doc["axis_tiers"].items()}
     if doc.get("collective_trees"):
         st.collective_trees = list(doc["collective_trees"])
+    if doc.get("zero"):
+        from ..runtime.zero import ZeroAssignment
+        st.zero = ZeroAssignment.from_json(doc["zero"])
     if doc.get("banks"):
         from ..parallel.banks import BankSpec
         st.banks = [BankSpec(list(b["members"]), tuple(b["axes"]),

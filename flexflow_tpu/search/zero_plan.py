@@ -1,0 +1,227 @@
+"""Per-parameter ZeRO planning: optimizer-state sharding as a searched,
+cost-model-scored decision (PAPERS.md, arXiv 2004.13336 "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training").
+
+The uniform ``--zero`` flag shards every moment or none — a global
+choice made after the search already committed a strategy. This module
+makes it a **per-parameter** trade the stack scores and honors:
+
+  - **memory side**: sharding one parameter's optimizer slots over a
+    degree-``d`` group saves ``slots x param_bytes x (1 - 1/d)`` bytes
+    per device (Adam: 2 slots, momentum-SGD: 1);
+  - **time side**: the update path changes from
+    ``all-reduce(grad) + replicated update`` to ``reduce-scatter(grad)
+    + sharded update + all-gather(param)``. Ring algebra makes the two
+    nearly bandwidth-neutral (2(d-1)/d vs (d-1)/d + (d-1)/d), so the
+    marginal cost is mostly latency rounds and tier effects — priced
+    here through :meth:`OpCostModel.xfer_cost` with the assignment's
+    actual mesh axes, so PR 9's tier-aware tables and reduction-tree
+    selection apply (a DCN-crossing all-gather prices as a DCN
+    all-gather, not an ICI one).
+
+Policies (``FFConfig.zero_policy``):
+
+  - ``"off"``  — never plan (default; the uniform flag is untouched);
+  - ``"auto"`` — shard every parameter whose predicted overhead is
+    within ``zero_overhead_frac`` of its replicated update cost (the
+    "free wins"), then shard further — cheapest overhead per byte
+    saved first — only while the static memory envelope exceeds the
+    device budget;
+  - ``"memory"`` — shard nothing unless the replicated envelope
+    exceeds the budget, then the cheapest set that fits;
+  - ``"all"`` — shard everything shardable (the uniform assignment,
+    scored).
+
+The adopted :class:`~flexflow_tpu.runtime.zero.ZeroAssignment`
+serializes with the strategy, is statically verified (a moment sharded
+over its weight's own axis is a compile-time error), annotates the
+strategy audit record under ``"zero"``, and drives the executor's
+in-jit state pins and the checkpoint meta's per-leaf shardings.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dtypes import itemsize
+from ..obs import events as obs_events
+from ..obs.metrics_registry import REGISTRY
+from ..runtime.zero import (ZeroAssignment, opt_slots, spec_axes,
+                            spec_degree, zero_spec)
+
+
+def score_param(cost_model, wbytes_local: float, zero_degree: int,
+                dp_degree: int, slots: int,
+                zero_axes: Optional[Tuple[str, ...]] = None
+                ) -> Tuple[float, float, float]:
+    """Score one parameter's update paths.
+
+    Returns ``(bytes_saved, overhead_s, replicated_s)``:
+
+      - ``bytes_saved`` — per-device optimizer-state bytes the sharding
+        frees: ``slots x wbytes_local x (1 - 1/zero_degree)``;
+      - ``replicated_s`` — the replicated path: one gradient
+        all-reduce over the data-parallel group (the cost the strategy
+        already pays today);
+      - ``overhead_s`` — sharded-path cost minus ``replicated_s``. The
+        sharded path is reduce-scatter(grad) over the assignment's
+        axes, an all-reduce of the scattered gradient over whatever
+        data-parallel degree remains (``dp_degree / zero_degree``, when
+        the free axes don't absorb the whole group), and the parameter
+        all-gather. Near zero on flat fabrics; tier-aware with a
+        placement attached (PR 9).
+    """
+    d = max(int(zero_degree), 1)
+    dp = max(int(dp_degree), 1)
+    saved = float(slots) * float(wbytes_local) * (1.0 - 1.0 / d)
+    base = cost_model.weight_sync_cost(wbytes_local, dp) if dp > 1 else 0.0
+    if d <= 1:
+        return 0.0, 0.0, float(base)
+    rs = cost_model.xfer_cost(wbytes_local, "reduce_scatter", d,
+                              axes=zero_axes)
+    ag = cost_model.xfer_cost(wbytes_local, "all_gather", d,
+                              axes=zero_axes)
+    rest = dp // d
+    mid = cost_model.weight_sync_cost(wbytes_local / d, rest) \
+        if rest > 1 else 0.0
+    return saved, float(rs + mid + ag - base), float(base)
+
+
+def plan_zero_assignment(strategy, layers: Sequence, dmesh, cost_model,
+                         optimizer, *, policy: str = "auto",
+                         overhead_frac: float = 0.05,
+                         hbm_bytes: Optional[float] = None
+                         ) -> Optional[ZeroAssignment]:
+    """Plan the per-parameter assignment for an adopted strategy.
+
+    Scores every trainable parameter, then applies ``policy`` under the
+    static per-device memory envelope (the same conservative envelope
+    the plan verifier enforces — a plan adopted here because it fits
+    *with* ZeRO also verifies). Returns None when nothing is worth (or
+    able to be) sharded — the caller keeps the replicated path.
+    """
+    t0 = time.perf_counter()
+    axis_sizes = dict(dmesh.axis_sizes)
+    n_dev = 1
+    for s in axis_sizes.values():
+        n_dev *= s
+    slots = opt_slots(optimizer)
+    if n_dev <= 1 or slots <= 0:
+        return None
+    ops = getattr(strategy, "ops", {})
+    # bank / place-group members execute on device SUBSETS with their
+    # parameters stacked under a group key — the per-layer assignment
+    # cannot address that state, so they stay replicated
+    subset_members: set = set()
+    for bk in getattr(strategy, "banks", None) or ():
+        subset_members.update(bk.members)
+    for pg in getattr(strategy, "place_groups", None) or ():
+        subset_members.update(pg.members)
+    assignment = ZeroAssignment({}, policy=policy)
+    candidates: List[Tuple[str, str, Dict]] = []
+    for layer in layers:
+        if layer.name in subset_members:
+            continue
+        for w in layer.weights or ():
+            if not getattr(layer, "trainable", True):
+                continue
+            total = float(int(np.prod(w.shape)) or 1) * itemsize(w.dtype)
+            os_ = ops.get(layer.name)
+            wspec = os_.weights.get(w.name) if os_ is not None else None
+            wdeg = spec_degree(wspec, axis_sizes)
+            dp_deg = max(1, n_dev // max(wdeg, 1))
+            sp = zero_spec(w.shape, wspec, axis_sizes)
+            zaxes = tuple(a for a in spec_axes(sp)
+                          if a not in spec_axes(wspec)) if sp else ()
+            zdeg = 1
+            for a in zaxes:
+                zdeg *= axis_sizes.get(a, 1)
+            local = total / max(wdeg, 1)
+            saved, overhead, base = score_param(
+                cost_model, local, zdeg, dp_deg, slots, zaxes or None)
+            rec = {
+                "spec": None,
+                "candidate_spec": None if sp is None else
+                [list(e) if isinstance(e, tuple) else e for e in sp],
+                "degree": 1, "candidate_degree": zdeg,
+                "bytes_saved": 0.0, "candidate_bytes_saved": saved,
+                "overhead_s": overhead, "replicated_s": base,
+            }
+            assignment.decisions.setdefault(layer.name, {})[w.name] = rec
+            if sp is not None and zdeg > 1:
+                candidates.append((layer.name, w.name, rec))
+    if not candidates:
+        return None
+
+    def adopt(rec) -> None:
+        rec["spec"] = rec["candidate_spec"]
+        rec["degree"] = rec["candidate_degree"]
+        rec["bytes_saved"] = rec["candidate_bytes_saved"]
+
+    if policy == "all":
+        for _, _, rec in candidates:
+            adopt(rec)
+    else:
+        if policy == "auto":
+            for _, _, rec in candidates:
+                slack = overhead_frac * max(rec["replicated_s"], 0.0)
+                if rec["overhead_s"] <= slack:
+                    adopt(rec)
+        # memory pressure: shard further (cheapest overhead per byte
+        # saved first) while the static envelope exceeds the device
+        # budget. Each adoption shrinks the envelope by exactly the
+        # candidate's bytes_saved (the same per-leaf formula
+        # memory_envelope applies), so the envelope is computed ONCE
+        # and a running deficit decremented — not O(params^2)
+        if hbm_bytes:
+            from ..analysis.plan_verifier import memory_envelope
+            env = memory_envelope(strategy, layers, axis_sizes,
+                                  optimizer, zero=assignment)
+            deficit = env["envelope_bytes"] - hbm_bytes
+            remaining = sorted(
+                (c for c in candidates if c[2]["spec"] is None),
+                key=lambda c: (max(c[2]["overhead_s"], 0.0)
+                               / max(c[2]["candidate_bytes_saved"], 1.0),
+                               c[0], c[1]))
+            for lname, wname, rec in remaining:
+                if deficit <= 0:
+                    break
+                adopt(rec)
+                deficit -= rec["bytes_saved"]
+    if not assignment:
+        return None
+    summary = assignment.summary()
+    REGISTRY.counter(
+        "ff_zero_plans_total",
+        "Per-parameter ZeRO assignments adopted by policy"
+        ).inc(policy=policy)
+    REGISTRY.gauge(
+        "ff_zero_bytes_saved",
+        "Per-device optimizer-state bytes saved by the last adopted "
+        "ZeRO assignment").set(summary["bytes_saved_total"])
+    obs_events.record_span(
+        "zero.plan", t0, time.perf_counter() - t0,
+        policy=policy, n_params=summary["n_params"],
+        n_sharded=summary["n_sharded"])
+    return assignment
+
+
+def audit_record(assignment: ZeroAssignment) -> Dict[str, Any]:
+    """The strategy-audit ``"zero"`` section: the summary plus every
+    parameter's choice with its bytes-saved / predicted-overhead score —
+    a regressed assignment is diagnosable from artifacts alone."""
+    per_param = []
+    for lname, ws in assignment.decisions.items():
+        for wname, rec in ws.items():
+            per_param.append({
+                "param": f"{lname}/{wname}",
+                "sharded": rec.get("spec") is not None,
+                "spec": rec.get("spec"),
+                "degree": rec.get("degree", 1),
+                "bytes_saved": rec.get("bytes_saved", 0.0),
+                "overhead_s": rec.get("overhead_s", 0.0),
+                "replicated_s": rec.get("replicated_s", 0.0),
+            })
+    return {**assignment.summary(), "per_param": per_param}

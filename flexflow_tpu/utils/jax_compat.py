@@ -12,6 +12,26 @@ collective calibration entirely without a trace.
 from __future__ import annotations
 
 
+def enable_partitionable_rng() -> None:
+    """Make ``jax.random`` bit-generation invariant under GSPMD
+    sharding (``jax_threefry_partitionable``). On the JAX versions this
+    package supports the flag defaults OFF, and with it off the SPMD
+    partitioner may generate *different* random bits when an rng
+    consumer's output is sharded — the root cause of the standing
+    ``test_tp_flag_matches_dp_numerics`` failure: the same dropout key
+    produced different masks under ``--tp 4`` and ``--only-data-
+    parallel``, so the two mathematically-identical strategies trained
+    on different data. Partitionable threefry derives each element's
+    bits from its GLOBAL index, so every sharding of the same op sees
+    the same mask. Called once at package import; best-effort on JAX
+    builds that dropped the flag (they are partitionable-by-default)."""
+    import jax
+    try:
+        jax.config.update("jax_threefry_partitionable", True)
+    except Exception:  # noqa: BLE001 — newer JAX: already the default
+        pass
+
+
 def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
     """Version-portable ``shard_map``; ``check_vma`` maps onto the old
     API's ``check_rep`` (None = library default on both)."""

@@ -450,3 +450,104 @@ def test_verify_strategy_file_structural_errors(tmp_path):
     assert not report.ok()
     msgs = " ".join(f.message for f in report.errors)
     assert "unknown mesh axis" in msgs and "reuses mesh axis" in msgs
+
+
+# ===========================================================================
+# per-parameter ZeRO: known-bad fixture + envelope + CLI (ISSUE 10)
+# ===========================================================================
+
+def test_badplan_zero_overlap_rejected():
+    """Fixture C: a per-parameter ZeRO assignment that shards a moment
+    over the mesh axis its weight is already column-parallel on — on a
+    DIFFERENT dim. The zero check must reject with the axis overlap
+    attributed to the op."""
+    path = os.path.join(FIXTURES, "badplan_zero_overlap.json")
+    report = verify_strategy_file(path)
+    assert not report.ok()
+    hits = [f for f in report.errors if f.check == "zero"]
+    assert hits, [f.format() for f in report.errors]
+    assert any(f.op == "op_linear_1" and f.seam == "zero-assignment"
+               and "x1" in f.message for f in hits), \
+        [f.format() for f in hits]
+
+
+def test_badplan_zero_overlap_rejected_via_ffcheck_cli(tmp_path):
+    """The same fixture through `ffcheck --verify-strategies` (the ci.sh
+    gate's entry point): exit 1 with the zero finding printed."""
+    import shutil
+    d = tmp_path / "strategies"
+    d.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "badplan_zero_overlap.json"),
+                str(d / "badplan_zero_overlap.json"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ffcheck.py"),
+         "--verify-strategies", str(d)],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "zero" in r.stdout and "op_linear_1" in r.stdout, r.stdout
+
+
+def test_zero_assignment_moment_may_follow_weight_axes():
+    """The NON-bug the overlap check must not flag: the moment spec
+    carries the weight's own axis on the weight's own dim (m/v are
+    zeros_like the param) plus a free axis elsewhere."""
+    from flexflow_tpu.analysis.plan_verifier import _check_zero
+    from flexflow_tpu.analysis.plan_verifier import PlanReport
+    from flexflow_tpu.runtime.zero import ZeroAssignment
+    report = PlanReport()
+    za = ZeroAssignment({"op": {"kernel": {
+        "spec": [["x0"], ["x1"]], "degree": 2}}})
+    _check_zero(report, za, {"op": {"kernel": (None, "x1")}},
+                {"op": {"kernel": (64, 64)}}, {"x0": 2, "x1": 4})
+    assert report.ok(), [f.format() for f in report.findings]
+
+
+def test_memory_envelope_per_parameter_zero():
+    """A plan that only fits BECAUSE of its ZeRO assignment verifies:
+    the envelope's opt-state term shrinks by each sharded leaf's
+    degree (and is bit-identical to the flat formula with no
+    assignment)."""
+    from flexflow_tpu.analysis.plan_verifier import memory_envelope
+    from flexflow_tpu.runtime.zero import ZeroAssignment
+    from flexflow_tpu import AdamOptimizer
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    ff, out = _mlp(cfg, hidden=(64, 64))
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+               [], output_tensor=out)
+    layers = ff.executor.program.layers
+    axis_sizes = dict(ff.dmesh.axis_sizes)
+    opt = AdamOptimizer(0.01)
+    flat = memory_envelope(ff.strategy, layers, axis_sizes, opt)
+    assert flat["opt_state_bytes"] == 2 * flat["params_bytes"]
+    assert flat["zero_sharded_params"] == 0
+    # shard one kernel's state by 8: its 2-slot term shrinks 8x
+    za = ZeroAssignment({"op_linear_1": {"kernel": {
+        "spec": [["x0"], None], "degree": 8}}})
+    z = memory_envelope(ff.strategy, layers, axis_sizes, opt, zero=za)
+    kernel_bytes = 64 * 64 * 4
+    saved = 2 * kernel_bytes * (1 - 1 / 8)
+    assert abs((flat["opt_state_bytes"] - z["opt_state_bytes"])
+               - saved) < 1e-6
+    assert z["zero_sharded_params"] == 1
+    assert flat["envelope_bytes"] - z["envelope_bytes"] == saved
+
+
+def test_zero_assignment_on_bank_member_rejected():
+    """An (imported) assignment sharding a bank member's moments is an
+    error: that state is stacked under the group key at runtime and
+    would stay replicated while the envelope counted it sharded."""
+    from flexflow_tpu.analysis.plan_verifier import (PlanReport,
+                                                     _check_zero)
+    from flexflow_tpu.runtime.zero import ZeroAssignment
+    report = PlanReport()
+    za = ZeroAssignment({"emb_0": {"weight": {
+        "spec": [["x0"]], "degree": 2}}})
+    _check_zero(report, za, {}, {"emb_0": {"weight": (50, 16)}},
+                {"x0": 2, "x1": 4},
+                unaddressable={"emb_0": "bank"})
+    assert not report.ok()
+    assert any(f.check == "zero" and "bank" in f.message
+               and "replicated" in f.message for f in report.errors), \
+        [f.format() for f in report.errors]

@@ -88,6 +88,15 @@ def _scripted(default_probe_results):
             return {"baseline_step_s": 0.1, "ckpt_sync_overhead_pct": 2.3,
                     "ckpt_async_overhead_pct": 1.1, "ckpt_every": 10,
                     "time_to_recover_s": 0.5, "ok": True}, None
+        if stage == "zero_memory":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"opt_bytes_sharded": 835624,
+                    "opt_bytes_replicated": 2408528,
+                    "mem_ratio": 0.3469, "dp_degree": 4,
+                    "n_sharded_params": 2, "step_time_ratio": 1.01,
+                    "ok": True}, None
         raise AssertionError(f"unexpected stage {args}")
 
     return fake_run_stage, calls

@@ -62,6 +62,38 @@ def test_tp_full_device_count():
     assert np.isfinite(_step(ff, bcfg))
 
 
+def test_rng_bits_invariant_under_sharding():
+    """Regression pin for the root cause of the standing
+    ``test_tp_flag_matches_dp_numerics`` failure: with
+    ``jax_threefry_partitionable`` off (the JAX default here), GSPMD
+    generates DIFFERENT random bits when an rng consumer's output is
+    sharded — the same dropout key produced different masks under
+    --tp 4 and --only-data-parallel, so two mathematically identical
+    strategies trained on different data. The package enables the flag
+    at import (utils/jax_compat.enable_partitionable_rng); this test
+    fails if that ever regresses."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    assert jax.config.jax_threefry_partitionable
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()).reshape(2, 4), ("a", "b"))
+    key = jax.random.key(42)
+
+    @jax.jit
+    def plain(k):
+        return jax.random.bernoulli(k, 0.9, (8, 16, 64))
+
+    @jax.jit
+    def sharded(k):
+        m = jax.random.bernoulli(k, 0.9, (8, 16, 64))
+        return jax.lax.with_sharding_constraint(
+            m, NamedSharding(mesh, P("a", None, "b")))
+
+    np.testing.assert_array_equal(np.asarray(plain(key)),
+                                  np.asarray(sharded(key)))
+
+
 def test_bad_combinations_rejected():
     import pytest
     with pytest.raises(ValueError, match="--sp requires"):

@@ -449,6 +449,99 @@ def stage_obs_overhead(steps: int):
            "ok": pct <= 3.0})
 
 
+def stage_attribution_overhead(steps: int):
+    """Attribution-mode overhead on the virtual mesh (ISSUE 12
+    acceptance: <= 5% per-step delta with attribution ON, ~0% off).
+
+    FF_ATTRIB adds NO per-step instrumentation of its own — the harness
+    runs once after training — so the per-step cost of an attribution
+    run is exactly the span tracing it implies. Measured here on one
+    compiled executable, interleaved chunks:
+
+      - ``on``:  tracing enabled + instrumented wrapper (what a run
+        with FF_ATTRIB=1 pays every step) vs the raw callable;
+      - ``off``: tracing disabled + wrapper (FF_ATTRIB=0) vs raw — the
+        near-zero disabled path.
+
+    The one-time harness wall (profile K steps + drift report) is
+    reported as ``harness_s``, outside the per-step gate by design."""
+    _apply_platform_env()
+    import numpy as np
+    import jax.numpy as jnp
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    from flexflow_tpu.obs import events
+
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.search_budget = 4       # searched plan -> audit record to
+    #                             attribute against
+    cfg.attribution = "false"   # the harness is invoked explicitly
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 32, in_dim=64, hidden=(128, 128), num_classes=10)
+    events.enable()             # the audit record only writes when
+    #                             tracing is on at search time
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               output_tensor=out)
+    events.disable()
+    events.clear()
+    rng = np.random.default_rng(0)
+    batch = {"input": rng.normal(size=(32, 64)).astype(np.float32),
+             "label": rng.integers(0, 10, size=(32, 1)).astype(np.int32)}
+    wrapped = ff.executor.make_train_step()
+    raw = wrapped.__wrapped__
+    carry = [ff.params, ff.opt_state, ff.state]
+    it = [0]
+
+    def run_chunk(fn, n):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            p, o, s, bm = fn(carry[0], carry[1], carry[2],
+                             jnp.int32(it[0]), batch)
+            _sync_fetch(bm["loss"])
+            ts.append(time.perf_counter() - t0)
+            carry[:] = [p, o, s]
+            it[0] += 1
+        return ts
+
+    run_chunk(wrapped, 3)               # compile + warm
+    steps = max(steps, 16)
+    chunk = max(2, steps // 8)
+    on_ts, off_ts, raw_ts = [], [], []
+    for _ in range(8):                  # interleave to debias drift
+        events.enable()
+        on_ts += run_chunk(wrapped, chunk)
+        events.disable()
+        off_ts += run_chunk(wrapped, chunk)
+        raw_ts += run_chunk(raw, chunk)
+    t_on, t_off, t_raw = min(on_ts), min(off_ts), min(raw_ts)
+    # on-vs-off shares the exact wrapper (the delta is the tracing
+    # FF_ATTRIB implies); off-vs-raw is the wrapper's disabled cost —
+    # the same <= 3% contract the obs_overhead leg pins
+    on_pct = (t_on / t_off - 1.0) * 100.0
+    off_pct = (t_off / t_raw - 1.0) * 100.0
+    # one-time harness cost + proof the measured side lands; the timed
+    # chunks DONATED the model's original arrays — hand the live carry
+    # back before profiling
+    ff.params, ff.opt_state, ff.state = carry
+    events.enable()
+    from flexflow_tpu.obs import attribution as obs_attrib
+    t0 = time.perf_counter()
+    side = obs_attrib.run_attribution(ff, steps=3)
+    harness_s = time.perf_counter() - t0
+    events.disable()
+    _emit({"attrib_on_step_s": round(t_on, 6),
+           "attrib_off_step_s": round(t_off, 6),
+           "raw_step_s": round(t_raw, 6),
+           "overhead_on_pct": round(on_pct, 3),
+           "overhead_off_pct": round(off_pct, 3),
+           "harness_s": round(harness_s, 3),
+           "measured_entries": len(side["per_op"]) if side else 0,
+           "ok": on_pct <= 5.0 and off_pct <= 3.0
+           and side is not None})
+
+
 def stage_dispatch_overlap(steps: int):
     """Async-dispatch leg (ISSUE 4 acceptance): paired sync-every-step
     vs deferred-metrics throughput, single CPU device (the parent
@@ -1161,6 +1254,29 @@ def main():
         else:
             errors.append(f"obs_overhead: {err}")
 
+    # -- stage 5.41: attribution-mode overhead (virtual mesh) ---------
+    # ISSUE 12 acceptance: FF_ATTRIB=1 costs <= 5% per step (it's the
+    # tracing it implies — the harness itself runs post-fit), ~0% off;
+    # the one-time harness wall rides along as context
+    if remaining() > 120:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            xf = (xf + " --xla_force_host_platform_device_count=8").strip()
+        aenv = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": xf}
+        at, err = stage(["--stage", "attribution_overhead", "--steps",
+                         "24"], 300, aenv)
+        if at is not None:
+            out["attrib_overhead_on_pct"] = at["overhead_on_pct"]
+            out["attrib_overhead_off_pct"] = at["overhead_off_pct"]
+            out["attrib_harness_s"] = at["harness_s"]
+            if not at["ok"]:
+                errors.append(
+                    f"attribution: overhead on={at['overhead_on_pct']}%"
+                    f" (gate 5%) off={at['overhead_off_pct']}% "
+                    f"(gate 3%), entries={at['measured_entries']}")
+        else:
+            errors.append(f"attribution_overhead: {err}")
+
     # -- stage 5.42: async-dispatch overlap (single CPU device) -------
     # ISSUE 4 acceptance: the deferred-metrics loop must be at least as
     # fast as sync-every-step (paired median-of-ratios) — the overlap
@@ -1367,6 +1483,8 @@ if __name__ == "__main__":
         stage_virtual(a.budget, a.steps)
     elif a.stage == "obs_overhead":
         stage_obs_overhead(a.steps)
+    elif a.stage == "attribution_overhead":
+        stage_attribution_overhead(a.steps)
     elif a.stage == "dispatch_overlap":
         stage_dispatch_overlap(a.steps)
     elif a.stage == "reshard":

@@ -56,6 +56,11 @@ case "${1:-fast}" in
     # (sharding is placement, not math), and a checkpoint saved under
     # it must restore into a shrunken 4-device world at the same loss
     python tools/zero_parity_smoke.py
+    # attribution smoke: search -> 3 train steps under FF_ATTRIB=1 ->
+    # the strategy audit record must carry a measured per-op side keyed
+    # 1:1 to the predicted entries AND a drift report must exist — the
+    # prediction-vs-reality loop (docs/observability.md) on every push
+    python tools/attribution_smoke.py
     # serving chaos smoke: injected inference failures must open the
     # per-model circuit breaker (fast 503 + Retry-After), the half-open
     # probe after the cooldown must restore service, and drain() must

@@ -87,6 +87,18 @@ class FFConfig:
     # write a Chrome trace-event JSON (Perfetto/TensorBoard-viewable)
     # of the recorded spans here when fit() completes; "" = off
     trace_export_file: str = ""
+    # step-time attribution (obs/attribution.py): profile a few
+    # steady-state steps of the compiled plan when training completes
+    # and write a MEASURED per-op/per-collective cost side into the
+    # strategy audit record next to the predicted ones, then run the
+    # cost-model drift detector (obs/drift.py) over the pair. "auto"
+    # honors FF_ATTRIB; enabling implies tracing (the audit record the
+    # measured side lands in only exists when tracing is on). Adds no
+    # per-step work — the harness runs once, after the last epoch.
+    attribution: str = "auto"     # "auto" | "true" | "false"
+    # steady-state steps the attribution harness profiles
+    # (FF_ATTRIB_STEPS overrides)
+    attribution_steps: int = 3
     # -------- execution --------
     perform_fusion: bool = False
     allow_tensor_op_math_conversion: bool = True   # = allow bf16 matmul accum
@@ -315,6 +327,12 @@ class FFConfig:
             elif a == "--trace-export":
                 cfg.trace_export_file = take()
                 cfg.trace = "true"
+            elif a == "--attribution":
+                cfg.attribution = "true"
+            elif a == "--no-attribution":
+                cfg.attribution = "false"
+            elif a == "--attribution-steps":
+                cfg.attribution_steps = int(take())
             elif a == "--fusion":
                 cfg.perform_fusion = True
             elif a == "--profiling":

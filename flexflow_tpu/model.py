@@ -553,7 +553,16 @@ class FFModel:
             # (per-rank heartbeats + bounded barriers) alongside it —
             # every later cross-rank wait goes through it
             from .resilience import coord
-            coord.ensure_started(self.config)
+            c = coord.ensure_started(self.config)
+            try:
+                # clock handshake for cross-rank trace alignment
+                # (tools/fftrace.py): one bounded barrier, every rank
+                # anchors its monotonic clock at the release instant.
+                # Unconditional — every rank reaches compile, so the
+                # rendezvous can never depend on per-rank trace flags
+                c.clock_sync("compile")
+            except Exception:  # noqa: BLE001 — alignment is best-effort
+                pass
         if machine_spec is not None:
             spec = machine_spec
         elif self.config.machine_model_file:
@@ -1006,7 +1015,34 @@ class FFModel:
             from .obs.trace_export import export_chrome_trace
             if obs_events.enabled():
                 export_chrome_trace(self.config.trace_export_file)
+        self._end_of_training_telemetry()
         return history
+
+    def _end_of_training_telemetry(self) -> None:
+        """End-of-training observability hooks shared by :meth:`fit`
+        and the resilience Supervisor: the step-time attribution
+        harness (``FF_ATTRIB`` — profiles the compiled plan once and
+        writes the measured side + drift report next to the predicted
+        audit breakdown) and the per-rank ring dump that
+        ``tools/fftrace.py`` merges across a multi-process world. Both
+        best-effort, both strictly after the last step — zero per-step
+        cost."""
+        from .obs import attribution as obs_attrib
+        from .obs import events as obs_events
+        if obs_attrib.attribution_enabled(self.config):
+            try:
+                obs_attrib.run_attribution(self)
+            except Exception as e:  # noqa: BLE001 — never kill training
+                import logging
+                logging.getLogger("flexflow_tpu").warning(
+                    "attribution failed: %r", e)
+        if obs_events.enabled():
+            import jax
+            from .obs.events import _env_on
+            if jax.process_count() > 1 \
+                    or _env_on(os.environ.get("FF_TRACE_DUMP")):
+                from .obs.trace_export import dump_rank_trace
+                dump_rank_trace()
 
     def _run_train_step(self, step_fn, batch):
         # fault-injection sites (resilience/faults.py): crash/device-loss

@@ -1,20 +1,29 @@
-"""Unified telemetry: span tracing, metrics, and strategy audit records.
+"""Unified telemetry: tracing, metrics, audit records, attribution.
 
-Three pieces, wired through all three execution layers (search,
-executor, serving):
+Pieces, wired through all three execution layers (search, executor,
+serving) plus the resilience runtime:
 
   - :mod:`.events` — thread-safe ring-buffered span/counter recorder,
     near-zero-cost when disabled, enabled via ``FF_TRACE=1`` or
     ``FFConfig.trace``;
   - :mod:`.trace_export` — Chrome trace-event JSON export of the
-    recorded spans (Perfetto / TensorBoard-viewable, composable with
-    the ``jax.profiler`` regions in ``utils/profiling.py``);
+    recorded spans (Perfetto / TensorBoard-viewable) plus the per-rank
+    ring dumps ``tools/fftrace.py`` merges across a multi-process
+    world;
   - :mod:`.metrics_registry` — counters/gauges/histograms with
     Prometheus text exposition (served at ``GET /metrics`` by both
     HTTP front-ends);
   - :mod:`.audit` — per-op predicted-cost breakdowns of each search
     adoption (searched vs DP baseline), persisted to
-    ``.ffcache/strategy_audit_<hash>.json``.
+    ``.ffcache/strategy_audit_<hash>.json``;
+  - :mod:`.attribution` — step-time attribution: measured per-op /
+    per-collective costs of the compiled plan, written as the
+    ``measured`` side of the audit record (``FF_ATTRIB=1``);
+  - :mod:`.drift` — predicted-vs-measured drift detection, attributed
+    to the calibration rows that produced the predictions (stale rows
+    are re-measured on the next calibration load);
+  - :mod:`.flight` — bounded flight-recorder dumps at failure sites
+    (RankFailure, NaN rollback, unhandled crash).
 
 See docs/observability.md.
 """
@@ -22,8 +31,10 @@ from . import events
 from .audit import load_strategy_audit, workload_key
 from .events import counter, instant, span
 from .metrics_registry import REGISTRY, MetricsRegistry, get_registry
-from .trace_export import export_chrome_trace, to_chrome_trace
+from .trace_export import (dump_rank_trace, export_chrome_trace,
+                           to_chrome_trace)
 
 __all__ = ["events", "span", "counter", "instant", "REGISTRY",
            "MetricsRegistry", "get_registry", "to_chrome_trace",
-           "export_chrome_trace", "workload_key", "load_strategy_audit"]
+           "export_chrome_trace", "dump_rank_trace", "workload_key",
+           "load_strategy_audit"]

@@ -1,0 +1,184 @@
+"""Cost-model drift detection: predicted vs measured, row by row.
+
+Input: a strategy audit record that carries BOTH a predicted
+``adopted`` side (the additive evaluator's per-op breakdown, written at
+search time) and a ``measured`` side (the attribution harness',
+obs/attribution.py) keyed 1:1 by op name. For every entry and every
+component (compute / xfer / sync) the detector computes the
+measured/predicted ratio and flags the out-of-band ones — ratio outside
+``[1/band, band]`` with at least one side above the noise floor.
+
+Each flagged ratio is **attributed to the calibration row that produced
+the prediction**: the evaluator's breakdown path runs with the cost
+model's provenance tap installed (``OpCostModel.provenance``), so every
+predicted entry carries the ``(backend, dtype, shape-class, axis-size,
+tier)`` table keys its pricing consulted. The drift report names them,
+``ff_costmodel_drift_total{table}`` counts them, and the keys are
+**marked stale** in the calibration sidecar
+(``CalibrationTable.mark_stale``) — the next calibration load treats
+exactly those rows as misses and re-measures only them, leaving every
+healthy row warm. Predictions that never touched a measured table
+(analytic roofline, uncalibrated runs) are reported under
+``table="analytic"`` and mark nothing.
+
+Knobs: ``FF_DRIFT_BAND`` (default 4.0 — the CPU sim's dispatch jitter
+makes tighter bands noisy) and ``FF_DRIFT_MIN_S`` (default 1e-4 s —
+entries cheaper than one host dispatch on both sides carry no signal).
+
+Reports land in ``<repo>/.ffcache/drift_report_<workload>.json`` next
+to the audit record they were derived from.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import events as obs_events
+from .metrics_registry import REGISTRY
+
+_DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".ffcache")
+
+SCHEMA_VERSION = 1
+DEFAULT_BAND = 4.0
+DEFAULT_MIN_SECONDS = 1e-4
+
+#: audit-entry components diffed independently; the provenance ``term``
+#: of each calibration row selects which component it explains
+_COMPONENTS = ("compute", "xfer", "sync")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def _component(entry: Dict[str, Any], comp: str) -> float:
+    if comp == "compute":
+        return float(entry.get("fwd_s", 0.0)) \
+            + float(entry.get("bwd_s", 0.0))
+    return float(entry.get(f"{comp}_s", 0.0))
+
+
+def drift_report_path(key: str, cache_dir: Optional[str] = None) -> str:
+    return os.path.join(cache_dir or _DEFAULT_DIR,
+                        f"drift_report_{key}.json")
+
+
+def detect_drift(doc: Dict[str, Any], band: Optional[float] = None,
+                 min_s: Optional[float] = None) -> Dict[str, Any]:
+    """Diff the ``adopted`` (predicted) and ``measured`` sides of one
+    audit record. Pure — no files, no counters; see
+    :func:`detect_and_write` for the persisted + metered entry point."""
+    band = band if band is not None \
+        else _env_float("FF_DRIFT_BAND", DEFAULT_BAND)
+    band = max(1.0 + 1e-9, float(band))
+    min_s = min_s if min_s is not None \
+        else _env_float("FF_DRIFT_MIN_S", DEFAULT_MIN_SECONDS)
+    predicted = (doc.get("adopted") or {}).get("per_op") or []
+    measured = {e.get("name"): e
+                for e in (doc.get("measured") or {}).get("per_op") or []}
+    out: List[Dict[str, Any]] = []
+    n_compared = 0
+    for pe in predicted:
+        me = measured.get(pe.get("name"))
+        if me is None or not me.get("measured"):
+            continue
+        prov = pe.get("calib") or []
+        for comp in _COMPONENTS:
+            if comp == "sync" and not me.get("sync_measured", True):
+                # the harness found no mesh-axis group realizing the
+                # dp degree, so measured sync is 0 by omission, not by
+                # observation — diffing it would stale-mark healthy rows
+                continue
+            p = _component(pe, comp)
+            m = _component(me, comp)
+            if p < min_s and m < min_s:
+                continue
+            n_compared += 1
+            ratio = m / max(p, 1e-12)
+            if 1.0 / band <= ratio <= band:
+                continue
+            rows = [r for r in prov if r.get("term") == comp]
+            keys = sorted({r["key"] for r in rows if r.get("key")})
+            tables = sorted({r.get("table") or "analytic"
+                             for r in rows}) or ["analytic"]
+            out.append({
+                "name": pe.get("name"),
+                "op_type": pe.get("op_type"),
+                "component": comp,
+                "predicted_s": p,
+                "measured_s": m,
+                "ratio": ratio,
+                "tables": tables,
+                "calibration_keys": keys,
+            })
+    stale = sorted({k for e in out for k in e["calibration_keys"]})
+    return {
+        "schema": SCHEMA_VERSION,
+        "workload_key": doc.get("workload_key"),
+        "band": band,
+        "min_s": min_s,
+        "measured_mode": (doc.get("measured") or {}).get("mode"),
+        "n_compared": n_compared,
+        "n_out_of_band": len(out),
+        "out_of_band": out,
+        "stale_keys": stale,
+    }
+
+
+def detect_and_write(doc: Dict[str, Any],
+                     cache_dir: Optional[str] = None,
+                     band: Optional[float] = None,
+                     min_s: Optional[float] = None,
+                     mark_stale: bool = True) -> Optional[str]:
+    """Run the detector, bump ``ff_costmodel_drift_total{table}``, mark
+    the attributed calibration rows stale, and persist the drift report
+    JSON. Returns the report path (None when the write failed)."""
+    t0 = time.perf_counter()
+    report = detect_drift(doc, band=band, min_s=min_s)
+    for e in report["out_of_band"]:
+        for table in e["tables"]:
+            REGISTRY.counter(
+                "ff_costmodel_drift_total",
+                "Out-of-band predicted-vs-measured cost entries, by "
+                "the calibration table that produced the prediction"
+            ).inc(table=table)
+        obs_events.counter("drift.out_of_band")
+    report["stale_marked"] = 0
+    if mark_stale and report["stale_keys"]:
+        try:
+            from ..search.calibration import CalibrationTable
+            report["stale_marked"] = CalibrationTable(
+                cache_dir).mark_stale(report["stale_keys"])
+            if report["stale_marked"]:
+                REGISTRY.counter(
+                    "ff_calibration_rows_staled_total",
+                    "Calibration rows marked for re-measurement by the "
+                    "drift detector").inc(report["stale_marked"])
+        except Exception:  # noqa: BLE001 — marking is best-effort
+            pass
+    report["generated_unix_s"] = time.time()
+    key = report.get("workload_key") or "unknown"
+    path = drift_report_path(key, cache_dir)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — reporting must never raise
+        return None
+    obs_events.record_span("obs.drift", t0, time.perf_counter() - t0,
+                           out_of_band=report["n_out_of_band"],
+                           stale=report["stale_marked"])
+    return path
+
+
+def load_drift_report(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)

@@ -89,12 +89,20 @@ def configure(cfg) -> None:
     "auto" (the default) leaves the FF_TRACE / explicit-enable decision
     untouched — except that a non-empty ``trace_export_file`` implies
     tracing (requesting an export of an empty trace is never what the
-    caller meant; the ``--trace-export`` flag applies the same rule)."""
+    caller meant; the ``--trace-export`` flag applies the same rule),
+    and so does an enabled attribution harness (``FF_ATTRIB`` /
+    ``FFConfig.attribution``): the measured side it produces lands in
+    the strategy audit record, which only exists when tracing is on."""
     mode = str(getattr(cfg, "trace", "auto") or "auto").lower()
     if mode in ("false", "off", "0", "no"):
         disable()
-    elif _env_on(mode) or mode == "true" \
-            or getattr(cfg, "trace_export_file", ""):
+        return
+    attrib = False
+    if getattr(cfg, "attribution", None) is not None:
+        from . import attribution as _attrib
+        attrib = _attrib.attribution_enabled(cfg)
+    if _env_on(mode) or mode == "true" \
+            or getattr(cfg, "trace_export_file", "") or attrib:
         enable()
 
 
@@ -111,6 +119,24 @@ def counters() -> Dict[str, float]:
         return dict(_counters)
 
 
+_drop_counter = None
+
+
+def _count_drop() -> None:
+    """Mirror ring-wraparound drops into the always-on Prometheus
+    registry (``ff_trace_events_dropped_total``): overflow used to be
+    silent — invisible unless someone compared ``dropped()`` by hand.
+    Only runs when an event is actually overwritten, so the disabled
+    path and the non-full ring pay nothing."""
+    global _drop_counter
+    if _drop_counter is None:
+        from .metrics_registry import REGISTRY
+        _drop_counter = REGISTRY.counter(
+            "ff_trace_events_dropped_total",
+            "Trace events lost to ring-buffer wraparound")
+    _drop_counter.inc()
+
+
 def _record(ev: Dict[str, Any]) -> None:
     global _head, _dropped
     with _lock:
@@ -120,6 +146,7 @@ def _record(ev: Dict[str, Any]) -> None:
             _ring[_head] = ev
             _head = (_head + 1) % _capacity
             _dropped += 1
+            _count_drop()
 
 
 def record_span(name: str, t0: float, dur: float, **attrs) -> None:
@@ -176,6 +203,21 @@ def events() -> List[Dict[str, Any]]:
 def dropped() -> int:
     """Events lost to ring wraparound since the last clear()."""
     return _dropped
+
+
+def snapshot(max_events: Optional[int] = None) -> Dict[str, Any]:
+    """One consistent view of the recorder — events (newest
+    ``max_events`` when bounded), counters, and the drop count — for
+    the per-rank trace dumps and the flight recorder."""
+    with _lock:
+        evts = _ring[_head:] + _ring[:_head]
+        ctrs = dict(_counters)
+        drops = _dropped
+    if max_events is not None and max_events >= 0:
+        # NOT evts[-max_events:]: a 0 bound means "no spans", while
+        # [-0:] would return the ENTIRE ring
+        evts = evts[-max_events:] if max_events else []
+    return {"events": evts, "counters": ctrs, "dropped": drops}
 
 
 # FF_TRACE honored at import so serving entry points (which never see an

@@ -9,8 +9,18 @@ XPlane is a different container — but the shared wall-clock makes the
 phases line up).
 
 Format: the "JSON Array Format" of the Trace Event spec — one complete
-('X') event per span with microsecond timestamps, one instant ('i')
-event per point event, counters summarized in ``otherData``.
+('X') event per span, one instant ('i') event per point event,
+process/thread-name metadata ('M') events so multi-rank merges are
+readable in Perfetto, and each counter exported as a Chrome 'C' counter
+event (its cumulative value, sampled at the trace end) in addition to
+the ``otherData`` summary.
+
+Multi-rank: :func:`dump_rank_trace` writes one RAW ring dump per rank
+(``.ffcache/trace_rank<r>_epoch<e>.json``) with this rank's clock
+anchor from the coordinator's KV handshake
+(``resilience.coord.Coordinator.clock_sync``); ``tools/fftrace.py``
+merges the dumps into one aligned Chrome trace with world epochs as
+lanes.
 """
 from __future__ import annotations
 
@@ -21,19 +31,41 @@ from typing import Any, Dict, List, Optional, Sequence
 from . import events as _events
 
 
+def _meta(pid: int, name: str, value: str, tid: int = 0,
+          sort_index: Optional[int] = None) -> List[Dict[str, Any]]:
+    out = [{"name": name, "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": value}}]
+    if sort_index is not None:
+        out.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"sort_index": sort_index}})
+    return out
+
+
 def to_chrome_trace(evts: Optional[Sequence[Dict[str, Any]]] = None,
-                    counters: Optional[Dict[str, float]] = None
-                    ) -> Dict[str, Any]:
+                    counters: Optional[Dict[str, float]] = None,
+                    pid: Optional[int] = None,
+                    process_name: Optional[str] = None,
+                    sort_index: Optional[int] = None,
+                    base: Optional[float] = None) -> Dict[str, Any]:
     """Convert recorded events (default: the live ring) to a Chrome
-    trace-event document. Timestamps are rebased to the earliest event
-    so the viewer opens at t=0."""
+    trace-event document. Timestamps are rebased to ``base`` (default:
+    the earliest event, so the viewer opens at t=0). ``pid`` /
+    ``process_name`` / ``sort_index`` label the process lane — the
+    multi-rank merger passes the rank/epoch here."""
     if evts is None:
         evts = _events.events()
     if counters is None:
         counters = _events.counters()
-    base = min((e["ts"] for e in evts), default=0.0)
-    pid = os.getpid()
+    if base is None:
+        base = min((e["ts"] for e in evts), default=0.0)
+    if pid is None:
+        pid = os.getpid()
     out: List[Dict[str, Any]] = []
+    out.extend(_meta(pid, "process_name",
+                     process_name or f"flexflow pid {pid}",
+                     sort_index=sort_index))
+    named_tids = set()
+    end_us = 0.0
     for e in evts:
         rec: Dict[str, Any] = {
             "name": e["name"],
@@ -48,7 +80,18 @@ def to_chrome_trace(evts: Optional[Sequence[Dict[str, Any]]] = None,
             rec["s"] = "t"          # instant scoped to its thread
         if e.get("attrs"):
             rec["args"] = e["attrs"]
+        if e["tid"] not in named_tids:
+            named_tids.add(e["tid"])
+            out.extend(_meta(pid, "thread_name", f"host-{e['tid']}",
+                             tid=e["tid"]))
+        end_us = max(end_us, rec["ts"] + rec.get("dur", 0.0))
         out.append(rec)
+    # counters as Chrome 'C' events: one cumulative sample at the trace
+    # end per counter, so merged multi-rank traces show them as tracks
+    # in Perfetto instead of burying them in otherData
+    for cname in sorted(counters):
+        out.append({"name": cname, "ph": "C", "ts": round(end_us, 3),
+                    "pid": pid, "args": {"value": counters[cname]}})
     return {"traceEvents": out,
             "displayTimeUnit": "ms",
             "otherData": {"counters": dict(counters),
@@ -67,3 +110,69 @@ def export_chrome_trace(path: str,
         json.dump(doc, f)
     os.replace(tmp, path)
     return path
+
+
+# ----------------------------------------------------------------------
+# per-rank raw dumps (fftrace merge input)
+# ----------------------------------------------------------------------
+
+_DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".ffcache")
+
+RANK_DUMP_SCHEMA = 1
+
+
+def rank_trace_path(rank: int, epoch: int,
+                    cache_dir: Optional[str] = None) -> str:
+    return os.path.join(cache_dir or _DEFAULT_DIR,
+                        f"trace_rank{rank}_epoch{epoch}.json")
+
+
+def dump_rank_trace(path: Optional[str] = None,
+                    cache_dir: Optional[str] = None) -> Optional[str]:
+    """Dump this rank's raw ring (events + counters + drop count) with
+    its identity (rank, world epoch) and clock anchor, for the
+    ``tools/fftrace.py`` cross-rank merge. The anchor is the
+    ``(perf_counter, wall)`` pair sampled at the coordinator's
+    epoch-scoped KV barrier release (``Coordinator.clock_sync``) — the
+    same physical instant on every rank, which is what lets the merger
+    place each rank's monotonic span clocks on one timeline without
+    trusting cross-host wall clocks. Returns the path (None on
+    failure; dumping telemetry must never kill the training run)."""
+    try:
+        from ..resilience import status
+        world = status.snapshot()
+        rank = int(world.get("world_rank") or 0)
+        epoch = int(world.get("world_epoch") or 0)
+        snap = _events.snapshot()
+        doc: Dict[str, Any] = {
+            "schema": RANK_DUMP_SCHEMA,
+            "rank": rank,
+            "world_epoch": epoch,
+            "world_size": int(world.get("world_size") or 1),
+            "pid": os.getpid(),
+            "events": snap["events"],
+            "counters": snap["counters"],
+            "dropped": snap["dropped"],
+        }
+        try:
+            from ..resilience import coord
+            c = coord.get()
+            anchor = getattr(c, "clock_anchor", None) \
+                if c is not None else None
+            if anchor:
+                doc["clock"] = dict(anchor)
+        except Exception:  # noqa: BLE001 — anchor is best-effort
+            pass
+        if path is None:
+            path = rank_trace_path(rank, epoch, cache_dir)
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        _events.counter("trace.rank_dumps")
+        return path
+    except Exception:  # noqa: BLE001
+        return None

@@ -171,6 +171,12 @@ class Coordinator:
         self._thread: Optional[threading.Thread] = None
         # rank -> (last seen seq, monotonic time the seq last advanced)
         self._peer_seen: Dict[int, tuple] = {}
+        # cross-rank clock anchor from the last clock_sync() handshake:
+        # {"perf_s", "wall_s", "name"} — perf_counter/wall sampled at
+        # the barrier release, i.e. (near-)the same physical instant on
+        # every rank. tools/fftrace.py uses it to place each rank's
+        # monotonic span timestamps on one merged timeline.
+        self.clock_anchor: Optional[Dict] = None
         status.set_value("world_epoch", self.epoch)
         status.set_value("world_rank", self.rank)
         status.set_value("world_size", self.world)
@@ -325,6 +331,32 @@ class Coordinator:
                                    barrier=name)
 
 
+    # -- clock handshake ----------------------------------------------
+    def clock_sync(self, name: str = "clock") -> Dict:
+        """KV-store clock handshake for cross-rank trace alignment:
+        every rank meets at one epoch-scoped bounded barrier, then
+        samples ``(perf_counter, wall)`` at the release — the same
+        physical instant (within barrier-release skew) everywhere — and
+        publishes its wall sample to the KV store for diagnostics. The
+        anchor is kept on ``self.clock_anchor``; the per-rank trace
+        dump (obs/trace_export.dump_rank_trace) and the flight recorder
+        embed it so ``tools/fftrace.py`` can align the rank timelines
+        without trusting cross-host wall clocks. Single-process worlds
+        anchor immediately (the barrier is a no-op)."""
+        if self.world > 1:
+            self.barrier(f"clock:{name}")
+        t_perf = time.perf_counter()
+        t_wall = time.time()
+        self.clock_anchor = {"perf_s": t_perf, "wall_s": t_wall,
+                             "name": name}
+        try:
+            self.kv.set(f"ff/clock/e{self.epoch}/{self.rank}",
+                        repr(t_wall))
+        except Exception:  # noqa: BLE001 — the KV copy is diagnostics
+            pass
+        return self.clock_anchor
+
+
 def _record_failure(f: RankFailure) -> None:
     status.record("rank_failures")
     status.set_value("last_rank_failure",
@@ -334,6 +366,11 @@ def _record_failure(f: RankFailure) -> None:
     obs_events.counter("resilience.rank_failure")
     obs_events.instant("resilience.rank_failure", rank=f.rank,
                        epoch=f.epoch, reason=f.reason)
+    # black-box dump at the detection site: this survivor may be about
+    # to exit for world re-formation, and its ring/counters/world facts
+    # are the only record of what the world looked like at the failure
+    from ..obs import flight
+    flight.dump_flight_record("rank_failure", exc=f)
     log.error("coordinator: %s", f)
 
 
@@ -372,6 +409,10 @@ def ensure_started(config=None) -> Coordinator:
                     kw[name] = float(v)
         _coord = Coordinator(jax.process_index(), jax.process_count(),
                              **kw).start()
+        # an unhandled crash on a world member should leave a flight
+        # record for the WorldSupervisor's per-rank report
+        from ..obs import flight
+        flight.install_excepthook()
         return _coord
 
 

@@ -35,6 +35,11 @@ def _fresh() -> Dict[str, Any]:
         "world_size": 1,
         "rank_failures": 0,               # peer failures detected here
         "last_rank_failure": None,        # "rank=R epoch=E reason"
+        # newest flight-recorder dump of this process (obs/flight.py):
+        # the bounded black-box written at RankFailure / NaN-rollback /
+        # crash sites, referenced from /healthz so a probe can point an
+        # operator straight at the evidence
+        "last_flight_record": None,
     }
 
 

@@ -110,6 +110,8 @@ class Supervisor:
         ff = self.ff
         if ff.executor is None:
             raise ValueError("call compile() first")
+        from ..obs import flight
+        flight.install_excepthook()  # unhandled crash -> flight record
         epochs = epochs or ff.config.epochs
         self._run_args = (x, y, batch_size, shuffle)
         loader = ff._combined_loader(x, y, batch_size, shuffle=shuffle)
@@ -159,6 +161,8 @@ class Supervisor:
                 self._nan_steps.add(e.step)
                 self.nan_rollbacks += 1
                 status.record("nan_rollbacks")
+                from ..obs import flight
+                flight.dump_flight_record("nan_rollback", exc=e)
                 self._recover(loader, reason="nan_loss", err=e)
             except DeviceLoss as e:
                 loader = self._recover_device_loss(loader, e)
@@ -179,6 +183,7 @@ class Supervisor:
             from ..obs.trace_export import export_chrome_trace
             if obs_events.enabled():
                 export_chrome_trace(ff.config.trace_export_file)
+        ff._end_of_training_telemetry()   # attribution + rank dump
         return history
 
     # ------------------------------------------------------------------
@@ -538,6 +543,19 @@ class WorldSupervisor:
         return out
 
     @staticmethod
+    def _flight_records(epoch: int) -> List[str]:
+        """Flight-recorder dumps the workers of world-epoch ``epoch``
+        left behind (obs/flight.py — written at RankFailure/NaN/crash
+        sites): attached to the per-epoch report so a failed epoch's
+        post-mortem starts from the black boxes, not a stderr tail."""
+        import glob
+        from ..obs import flight
+        try:
+            return sorted(glob.glob(flight.flight_path("*", epoch)))
+        except Exception:  # noqa: BLE001
+            return []
+
+    @staticmethod
     def _suspects(records) -> List[int]:
         """Ranks believed dead/hung on their own: died hard without our
         SIGKILL, or — ONLY when no rank died hard — still running
@@ -571,9 +589,16 @@ class WorldSupervisor:
             log.info("world supervisor: launching epoch %d with %d "
                      "process(es)", self.epoch, self.nprocs)
             records = self._launch_epoch()
+            flights = self._flight_records(self.epoch)
+            for rec in records:
+                rec["flight_records"] = [
+                    p for p in flights
+                    if f"flight_rank{rec['rank']}_" in
+                    os.path.basename(p)]
             self.report.append({"epoch": self.epoch,
                                 "nprocs": self.nprocs,
-                                "rcs": [r["rc"] for r in records]})
+                                "rcs": [r["rc"] for r in records],
+                                "flight_records": flights})
             if all(r["rc"] == 0 for r in records):
                 status.set_value("world_epoch", self.epoch)
                 return records
@@ -584,6 +609,21 @@ class WorldSupervisor:
             obs_events.instant("resilience.world_restart",
                                epoch=self.epoch, nprocs=self.nprocs,
                                why=why)
+            # launcher-side flight record: a hard-crashed rank leaves
+            # nothing (os._exit), and the supervisor reaps survivors
+            # before their detection window — the launcher is the one
+            # process guaranteed to witness the failed epoch, so it
+            # records the black box (rank="launcher" can never collide
+            # with a worker rank's file)
+            from ..obs import flight
+            fpath = flight.dump_flight_record(
+                "world_restart", rank="launcher", epoch=self.epoch,
+                extra={"why": why,
+                       "rcs": {str(r["rank"]): r["rc"]
+                               for r in records}})
+            if fpath and self.report:
+                self.report[-1].setdefault("flight_records",
+                                           []).append(fpath)
             self.epoch += 1
             relaunch_ok = (self.policy in ("auto", "relaunch")
                            and self.world_restarts

@@ -77,11 +77,20 @@ class CalibrationTable:
     def __init__(self, cache_dir: Optional[str] = None):
         self._cache_dir = cache_dir or _DEFAULT_DIR
         self._data: Optional[Dict[str, float]] = None
+        self._stale: Optional[set] = None
         self.measured = 0          # live measurements this process
 
     @property
     def path(self) -> str:
         return os.path.join(self._cache_dir, "calibration_v2.json")
+
+    @property
+    def stale_path(self) -> str:
+        """Sidecar naming rows the drift detector voted out: a stale
+        key answers like a miss (so exactly IT is re-measured on the
+        next calibration load) while every healthy row keeps serving
+        warm — the surgical alternative to deleting the whole table."""
+        return os.path.join(self._cache_dir, "calibration_v2_stale.json")
 
     @staticmethod
     def key(backend: str, kind: str, dtype: str = "-",
@@ -98,15 +107,63 @@ class CalibrationTable:
                 self._data = {}
         return self._data
 
+    def _load_stale(self) -> set:
+        if self._stale is None:
+            try:
+                with open(self.stale_path) as f:
+                    self._stale = {str(k) for k in json.load(f)}
+            except Exception:  # noqa: BLE001 — no sidecar = none stale
+                self._stale = set()
+        return self._stale
+
+    def _write_stale(self) -> None:
+        try:
+            os.makedirs(self._cache_dir, exist_ok=True)
+            tmp = self.stale_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(sorted(self._load_stale()), f)
+            os.replace(tmp, self.stale_path)
+        except Exception:  # noqa: BLE001 — persistence is best-effort
+            pass
+
+    def mark_stale(self, keys) -> int:
+        """Mark full table keys (``backend|kind|dtype|sclass|axis``) as
+        stale: they stop answering (get/entries skip them) until a fresh
+        measurement re-files them via :meth:`put`. Returns how many of
+        the keys actually exist in the table (unknown keys are ignored —
+        a drift report from another machine's table must not poison
+        this one)."""
+        data = self._load()
+        stale = self._load_stale()
+        hit = 0
+        for k in keys:
+            if k in data:
+                stale.add(k)
+                hit += 1
+        if hit:
+            self._write_stale()
+        return hit
+
+    def stale_keys(self) -> List[str]:
+        return sorted(self._load_stale())
+
     def get(self, backend: str, kind: str, dtype: str = "-",
             sclass: int = 0, axis_size: int = 0) -> Optional[float]:
-        return self._load().get(self.key(backend, kind, dtype, sclass,
-                                         axis_size))
+        key = self.key(backend, kind, dtype, sclass, axis_size)
+        if key in self._load_stale():
+            return None
+        return self._load().get(key)
 
     def put(self, backend: str, kind: str, dtype: str, sclass: int,
             axis_size: int, value: float) -> None:
         data = self._load()
-        data[self.key(backend, kind, dtype, sclass, axis_size)] = value
+        key = self.key(backend, kind, dtype, sclass, axis_size)
+        data[key] = value
+        stale = self._load_stale()
+        if key in stale:
+            # a fresh measurement supersedes the drift verdict
+            stale.discard(key)
+            self._write_stale()
         try:
             os.makedirs(self._cache_dir, exist_ok=True)
             tmp = self.path + ".tmp"
@@ -142,9 +199,11 @@ class CalibrationTable:
         axis-size), sorted by shape class — interpolation input."""
         prefix = f"{backend}|{kind}|{dtype}|"
         suffix = f"|{axis_size}"
+        stale = self._load_stale()
         out = []
         for k, v in self._load().items():
-            if k.startswith(prefix) and k.endswith(suffix):
+            if k.startswith(prefix) and k.endswith(suffix) \
+                    and k not in stale:
                 out.append((int(k[len(prefix):-len(suffix)]), v))
         return sorted(out)
 
@@ -346,9 +405,10 @@ class MeshCalibration:
         hit = self._degs.get(coll)
         if hit is None:
             prefix = f"{self.backend}|coll_{coll}|{self.dtype}|"
+            stale = self.table._load_stale()
             out = set()
             for k in self.table._load():
-                if k.startswith(prefix):
+                if k.startswith(prefix) and k not in stale:
                     out.add(int(k.rsplit("|", 1)[1]))
             hit = sorted(out)
             self._degs[coll] = hit
@@ -402,6 +462,35 @@ class MeshCalibration:
         slope = (ys[i] - ys[i - 1]) / max(xs[i] - xs[i - 1], 1e-9)
         y = ys[i - 1] + slope * (x - xs[i - 1])
         return math.exp(y)
+
+    def row_key(self, coll: str, degree: int, nbytes: float,
+                tier: Optional[str] = None) -> Optional[str]:
+        """Full table key (``backend|kind|dtype|shape_class|axis_size``)
+        of the measured row anchoring a :meth:`collective_time` answer —
+        the nearest measured shape class at the answering degree. The
+        drift detector (obs/drift.py) attributes an out-of-band
+        predicted-vs-measured ratio to exactly this row and marks it
+        stale. None = the query would not answer from the table (the
+        prediction came from the analytic model instead)."""
+        if self.table is None or degree <= 1 or nbytes <= 0:
+            return None
+        kind = f"{coll}@{tier}" if tier else coll
+        pts = self._points(coll, degree, tier)
+        deg = degree
+        if not pts and tier is None:
+            degs = self._degrees_measured(coll)
+            if degs:
+                near = min(degs, key=lambda d: abs(math.log(d)
+                                                   - math.log(degree)))
+                if 0.5 <= near / degree <= 2.0:
+                    deg = near
+                    pts = self._points(coll, near)
+        if not pts:
+            return None
+        sc = min(pts, key=lambda p: abs(
+            math.log(max(p[0], 1)) - math.log(max(nbytes, 1.0))))[0]
+        return CalibrationTable.key(self.backend, f"coll_{kind}",
+                                    self.dtype, sc, deg)
 
     def collective_marginal(self, coll: str, degree: int,
                             nbytes: float) -> Optional[float]:

@@ -98,6 +98,13 @@ class OpCostModel:
         # adopted strategy's serialized tree shapes (bounded)
         self.algo_choices: Dict[Tuple, Dict[str, Any]] = {}
         self._tree_memo: Dict[Tuple, Any] = {}
+        # calibration-row provenance tap (obs/drift.py): when a list is
+        # installed here, every pricing call appends WHICH calibration
+        # row (or analytic term) produced its answer. Installed only by
+        # the audit breakdown path (GraphCostEvaluator.
+        # graph_cost_breakdown) — None keeps the search's hot loops at
+        # one attribute read per call.
+        self.provenance: Optional[List[Dict[str, Any]]] = None
         # on-device measurement (reference measure_operator_cost analog)
         self.measure_on_device = False
         self.measure_budget_s = 120.0   # total wall budget for microbenches
@@ -219,6 +226,16 @@ class OpCostModel:
             self._tree_memo[memo_key] = choice
             self._record_choice(site, collective, degree, path, choice,
                                 volume_bytes)
+        if self.provenance is not None:
+            # tier-path pricing provenance (best effort): the
+            # bottleneck (outermost) tier's row is the one a drift on
+            # this entry should re-measure
+            tier = path[-1][0].name
+            key = self.calib.row_key(collective, degree, volume_bytes,
+                                     tier=tier) \
+                if self.calib is not None else None
+            self._prov("sync" if site == "grad_sync" else "xfer",
+                       f"coll_{collective}@{tier}", key, tier)
         return float(choice.cost_s)
 
     def _record_choice(self, site, collective, degree, path, choice,
@@ -242,6 +259,18 @@ class OpCostModel:
             "tier_path": [[t.name, d] for t, d in path],
             "volume_bytes": float(volume_bytes),
             **choice.to_json()}
+
+    def _prov(self, term: str, table: Optional[str],
+              key: Optional[str] = None, tier: Optional[str] = None
+              ) -> None:
+        """Record one provenance row when the tap is installed (audit
+        breakdowns only): ``term`` is the audit-entry component the
+        answer lands in ("compute" | "xfer" | "sync"), ``table`` the
+        calibration table family, ``key`` the exact row."""
+        p = self.provenance
+        if p is not None:
+            p.append({"term": term, "table": table, "key": key,
+                      "tier": tier})
 
     # ------------------------------------------------------------------
     def attach_calibration(self, calib) -> None:
@@ -505,6 +534,8 @@ class OpCostModel:
         hit = self.cache.get(key)
         if hit is not None:
             obs_events.counter("costmodel.cache_hits")
+            if self.provenance is not None:
+                self._op_prov(key)
             return hit
         op = get_op_def(layer.op_type)
         in_shapes = [t.shape for t in layer.inputs]
@@ -557,7 +588,35 @@ class OpCostModel:
                          inputs_memory=in_bytes, outputs_memory=out_bytes,
                          weights_memory=w_bytes)
         self.cache[key] = cm
+        if self.provenance is not None:
+            self._op_prov(key)
         return cm
+
+    def _op_prov(self, key: Tuple) -> None:
+        """Compute-term provenance for one ``op_cost`` answer: the
+        on-device measured row when one exists, else the calibrated
+        host terms (membw/dispatch/parallel-eff — re-measuring those
+        three is what fixes a drifting compute prediction), else the
+        bare analytic roofline."""
+        from .calibration import CalibrationTable
+        if self.measure_on_device:
+            dkey = repr((self.spec.generation,) + key)
+            if self._disk_cache().get(dkey) is not None:
+                self._prov("compute", "opcost", dkey)
+                return
+        if self.calib is not None:
+            b = self.calib.backend
+            self._prov("compute", "host_membw",
+                       CalibrationTable.key(b, "host_membw"))
+            self._prov("compute", "host_dispatch",
+                       CalibrationTable.key(b, "host_dispatch"))
+            if self.calib.parallel_eff:
+                n = max(self.spec.num_devices, 1)
+                self._prov("compute", "parallel_eff",
+                           CalibrationTable.key(b, "parallel_eff", "-",
+                                                0, n))
+        else:
+            self._prov("compute", None)
 
     # ------------------------------------------------------------------
     def xfer_cost(self, volume_bytes: float, collective: str,
@@ -603,10 +662,17 @@ class OpCostModel:
             kind = "all_to_all" if collective == "permute" else collective
             t = self.calib.collective_time(kind, degree, volume_bytes)
             if t is not None:
+                if self.provenance is not None:
+                    self._prov("xfer", f"coll_{kind}",
+                               self.calib.row_key(kind, degree,
+                                                  volume_bytes))
                 return float(t)
             # even off-table, no collective is cheaper than one measured
             # host dispatch — the floor the host-blind model lacked
             floor = self.calib.dispatch_s or 0.0
+        if self.provenance is not None and degree > 1 \
+                and volume_bytes > 0:
+            self._prov("xfer", None)     # analytic ring model
         ici_bw = self.coll_bw or self.spec.ici_bandwidth
         ici_lat = self.coll_lat if self.coll_lat is not None \
             else self.spec.ici_latency_us * 1e-6
@@ -708,5 +774,17 @@ class OpCostModel:
             t = self.calib.collective_marginal("all_reduce", dp_degree,
                                                weight_bytes)
             if t is not None:
+                if self.provenance is not None:
+                    self._prov("sync", "coll_all_reduce",
+                               self.calib.row_key("all_reduce",
+                                                  dp_degree,
+                                                  weight_bytes))
                 return float(t)
-        return self.xfer_cost(weight_bytes, "all_reduce", dp_degree)
+        n0 = len(self.provenance) if self.provenance is not None else 0
+        t = self.xfer_cost(weight_bytes, "all_reduce", dp_degree)
+        if self.provenance is not None:
+            # the fallthrough priced through xfer_cost, but this IS the
+            # gradient sync — the drift entry diffs it under "sync"
+            for row in self.provenance[n0:]:
+                row["term"] = "sync"
+        return t

@@ -66,13 +66,25 @@ class StrategySimulator:
         """(GraphCost, per-op entries) — the strategy-audit breakdown;
         entry component sums equal the GraphCost components (before the
         infeasibility penalty, flagged per entry set by the caller)."""
-        return self._evaluate(assign, breakdown=True)
+        try:
+            return self._evaluate(assign, breakdown=True)
+        finally:
+            # the provenance tap (installed below for breakdowns only)
+            # must not survive onto the MCMC walk's hot loop
+            self.cost.provenance = None
 
     def _evaluate(self, assign: Dict[str, Tuple[int, ...]],
                   breakdown: bool) -> Tuple[GraphCost, List[Dict]]:
         compute = xfer = sync = 0.0
         mem = 0
         entries: List[Dict] = []
+        if breakdown:
+            # calibration-row provenance for obs/drift.py — same
+            # contract as GraphCostEvaluator.graph_cost_breakdown: each
+            # entry records which table rows priced it, so drift on an
+            # mcmc-searched plan marks the right rows stale instead of
+            # reporting calibrated predictions as "analytic"
+            self.cost.provenance = []
         out_degrees: Dict[int, Dict[int, int]] = {}  # tensor guid -> degrees
         for layer in self.layers:
             opts = self.options[layer.name]
@@ -112,7 +124,7 @@ class StrategySimulator:
                 l_sync = self.cost.weight_sync_cost(wbytes, dp_deg)
             sync += l_sync
             if breakdown:
-                entries.append({
+                e = {
                     "name": layer.name,
                     "op_type": getattr(layer.op_type, "name",
                                        str(layer.op_type)),
@@ -120,7 +132,13 @@ class StrategySimulator:
                     "xfer_s": l_xfer, "sync_s": l_sync,
                     "mem_bytes": l_mem,
                     "total_s": cm.forward_time + cm.backward_time
-                    + l_xfer + l_sync})
+                    + l_xfer + l_sync}
+                prov = self.cost.provenance
+                if prov:
+                    e["calib"] = list(prov)
+                if prov is not None:
+                    del prov[:]
+                entries.append(e)
         total = compute + xfer + sync
         # memory feasibility: ~4x weights (param + grad + 2 Adam moments)
         if mem * 4 > self.cost.spec.hbm_bytes:

@@ -175,7 +175,13 @@ class GraphCostEvaluator:
         aggregate is accumulated from the same per-node terms), which is
         what makes the strategy audit record diffable against the
         search's reported cost."""
-        return self._evaluate(graph, in_pins, out_pin, breakdown=True)
+        try:
+            return self._evaluate(graph, in_pins, out_pin,
+                                  breakdown=True)
+        finally:
+            # the tap must not survive onto the search's hot loop (the
+            # cost model is shared across evaluators)
+            self.cost.provenance = None
 
     def _evaluate(self, graph: Graph, in_pins: Optional[Dict[int, Layout]],
                   out_pin: Optional[Layout], breakdown: bool
@@ -185,17 +191,31 @@ class GraphCostEvaluator:
         mem = 0
         entries: List[Dict] = []
         n_dev = self.dmesh.num_devices
+        if breakdown:
+            # calibration-row provenance tap (obs/drift.py): the cost
+            # model appends which table row answered each pricing call;
+            # note() folds the rows accumulated since the previous
+            # entry into that entry's "calib" list. Breakdowns are
+            # uncached audit-only evaluations, so the tap never rides
+            # along on the search's hot loop.
+            self.cost.provenance = []
 
         def note(node, fwd=0.0, bwd=0.0, nx=0.0, ns=0.0, nmem=0):
             if breakdown:
-                entries.append({
+                e = {
                     "name": node.layer.name,
                     "op_type": getattr(node.op_type, "name",
                                        str(node.op_type)),
                     "fwd_s": fwd, "bwd_s": bwd, "xfer_s": nx,
                     "sync_s": ns, "mem_bytes": nmem,
                     "total_s": fwd + bwd + nx + ns
-                    + self.mem_lambda * nmem})
+                    + self.mem_lambda * nmem}
+                prov = self.cost.provenance
+                if prov:
+                    e["calib"] = list(prov)
+                if prov is not None:
+                    del prov[:]
+                entries.append(e)
 
         for n in graph.topo_order():
             t = n.op_type
@@ -297,10 +317,15 @@ class GraphCostEvaluator:
                     dict(out_pin))
                 xfer += nx
                 if breakdown:
-                    entries.append({
+                    e = {
                         "name": "__out_pin__", "op_type": "RESHARD",
                         "fwd_s": 0.0, "bwd_s": 0.0, "xfer_s": nx,
-                        "sync_s": 0.0, "mem_bytes": 0, "total_s": nx})
+                        "sync_s": 0.0, "mem_bytes": 0, "total_s": nx}
+                    prov = self.cost.provenance
+                    if prov:
+                        e["calib"] = list(prov)
+                        del prov[:]
+                    entries.append(e)
         total = compute + xfer + sync + self.mem_lambda * mem
         return GraphCost(total, compute, xfer, sync, mem), entries
 

@@ -192,7 +192,13 @@ def get_route(path: str, repo, schedulers, state: Optional[ServingState]
         body = {"status": "draining" if draining else "ok",
                 "ready": not draining,
                 "resilience": resilience_status.health_fields(),
-                "serving": serving}
+                "serving": serving,
+                # trace-recorder health: silent ring overflow was
+                # invisible before — a probe can now alert on a dropping
+                # recorder; the flight-record pointer rides in the
+                # resilience block (last_flight_record)
+                "trace": {"enabled": obs_events.enabled(),
+                          "events_dropped": obs_events.dropped()}}
         # READINESS flips to 503 while draining (stop routing here);
         # LIVENESS (/healthz) must stay 200 — the process is alive and
         # finishing work, and a k8s liveness kill would abort exactly

@@ -1,0 +1,485 @@
+"""Step-time attribution + drift detection + cross-rank trace tooling
+(ISSUE 12): measured audit side keyed 1:1 to the predicted entries,
+drift attribution to exact calibration rows (pinned fixture),
+stale-row re-measurement, flight recorder, fftrace merge, and the
+dropped-events counter surfaces."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs import events
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture
+def traced():
+    """Tracing on with a fresh buffer; restores the PRIOR enabled state
+    (the ci.sh FF_TRACE=1 pass shares the process)."""
+    was_enabled = events.enabled()
+    events.enable(capacity=events.DEFAULT_CAPACITY)
+    events.clear()
+    try:
+        yield events
+    finally:
+        if not was_enabled:
+            events.disable()
+        events.clear()
+
+
+def _searched_mlp(attribution="true"):
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.search_budget = 4
+    cfg.attribution = attribution
+    cfg.attribution_steps = 3
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 16, in_dim=32, hidden=(64,), num_classes=8)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+               [], output_tensor=out)
+    return ff
+
+
+# ----------------------------------------------------------------------
+# attribution soundness (acceptance criteria)
+# ----------------------------------------------------------------------
+
+def test_measured_side_keys_match_predicted_one_to_one(traced):
+    from flexflow_tpu.obs import attribution as obs_attrib
+    ff = _searched_mlp()
+    side = obs_attrib.run_attribution(ff)
+    assert side is not None and side["mode"] == "spans"
+    doc = json.load(open(ff._strategy_audit_path))
+    pred = [e["name"] for e in doc["adopted"]["per_op"]]
+    meas = [e["name"] for e in doc["measured"]["per_op"]]
+    # acceptance: measured side keyed 1:1 to the predicted entries
+    assert pred == meas and len(pred) > 0
+    assert all(e["measured"] for e in doc["measured"]["per_op"])
+    assert doc["measured"]["n_steps"] == 3
+    assert doc["measured"]["jit_step_wall_s"] > 0
+
+
+def test_measured_entries_sum_to_step_wall(traced):
+    """Acceptance: on the 8-virtual-device mesh, the measured entries
+    (plus the timed optimizer update and unattributed emission) sum to
+    within tolerance of the instrumented step's measured wall time —
+    the spans cover the step end to end by construction."""
+    from flexflow_tpu.obs import attribution as obs_attrib
+    ff = _searched_mlp()
+    side = obs_attrib.run_attribution(ff)
+    accounted = (sum(e["total_s"] for e in side["per_op"])
+                 + side["update_s"] + side["unattributed_s"])
+    wall = side["step_wall_s"]
+    assert wall > 0
+    # spans sum ≈ wall minus inter-span host overhead; 30% covers CPU
+    # scheduler noise on the 2-core runner without masking a real gap
+    assert 0.7 * wall <= accounted <= 1.1 * wall, \
+        f"accounted {accounted} vs wall {wall}"
+
+
+def test_attribution_runs_from_fit_hook(traced):
+    ff = _searched_mlp()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(48, 32)).astype(np.float32)
+    y = rng.integers(0, 8, size=(48, 1)).astype(np.int32)
+    ff.fit(x=x, y=y, epochs=1, verbose=False)
+    doc = json.load(open(ff._strategy_audit_path))
+    assert "measured" in doc, "fit-end hook must write the measured side"
+    assert "drift_report" in doc and os.path.exists(doc["drift_report"])
+    dr = json.load(open(doc["drift_report"]))
+    assert dr["workload_key"] == doc["workload_key"]
+
+
+def test_attribution_skips_searchless_compiles(traced):
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    from flexflow_tpu.obs import attribution as obs_attrib
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.only_data_parallel = True   # no search -> no audit record
+    cfg.attribution = "true"
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 16, in_dim=32, hidden=(64,), num_classes=8)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+               [], output_tensor=out)
+    assert obs_attrib.run_attribution(ff) is None
+
+
+def test_attribution_enabled_resolution(monkeypatch):
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.obs import attribution as obs_attrib
+    cfg = FFConfig()
+    monkeypatch.delenv("FF_ATTRIB", raising=False)
+    assert not obs_attrib.attribution_enabled(cfg)
+    monkeypatch.setenv("FF_ATTRIB", "1")
+    assert obs_attrib.attribution_enabled(cfg)
+    cfg.attribution = "false"
+    assert not obs_attrib.attribution_enabled(cfg)
+    monkeypatch.delenv("FF_ATTRIB", raising=False)
+    cfg.attribution = "true"
+    assert obs_attrib.attribution_enabled(cfg)
+    monkeypatch.setenv("FF_ATTRIB_STEPS", "7")
+    assert obs_attrib.attribution_steps(cfg) == 7
+
+
+def test_attribution_implies_tracing():
+    from flexflow_tpu import FFConfig
+    was = events.enabled()
+    events.disable()
+    try:
+        cfg = FFConfig()
+        cfg.attribution = "true"
+        events.configure(cfg)
+        assert events.enabled(), \
+            "FF_ATTRIB must imply tracing (the audit record needs it)"
+    finally:
+        if not was:
+            events.disable()
+
+
+# ----------------------------------------------------------------------
+# drift detection (acceptance: staled row attributed to its exact key)
+# ----------------------------------------------------------------------
+
+def test_drift_fixture_attributes_exact_calibration_key(tmp_path):
+    """Pinned fixture: a deliberately-staled calibration row (its
+    measured sync is 50x the prediction it produced) must be flagged
+    and attributed to its exact (backend, dtype, shape-class,
+    axis-size) table key — and ONLY it; the healthy entry stays."""
+    from flexflow_tpu.obs import drift
+    from flexflow_tpu.search.calibration import CalibrationTable
+    doc = json.load(open(os.path.join(FIXTURES,
+                                      "audit_drift_fixture.json")))
+    report = drift.detect_drift(doc, band=4.0, min_s=1e-4)
+    oob = report["out_of_band"]
+    assert len(oob) == 1
+    assert oob[0]["name"] == "dense_0"
+    assert oob[0]["component"] == "sync"
+    key = "cpu|coll_all_reduce|float32|1048576|8"
+    assert oob[0]["calibration_keys"] == [key]
+    assert oob[0]["tables"] == ["coll_all_reduce"]
+    assert report["stale_keys"] == [key]
+    # end-to-end: the attributed row gets marked stale in a live table
+    tab = CalibrationTable(str(tmp_path))
+    tab.put("cpu", "coll_all_reduce", "float32", 1 << 20, 8, 1e-5)
+    tab.put("cpu", "coll_all_reduce", "float32", 1 << 19, 8, 5e-6)
+    path = drift.detect_and_write(doc, cache_dir=str(tmp_path))
+    assert path and os.path.exists(path)
+    rep = json.load(open(path))
+    assert rep["stale_marked"] == 1
+    fresh = CalibrationTable(str(tmp_path))
+    assert fresh.get("cpu", "coll_all_reduce", "float32",
+                     1 << 20, 8) is None, "stale row must answer as miss"
+    assert fresh.get("cpu", "coll_all_reduce", "float32",
+                     1 << 19, 8) == 5e-6, "healthy row must stay warm"
+
+
+def test_stale_row_remeasured_then_cleared(tmp_path):
+    from flexflow_tpu.search.calibration import CalibrationTable
+    tab = CalibrationTable(str(tmp_path))
+    tab.put("cpu", "coll_all_gather", "float32", 1 << 20, 4, 1e-4)
+    key = CalibrationTable.key("cpu", "coll_all_gather", "float32",
+                               1 << 20, 4)
+    assert tab.mark_stale([key]) == 1
+    assert tab.stale_keys() == [key]
+    assert tab.entries("cpu", "coll_all_gather", "float32",
+                       axis_size=4) == []
+    calls = []
+
+    def bench():
+        calls.append(1)
+        return 2e-4
+
+    v = tab.get_or_measure("cpu", "coll_all_gather", "float32",
+                           1 << 20, 4, bench)
+    assert v == 2e-4 and calls, "stale row must re-measure, not answer"
+    assert tab.stale_keys() == [], "fresh measurement clears the mark"
+    assert tab.get("cpu", "coll_all_gather", "float32", 1 << 20, 4) \
+        == 2e-4
+    # unknown keys from a foreign report never mark anything
+    assert tab.mark_stale(["tpu|coll_all_reduce|float32|64|2"]) == 0
+
+
+def test_provenance_records_exact_calibration_rows(tmp_path):
+    """The evaluator-side tap: a calibrated sync/xfer prediction must
+    carry the full table key of the row that produced it."""
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.calibration import (CalibrationTable,
+                                                 MeshCalibration)
+    from flexflow_tpu.search.costmodel import OpCostModel
+    tab = CalibrationTable(str(tmp_path))
+    tab.put("cpu", "coll_all_reduce", "float32", 1 << 20, 8, 1e-4)
+    calib = MeshCalibration(backend="cpu", table=tab)
+    cm = OpCostModel(MachineSpec.detect())
+    cm.attach_calibration(calib)
+    key = "cpu|coll_all_reduce|float32|1048576|8"
+    cm.provenance = []
+    t = cm.weight_sync_cost(float(1 << 20), 8)
+    assert t > 0
+    rows = [r for r in cm.provenance if r["term"] == "sync"]
+    assert rows and rows[0]["key"] == key
+    cm.provenance = []
+    t = cm.xfer_cost(float(1 << 20), "all_reduce", 8)
+    assert t > 0
+    rows = [r for r in cm.provenance if r["term"] == "xfer"]
+    assert rows and rows[0]["key"] == key
+    # tap uninstalled -> zero bookkeeping
+    cm.provenance = None
+    cm.xfer_cost(float(1 << 20), "all_reduce", 8)
+
+
+def test_breakdown_entries_carry_provenance(traced):
+    ff = _searched_mlp(attribution="false")
+    doc = json.load(open(ff._strategy_audit_path))
+    entries = doc["adopted"]["per_op"]
+    assert any(e.get("calib") for e in entries), \
+        "audit breakdown must record pricing provenance"
+    for e in entries:
+        for row in e.get("calib") or []:
+            assert row["term"] in ("compute", "xfer", "sync")
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+# ----------------------------------------------------------------------
+
+def test_flight_record_bounded_dump(tmp_path, traced):
+    from flexflow_tpu.obs import flight
+    from flexflow_tpu.resilience import status
+    for k in range(300):
+        with events.span(f"s{k}"):
+            pass
+    events.counter("flight.test", 3)
+    path = flight.dump_flight_record("nan_rollback",
+                                     exc=ValueError("loss=nan"),
+                                     cache_dir=str(tmp_path),
+                                     max_events=64)
+    assert path and os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["reason"] == "nan_rollback"
+    assert len(doc["events"]) == 64, "flight record must stay bounded"
+    assert doc["events"][-1]["name"] == "s299", "newest spans survive"
+    assert doc["counters"]["flight.test"] == 3
+    assert "world" in doc and "world_epoch" in doc["world"]
+    assert "exception" in doc and "loss=nan" in doc["exception"]
+    assert status.snapshot()["last_flight_record"] == path
+
+
+def test_flight_record_works_without_tracing(tmp_path):
+    from flexflow_tpu.obs import flight
+    was = events.enabled()
+    events.disable()
+    try:
+        path = flight.dump_flight_record("rank_failure",
+                                         cache_dir=str(tmp_path))
+        doc = json.load(open(path))
+        assert doc["events"] == []          # no spans, but still a record
+        assert doc["reason"] == "rank_failure"
+    finally:
+        if was:
+            events.enable()
+
+
+# ----------------------------------------------------------------------
+# trace export + fftrace merge
+# ----------------------------------------------------------------------
+
+def test_chrome_trace_metadata_and_counter_events(traced):
+    from flexflow_tpu.obs.trace_export import to_chrome_trace
+    with events.span("phase", depth=1):
+        pass
+    events.counter("widgets", 5)
+    doc = to_chrome_trace(pid=7, process_name="rank 0 · epoch 0")
+    names = [(e["ph"], e["name"]) for e in doc["traceEvents"]]
+    assert ("M", "process_name") in names
+    assert ("M", "thread_name") in names
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert {"widgets"} <= {e["name"] for e in cs}
+    assert [e["args"]["value"] for e in cs
+            if e["name"] == "widgets"] == [5]
+    pn = [e for e in doc["traceEvents"]
+          if e["ph"] == "M" and e["name"] == "process_name"][0]
+    assert pn["args"]["name"] == "rank 0 · epoch 0" and pn["pid"] == 7
+
+
+def test_dump_rank_trace_and_fftrace_merge(tmp_path, traced):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import fftrace
+    from flexflow_tpu.obs.trace_export import dump_rank_trace
+    with events.span("train.step", step=0):
+        pass
+    p0 = dump_rank_trace(path=str(tmp_path / "trace_rank0_epoch1.json"))
+    assert p0 and os.path.exists(p0)
+    # a second synthetic rank with a clock anchor offset from rank 0's
+    d0 = json.load(open(p0))
+    base0 = d0["events"][0]["ts"]
+    d0["clock"] = {"perf_s": base0 - 0.5, "wall_s": 0.0}
+    d0["world_epoch"] = 1
+    json.dump(d0, open(p0, "w"))
+    d1 = dict(d0, rank=1, pid=99999,
+              clock={"perf_s": base0 + 99.5, "wall_s": 0.0},
+              events=[dict(e, ts=e["ts"] + 100.0)
+                      for e in d0["events"]],
+              counters={"train.steps": 2})
+    p1 = str(tmp_path / "trace_rank1_epoch1.json")
+    json.dump(d1, open(p1, "w"))
+    merged = fftrace.merge_rank_traces([p0, p1])
+    evs = merged["traceEvents"]
+    assert isinstance(evs, list) and evs, "valid Chrome trace"
+    pnames = {e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert pnames == {"rank 0 · epoch 1", "rank 1 · epoch 1"}
+    spans = [e for e in evs if e["ph"] == "X"]
+    pids = {e["pid"] for e in spans}
+    assert len(pids) == 2, "one lane per rank"
+    # clock alignment: both ranks' anchors sit 0.5s before their span,
+    # so the aligned timestamps coincide to the microsecond
+    by_pid = {}
+    for e in spans:
+        by_pid.setdefault(e["pid"], []).append(e["ts"])
+    t0, t1 = (v[0] for v in by_pid.values())
+    assert abs(t0 - t1) < 1.0, f"anchor alignment broken: {t0} vs {t1}"
+    assert all(e["ts"] >= 0 for e in spans)
+    assert any(e["ph"] == "C" and e["name"] == "train.steps"
+               for e in evs)
+
+
+def test_fftrace_merges_flight_records_and_launcher_rank(tmp_path):
+    """--include-flights: a launcher flight record (rank="launcher")
+    must merge without crashing, and a rank's full dump + its flight
+    record for the SAME (rank, epoch) must land on distinct lanes."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import fftrace
+    ev = [{"name": "s", "kind": "span", "ts": 1.0, "dur": 0.1,
+           "tid": 1, "attrs": None}]
+    json.dump({"schema": 1, "rank": 0, "world_epoch": 0, "pid": 1,
+               "events": ev, "counters": {}, "dropped": 0,
+               "clock": {"perf_s": 0.5, "wall_s": 0.0}},
+              open(tmp_path / "trace_rank0_epoch0.json", "w"))
+    json.dump({"schema": 1, "rank": 0, "world_epoch": 0, "pid": 1,
+               "reason": "rank_failure", "events": ev, "counters": {},
+               "dropped_events": 0,
+               "clock": {"perf_s": 0.5, "wall_s": 0.0}},
+              open(tmp_path / "flight_rank0_epoch0.json", "w"))
+    json.dump({"schema": 1, "rank": "launcher", "world_epoch": 0,
+               "pid": 2, "reason": "world_restart", "events": [],
+               "counters": {}, "dropped_events": 0},
+              open(tmp_path / "flight_ranklauncher_epoch0.json", "w"))
+    merged = fftrace.merge_rank_traces(
+        [str(tmp_path / "trace_rank0_epoch0.json"),
+         str(tmp_path / "flight_rank0_epoch0.json"),
+         str(tmp_path / "flight_ranklauncher_epoch0.json")])
+    lanes = merged["otherData"]["lanes"]
+    assert len(lanes) == 3
+    assert len({ln["pid"] for ln in lanes}) == 3, \
+        "full dump and flight record must not share a lane"
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert any("[flight: rank_failure]" in n for n in names)
+    assert any("launcher" in n for n in names)
+
+
+def test_snapshot_zero_bound_returns_no_events(traced):
+    with events.span("s"):
+        pass
+    snap = events.snapshot(max_events=0)
+    assert snap["events"] == []
+    assert events.snapshot(max_events=1)["events"]
+
+
+def test_drift_skips_unmeasured_sync():
+    """A sync the harness could not realize (no mesh-axis group for the
+    dp degree) must not read as drift against healthy rows."""
+    from flexflow_tpu.obs import drift
+    doc = {
+        "workload_key": "k",
+        "adopted": {"per_op": [{
+            "name": "dense_0", "fwd_s": 0.0, "bwd_s": 0.0,
+            "xfer_s": 0.0, "sync_s": 0.002,
+            "calib": [{"term": "sync", "table": "coll_all_reduce",
+                       "key": "cpu|coll_all_reduce|float32|64|4"}]}]},
+        "measured": {"mode": "spans", "per_op": [{
+            "name": "dense_0", "fwd_s": 0.0, "bwd_s": 0.0,
+            "xfer_s": 0.0, "sync_s": 0.0, "measured": True,
+            "sync_measured": False}]},
+    }
+    report = drift.detect_drift(doc, band=4.0, min_s=1e-4)
+    assert report["n_out_of_band"] == 0 and report["stale_keys"] == []
+
+
+def test_mcmc_breakdown_carries_provenance(traced):
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.search_algo = "mcmc"
+    cfg.search_budget = 10
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 16, in_dim=32, hidden=(64,), num_classes=8)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+               [], output_tensor=out)
+    doc = json.load(open(ff._strategy_audit_path))
+    assert any(e.get("calib") for e in doc["adopted"]["per_op"]), \
+        "mcmc audit breakdowns must record pricing provenance too"
+
+
+def test_fftrace_epochs_become_separate_lanes(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import fftrace
+    ev = [{"name": "s", "kind": "span", "ts": 1.0, "dur": 0.1,
+           "tid": 1, "attrs": None}]
+    for epoch in (0, 1):
+        json.dump({"schema": 1, "rank": 0, "world_epoch": epoch,
+                   "pid": 1, "events": ev, "counters": {},
+                   "dropped": 0,
+                   "clock": {"perf_s": 0.5, "wall_s": 0.0}},
+                  open(tmp_path / f"trace_rank0_epoch{epoch}.json",
+                       "w"))
+    merged = fftrace.merge_rank_traces(
+        [str(tmp_path / "trace_rank0_epoch0.json"),
+         str(tmp_path / "trace_rank0_epoch1.json")])
+    lanes = merged["otherData"]["lanes"]
+    assert [(ln["rank"], ln["epoch"]) for ln in lanes] == \
+        [(0, 0), (0, 1)]
+    assert lanes[0]["pid"] != lanes[1]["pid"], \
+        "world epochs must be separate lanes"
+
+
+# ----------------------------------------------------------------------
+# dropped-events surfacing (satellite: overflow was silent)
+# ----------------------------------------------------------------------
+
+def test_dropped_events_counter_and_healthz():
+    from flexflow_tpu.obs.metrics_registry import REGISTRY
+    was = events.enabled()
+    ctr = REGISTRY.counter("ff_trace_events_dropped_total")
+    before = ctr.value()
+    events.enable(capacity=8)
+    events.clear()
+    try:
+        for k in range(12):
+            with events.span(f"d{k}"):
+                pass
+        assert events.dropped() == 4
+        assert ctr.value() == before + 4, \
+            "ring overflow must surface in the Prometheus counter"
+        from flexflow_tpu.serving.http_server import get_route
+        code, body, _ = get_route("/healthz", None, {})
+        assert code == 200
+        assert body["trace"]["events_dropped"] == 4
+        assert "last_flight_record" in body["resilience"]
+    finally:
+        events.enable(capacity=events.DEFAULT_CAPACITY)
+        if not was:
+            events.disable()
+        events.clear()

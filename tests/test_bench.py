@@ -57,6 +57,15 @@ def _scripted(default_probe_results):
                 in env.get("XLA_FLAGS", "")
             return {"wrapped_step_s": 0.001, "raw_step_s": 0.001,
                     "overhead_pct": 0.1, "ok": True}, None
+        if stage == "attribution_overhead":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"attrib_on_step_s": 0.00101,
+                    "attrib_off_step_s": 0.001,
+                    "raw_step_s": 0.001, "overhead_on_pct": 1.0,
+                    "overhead_off_pct": 0.0, "harness_s": 1.5,
+                    "measured_entries": 7, "ok": True}, None
         if stage == "dispatch_overlap":
             assert env.get("JAX_PLATFORMS") == "cpu"
             # single-device leg: the parent must CLEAR any inherited
@@ -161,6 +170,11 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         # measured percentage reaches the driver JSON
         assert out["obs_overhead_pct"] == 0.1
         assert any(a[1] == "obs_overhead" for a, _ in calls)
+        # and the attribution-mode overhead leg (ISSUE 12)
+        assert out["attrib_overhead_on_pct"] == 1.0
+        assert out["attrib_overhead_off_pct"] == 0.0
+        assert out["attrib_harness_s"] == 1.5
+        assert any(a[1] == "attribution_overhead" for a, _ in calls)
         # and the async-dispatch overlap leg
         assert out["dispatch_overlap_ratio"] == 1.08
         assert any(a[1] == "dispatch_overlap" for a, _ in calls)

@@ -175,14 +175,20 @@ def test_chrome_trace_export_golden(tmp_path, traced):
     path = export_chrome_trace(str(tmp_path / "trace.json"))
     doc = json.load(open(path))
     pid = os.getpid()
-    te = doc["traceEvents"]
-    # rebased to the earliest event (phase_a at 10.0s -> ts 0)
+    # span/instant payload events, metadata ('M') stripped: rebased to
+    # the earliest event (phase_a at 10.0s -> ts 0)
+    te = [e for e in doc["traceEvents"] if e["ph"] in ("X", "i")]
     assert te[0]["name"] == "phase_a" and te[0]["ph"] == "X"
     assert te[0]["ts"] == 0.0 and te[0]["dur"] == 500000.0
     assert te[0]["pid"] == pid and te[0]["args"] == {"k": 1}
     assert te[1]["name"] == "phase_b" and te[1]["ts"] == 500000.0 \
         and te[1]["dur"] == 250000.0
     assert te[2]["ph"] == "i" and te[2]["s"] == "t"
+    # process/thread metadata + counters as Chrome 'C' counter events
+    metas = {e["name"] for e in doc["traceEvents"] if e["ph"] == "M"}
+    assert {"process_name", "thread_name"} <= metas
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert [(e["name"], e["args"]["value"]) for e in cs] == [("c", 4)]
     assert doc["otherData"]["counters"] == {"c": 4}
     assert doc["displayTimeUnit"] == "ms"
     # the same doc from the API matches the exported file

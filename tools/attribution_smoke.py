@@ -1,0 +1,82 @@
+"""CI attribution smoke (ci.sh fast tier, ISSUE 12).
+
+Search → a few train steps with ``FF_ATTRIB=1`` → the strategy audit
+record must carry a ``measured`` side keyed 1:1 to the predicted
+entries, and a drift report must exist for the same workload key —
+the prediction-vs-reality loop exercised end-to-end on every push.
+
+Runs on the 8-virtual-device CPU mesh like the rest of the fast tier.
+Exit 0 = the attribution pipeline works.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_"
+                                 "count=8").strip()
+os.environ["FF_ATTRIB"] = "1"
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.search_budget = 4          # searched plan -> audit record
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 16, in_dim=32, hidden=(64,), num_classes=8)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+               [], output_tensor=out)
+    audit_path = getattr(ff, "_strategy_audit_path", None)
+    if not audit_path or not os.path.exists(audit_path):
+        raise SystemExit("FF_ATTRIB=1 must imply tracing, and a "
+                         "searched compile must write an audit record")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(48, 32)).astype(np.float32)   # 3 steps @ 16
+    y = rng.integers(0, 8, size=(48, 1)).astype(np.int32)
+    ff.fit(x=x, y=y, epochs=1, verbose=False)
+
+    with open(audit_path) as f:
+        doc = json.load(f)
+    measured = doc.get("measured")
+    if not measured:
+        raise SystemExit("fit under FF_ATTRIB=1 left no measured side "
+                         "in the audit record")
+    pred = [e["name"] for e in doc["adopted"]["per_op"]]
+    meas = [e["name"] for e in measured["per_op"]]
+    if pred != meas:
+        raise SystemExit(f"measured side not keyed 1:1 to predicted: "
+                         f"{pred} vs {meas}")
+    n_measured = sum(1 for e in measured["per_op"] if e["measured"])
+    if measured["mode"] == "spans" and n_measured == 0:
+        raise SystemExit("spans mode measured nothing")
+    drift_path = doc.get("drift_report")
+    if not drift_path or not os.path.exists(drift_path):
+        raise SystemExit("attribution must leave a drift report")
+    with open(drift_path) as f:
+        drift = json.load(f)
+    if drift.get("workload_key") != doc.get("workload_key"):
+        raise SystemExit("drift report keyed to the wrong workload")
+    print(f"attribution smoke OK: mode={measured['mode']} "
+          f"{n_measured}/{len(meas)} entries measured, "
+          f"step_wall={measured['step_wall_s'] * 1e3:.2f} ms, "
+          f"jit_wall={(measured.get('jit_step_wall_s') or 0) * 1e3:.2f}"
+          f" ms, drift compared={drift['n_compared']} "
+          f"out_of_band={drift['n_out_of_band']} "
+          f"stale_marked={drift['stale_marked']}")
+
+
+if __name__ == "__main__":
+    main()

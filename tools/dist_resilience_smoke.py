@@ -75,6 +75,7 @@ def worker() -> None:
 
 
 def launch() -> None:
+    import glob
     import tempfile
 
     from flexflow_tpu.resilience import WorldSupervisor
@@ -89,11 +90,23 @@ def launch() -> None:
         "FF_HB_TIMEOUT_S": "3",
         "FF_BARRIER_TIMEOUT_S": "20",
         "FF_LOCAL_DEVICES": "1",
+        # span tracing ON in the workers: each surviving rank dumps its
+        # ring at the end of training (trace_rank<r>_epoch<e>.json) so
+        # the fftrace merge below has real multi-rank input, and the
+        # crash drill's flight record carries spans
+        "FF_TRACE": "1",
         "PYTHONPATH": os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))) + os.pathsep
         + os.environ.get("PYTHONPATH", ""),
     }
     env.pop("JAX_PLATFORMS", None)
+    # stale dumps from an earlier run must not satisfy this run's
+    # assertions
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".ffcache")
+    for pat in ("flight_rank*_epoch*.json", "trace_rank*_epoch*.json"):
+        for p in glob.glob(os.path.join(cache, pat)):
+            os.remove(p)
     ws = WorldSupervisor(
         [sys.executable, os.path.abspath(__file__), "--worker"],
         nprocs=2, max_world_restarts=1, policy=policy,
@@ -102,6 +115,36 @@ def launch() -> None:
     records = ws.run()
     assert ws.world_restarts + ws.shrinks >= 1, \
         "fault injected but the world never needed re-forming"
+    # the crash drill must leave a flight record (the survivor dumps
+    # its black box at the RankFailure detection site), and the
+    # WorldSupervisor report must reference it
+    import json
+    flights = glob.glob(os.path.join(cache, "flight_rank*_epoch*.json"))
+    assert flights, "rank-crash drill left no flight record"
+    fdoc = json.load(open(flights[0]))
+    assert fdoc["reason"] in ("rank_failure", "crash",
+                              "world_restart"), fdoc["reason"]
+    assert "world" in fdoc and "counters" in fdoc
+    assert any(r.get("flight_records") for r in ws.report), \
+        "WorldSupervisor report references no flight record"
+    # the final (successful) epoch's per-rank trace dumps must merge
+    # into one valid Chrome trace with one lane per rank
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fftrace
+    dumps = sorted(glob.glob(os.path.join(
+        cache, f"trace_rank*_epoch{ws.epoch}.json")))
+    assert len(dumps) == ws.nprocs, \
+        f"expected {ws.nprocs} rank dumps for epoch {ws.epoch}, " \
+        f"got {dumps}"
+    merged = fftrace.merge_rank_traces(dumps)
+    evs = merged["traceEvents"]
+    assert evs and all("ts" in e and "pid" in e for e in evs
+                       if e["ph"] != "M")
+    lanes = merged["otherData"]["lanes"]
+    assert len(lanes) == ws.nprocs and all(ln["aligned"]
+                                           for ln in lanes), lanes
+    assert len({ln["pid"] for ln in lanes}) == ws.nprocs
+    assert any(e["ph"] == "X" for e in evs), "merged trace has no spans"
     losses = []
     for rec in records:
         toks = [t for ln in rec["out"].splitlines()
@@ -116,7 +159,9 @@ def launch() -> None:
     print(f"dist resilience smoke OK: {len(ws.report)} world epoch(s) "
           f"{ws.report}, {ws.world_restarts} relaunch(es), "
           f"{ws.shrinks} shrink(s), final world {ws.nprocs} proc(s), "
-          f"loss {losses[0]:.6f}")
+          f"loss {losses[0]:.6f}; {len(flights)} flight record(s), "
+          f"{len(dumps)} rank dump(s) merged into "
+          f"{len(merged['traceEvents'])} trace event(s)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,188 @@
+"""fftrace: merge per-rank trace dumps into one Chrome trace.
+
+Each rank of a multi-process world dumps its span ring at the end of
+training (``flexflow_tpu.obs.trace_export.dump_rank_trace`` →
+``.ffcache/trace_rank<r>_epoch<e>.json``) with a clock anchor sampled
+at the coordinator's epoch-scoped KV barrier release
+(``resilience/coord.py::Coordinator.clock_sync``) — the same physical
+instant on every rank. This tool places all the dumps on ONE timeline:
+
+  - events from rank r are shifted so the anchor instant is t=0 —
+    monotonic per-rank clocks align without trusting cross-host wall
+    clocks (dumps without an anchor are rebased to their own earliest
+    event and flagged);
+  - every (rank, world-epoch) pair becomes its own process lane, named
+    ``rank R · epoch E`` and sorted epoch-major — a re-formed world's
+    epochs stack as separate lanes instead of interleaving;
+  - counters export as Chrome 'C' counter events, thread names as 'M'
+    metadata, so the merge is readable in Perfetto / chrome://tracing.
+
+Flight-recorder dumps (``flight_rank<r>_epoch<e>.json``) are accepted
+as inputs too — their bounded event tails merge the same way.
+
+Usage:
+    python tools/fftrace.py                      # merge .ffcache dumps
+    python tools/fftrace.py a.json b.json -o merged.json
+    python tools/fftrace.py --cache-dir /path/.ffcache
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".ffcache")
+
+
+def _load_dump(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception as e:  # noqa: BLE001 — skip unreadable inputs
+        print(f"fftrace: skipping {path}: {e}", file=sys.stderr)
+        return None
+    if not isinstance(doc.get("events"), list):
+        print(f"fftrace: skipping {path}: no events list",
+              file=sys.stderr)
+        return None
+    doc["_path"] = path
+    return doc
+
+
+def _anchor_perf(doc: Dict[str, Any]) -> Optional[float]:
+    clock = doc.get("clock") or {}
+    v = clock.get("perf_s")
+    try:
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _rank_num(d: Dict[str, Any]) -> int:
+    """Numeric sort key for a dump's rank. Worker ranks are ints;
+    launcher-side flight records carry ``rank="launcher"`` — sort those
+    after every worker instead of crashing the merge."""
+    r = d.get("rank", 0)
+    try:
+        return int(r)
+    except (TypeError, ValueError):
+        return 1 << 20
+
+
+def merge_rank_traces(paths: List[str]) -> Dict[str, Any]:
+    """Merge rank dump files into one Chrome trace-event document.
+    Per-dump event conversion delegates to
+    ``flexflow_tpu.obs.trace_export.to_chrome_trace`` — one exporter,
+    whether the trace is single-rank or merged."""
+    from flexflow_tpu.obs.trace_export import to_chrome_trace
+    dumps = [d for d in (_load_dump(p) for p in paths) if d is not None]
+    if not dumps:
+        raise ValueError("no readable rank dumps to merge")
+    # lane order: epoch-major, then rank (flight records after their
+    # rank's full dump) — each world incarnation reads as its own block
+    dumps.sort(key=lambda d: (int(d.get("world_epoch") or 0),
+                              _rank_num(d), bool(d.get("reason"))))
+    # one shared origin: the earliest anchor-relative (or raw) instant
+    # across all dumps, so no event lands at negative time
+    rel_starts = []
+    for d in dumps:
+        anchor = _anchor_perf(d)
+        tss = [e["ts"] for e in d["events"]]
+        if not tss:
+            continue
+        base = anchor if anchor is not None else min(tss)
+        rel_starts.append(min(t - base for t in tss))
+    origin = min(rel_starts, default=0.0)
+    events: List[Dict[str, Any]] = []
+    lanes = []
+    for i, d in enumerate(dumps):
+        rank = d.get("rank", 0)
+        epoch = int(d.get("world_epoch") or 0)
+        # pid is the lane identity: strictly per-dump (enumerate), so a
+        # rank's full dump and its flight record for the same epoch can
+        # never collapse into one mislabeled lane
+        pid = i + 1
+        anchor = _anchor_perf(d)
+        aligned = anchor is not None
+        base = anchor if aligned else min(
+            (e["ts"] for e in d["events"]), default=0.0)
+        name = f"rank {rank} · epoch {epoch}"
+        if not aligned:
+            name += " (unaligned)"
+        reason = d.get("reason")
+        if reason:                    # a flight record, not a full dump
+            name += f" [flight: {reason}]"
+        # sort: epoch block, then rank, flights after full dumps, the
+        # launcher (rank_num clamped) at its epoch's tail
+        sort_index = (epoch * 4096 + min(_rank_num(d), 1024)
+                      + (2048 if reason else 0))
+        sub = to_chrome_trace(d["events"], d.get("counters") or {},
+                              pid=pid, process_name=name,
+                              sort_index=sort_index,
+                              base=base + origin)
+        events.extend(sub["traceEvents"])
+        lanes.append({"pid": pid, "rank": rank, "epoch": epoch,
+                      "aligned": aligned,
+                      "n_events": len(d["events"]),
+                      "dropped": d.get("dropped",
+                                       d.get("dropped_events", 0)),
+                      "source": d["_path"]})
+    return {"traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"lanes": lanes}}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fftrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("inputs", nargs="*",
+                    help="rank dump files (default: every "
+                         "trace_rank*_epoch*.json in the cache dir)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="merged Chrome trace path "
+                         "(default: <cache>/trace_merged.json)")
+    ap.add_argument("--cache-dir", default=_DEFAULT_CACHE,
+                    help="where rank dumps live (default: repo "
+                         ".ffcache)")
+    ap.add_argument("--include-flights", action="store_true",
+                    help="also merge flight_rank*_epoch*.json records")
+    a = ap.parse_args(argv)
+    paths = list(a.inputs)
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(
+            a.cache_dir, "trace_rank*_epoch*.json")))
+        if a.include_flights:
+            paths += sorted(glob.glob(os.path.join(
+                a.cache_dir, "flight_rank*_epoch*.json")))
+    if not paths:
+        print("fftrace: no rank dumps found (run with FF_TRACE=1 in a "
+              "multi-process world, or FF_TRACE_DUMP=1 anywhere)",
+              file=sys.stderr)
+        return 2
+    doc = merge_rank_traces(paths)
+    out = a.output or os.path.join(a.cache_dir, "trace_merged.json")
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out)
+    lanes = doc["otherData"]["lanes"]
+    print(f"fftrace: merged {len(lanes)} lane(s), "
+          f"{len(doc['traceEvents'])} event(s) -> {out}")
+    for ln in lanes:
+        tag = "" if ln["aligned"] else " (unaligned)"
+        print(f"  rank {ln['rank']} epoch {ln['epoch']}: "
+              f"{ln['n_events']} events, {ln['dropped']} dropped{tag}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

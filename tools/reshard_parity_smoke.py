@@ -17,6 +17,9 @@ Exits non-zero on any mismatch.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if "xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""):

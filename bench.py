@@ -653,11 +653,32 @@ def stage_reshard(steps: int):
     per timing. Both sides run the SAME chain; the naive side is traced
     with the flag set (the planner consults it at trace time). Ratio is
     min-paired per round, median across rounds (the stage_virtual
-    one-sided-noise argument). Gates: the chosen plans' peak transient
-    bytes must never exceed the naive gather-everything baseline's
-    (hard); the time ratio >= 1.0 is reported but deferred — on the
-    2-core CPU sim both sides' collectives are memcpys and the ratio is
-    noise-dominated."""
+    one-sided-noise argument).
+
+    Honest-chain fix (ISSUE 13, closing the standing PR 6 gap): the
+    naive side used to ELIDE chained constraints on CPU-sim (XLA folded
+    consecutive reshards of an otherwise-unused intermediate), so the
+    two sides executed different work and the deferred >= 1.0 gate was
+    vacuous. Now (a) both sides pin every intermediate layout with an
+    ``optimization_barrier`` between chain steps, (b) the timed chain
+    starts from an on-mesh SHARDED placement — matching in-graph
+    reality, where the planner transitions values already distributed
+    across the mesh (a single-device start charged the searched side's
+    pinned shard_map an 8x broadcast the naive scatter never paid),
+    and (c) the timed chain covers the COMMUNICATION vocabulary
+    (axis-move all-to-alls, partial/full gathers) — the replicated→
+    sharded slice-only transition stays in the peak/parity checks but
+    not the timing, because its cost on this backend is a shard_map
+    local-copy artifact, not communication the planner chose. Gates:
+    the chosen plans' peak transient bytes must never exceed the naive
+    gather-everything baseline's AND the honest time ratio must clear
+    the 0.75 no-regression floor (both hard — the floor sits below the
+    0.87-1.07 band the same code measures across runs of this shared
+    2-core box, so it catches a plan change that genuinely doubles
+    work without flapping on scheduler noise); the >= 1.0 win flag is
+    reported — on the CPU sim both sides' collectives are memcpys and
+    the honest ratio centers on parity, so the win binds on real
+    fabrics where partial gathers move fewer bytes."""
     _apply_platform_env()
     import statistics
     import numpy as np
@@ -667,44 +688,72 @@ def stage_reshard(steps: int):
     from flexflow_tpu.parallel.machine import DeviceMesh, MachineSpec
     from flexflow_tpu.parallel.reshard import ReshardPlanner
 
+    from jax.sharding import NamedSharding
+
     dmesh = DeviceMesh(MachineSpec(num_devices=8))
     planner = ReshardPlanner(dmesh)
-    chain = [
+    full_chain = [
         (P(), P(("x0", "x1"), "x2")),
         (P(("x0", "x1"), "x2"), P("x2", ("x0", "x1"))),
         (P("x2", ("x0", "x1")), P(None, ("x0", "x1"))),
         (P(None, ("x0", "x1")), P("x0", None)),
         (P("x0", None), P()),
     ]
+    # the timed chain: the communication transitions only (see the
+    # honest-chain fix above), from an on-mesh sharded start
+    chain = full_chain[1:]
     shape = (2048, 512)
     x = jnp.asarray(np.random.default_rng(0)
                     .standard_normal(shape).astype(np.float32))
+    x = jax.device_put(x, NamedSharding(dmesh.mesh, chain[0][0]))
 
     peak_ok = True
-    for src, dst in chain:
+    for src, dst in full_chain:
         plan = planner.plan(src, dst, shape, 4)
         if plan.peak_bytes > plan.naive_peak_bytes + 1e-6:
             peak_ok = False
 
     def chain_body(a):
+        # the barrier pins every intermediate layout as a materialized
+        # value: without it XLA elides chained constraints on the naive
+        # side (the PR 6 bench gap) and the two sides time different
+        # programs. Applied to BOTH sides — apples to apples.
         for src, dst in chain:
             a = planner.apply(a, src, dst)
+            a = jax.lax.optimization_barrier(a)
+        return jnp.sum(a)
+
+    def full_chain_body(a):
+        for src, dst in full_chain:
+            a = planner.apply(a, src, dst)
+            a = jax.lax.optimization_barrier(a)
         return jnp.sum(a)
 
     searched_fn = jax.jit(lambda a: chain_body(a))
     naive_fn = jax.jit(lambda a: chain_body(a))
+    # parity across the FULL vocabulary (slice-only entry included),
+    # from a replicated start
+    x_full = jax.device_put(
+        jnp.asarray(np.random.default_rng(1)
+                    .standard_normal(shape).astype(np.float32)),
+        NamedSharding(dmesh.mesh, P()))
+    searched_full = jax.jit(lambda a: full_chain_body(a))
+    naive_full = jax.jit(lambda a: full_chain_body(a))
     # an inherited FF_NAIVE_RESHARD=1 would turn the searched trace
     # into a second naive trace and report a meaningless ~1.0 ratio
     inherited = os.environ.pop("FF_NAIVE_RESHARD", None)
     try:
         s0 = _sync_fetch(searched_fn(x))      # trace searched
+        sf0 = _sync_fetch(searched_full(x_full))
         os.environ["FF_NAIVE_RESHARD"] = "1"
         n0 = _sync_fetch(naive_fn(x))         # trace naive under the flag
+        nf0 = _sync_fetch(naive_full(x_full))
     finally:
         os.environ.pop("FF_NAIVE_RESHARD", None)
         if inherited is not None:
             os.environ["FF_NAIVE_RESHARD"] = inherited
     assert n0 == s0, (n0, s0)                 # parity before timing
+    assert nf0 == sf0, (nf0, sf0)             # full-vocabulary parity
 
     chunk = max(8, steps)
 
@@ -731,8 +780,165 @@ def stage_reshard(steps: int):
            "naive_chunk_s": round(min(n_s), 6),
            "searched_chunk_s": round(min(s_s), 6),
            "peak_ok": peak_ok, "chunk": chunk, "rounds": rounds,
-           "time_ok_deferred": ratio >= 1.0,
-           "ok": peak_ok})
+           "time_win": ratio >= 1.0,
+           "ok": peak_ok and ratio >= 0.75})
+
+
+def stage_comm_overlap(steps: int):
+    """Communication–computation overlap leg (ISSUE 13 acceptance):
+    paired overlapped-vs-serial step time on a collective-heavy
+    searched plan over the 8-virtual-device mesh.
+
+    One compile (search under FF_OVERLAP=1, so the overlap-aware
+    evaluator scores the plan and the audit record carries the
+    predicted hidden/exposed split plus the event-driven simulator's
+    authoritative estimate), then TWO executors over the SAME program
+    and strategy: the serial update path and the bucketed
+    barrier-chained overlap schedule (``runtime/overlap.py``). Gates:
+
+      - bit-exact parity: K steps from identical initial state must
+        produce identical loss histories (hard — the overlap path is
+        schedule shaping, never math);
+      - model-vs-sim agreement: the additive evaluator's predicted
+        exposed comm within 2x of the task simulator's event-driven
+        estimate (hard);
+      - paired median-of-ratios serial/overlapped step time: the
+        no-regression floor (>= 0.95) is hard — the overlap schedule
+        must cost nothing where it cannot win. On the CPU sim both
+        schedules execute sequentially per device thread, so the ratio
+        centers on 1.0 and the >= 1.05 step-time WIN target binds on
+        real-accelerator runs (XLA's latency-hiding scheduler is what
+        the dependency cuts feed); the predicted win is what the
+        model-vs-sim agreement gate covers here."""
+    _apply_platform_env()
+    import copy
+    import statistics
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.executor import Executor
+    from flexflow_tpu.models import build_mlp
+    from flexflow_tpu.obs.audit import load_strategy_audit
+    from flexflow_tpu.runtime.optimizers import AdamOptimizer
+
+    os.environ["FF_OVERLAP"] = "1"
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    cfg.search_budget = 8
+    cfg.search_floor_guard = "false"
+    cfg.trace = "true"          # the audit record carries the overlap block
+    cfg.overlap = "on"
+    cfg.overlap_bucket_mb = 1   # several buckets on this model
+    ff = FFModel(cfg)
+    # wide layers: gradient sync (all-reduce of ~5 MB of weights over
+    # 8 ranks) dominates the predicted comm — the collective-heavy case
+    out = build_mlp(ff, 64, in_dim=256, hidden=(768, 768, 512),
+                    num_classes=64)
+    ff.compile(AdamOptimizer(0.001), "sparse_categorical_crossentropy",
+               [], output_tensor=out)
+
+    agree = None
+    sim_err = None
+    audit_path = getattr(ff, "_strategy_audit_path", None)
+    if audit_path and os.path.exists(audit_path):
+        ov = load_strategy_audit(audit_path).get("overlap") or {}
+        sim = ov.get("tasksim") or {}
+        sim_err = ov.get("tasksim_error")
+        pred = ov.get("predicted_exposed_s")
+        sim_e = sim.get("exposed_comm_s")
+        if pred is not None and sim_e is not None:
+            agree = (pred + 1e-9) / (sim_e + 1e-9)
+    if agree is None:
+        # audit record absent or incomplete: derive the agreement
+        # directly from the retained adopted PCG (same definitions:
+        # additive exposed = sync exposure + xfer vs the event-driven
+        # estimate)
+        g = getattr(ff, "_adopted_pcg", None)
+        cm = getattr(ff, "_search_cost_model", None)
+        if g is not None and cm is not None:
+            from flexflow_tpu.search.tasksim import TaskGraphEvaluator
+            from flexflow_tpu.search.unity import GraphCostEvaluator
+            cm.overlap_mode = True
+            gc = GraphCostEvaluator(cm, ff.dmesh).graph_cost(g)
+            est = TaskGraphEvaluator(cm, ff.dmesh).overlap_estimate(g)
+            agree = (gc.sync + gc.xfer + 1e-9) \
+                / (est["exposed_comm_s"] + 1e-9)
+
+    ex_ov = ff.executor
+    if ex_ov._overlap_schedule is None:
+        raise RuntimeError("overlap schedule was not built")
+    cfg_ser = copy.copy(cfg)
+    cfg_ser.overlap = "off"
+    os.environ.pop("FF_OVERLAP", None)
+    ex_ser = Executor(ex_ov.program, cfg_ser, ff.dmesh, ff.strategy,
+                      ff.optimizer, ff.loss_type, ff.metrics,
+                      seed=cfg.seed)
+    if ex_ser._overlap_schedule is not None:
+        raise RuntimeError("serial executor built an overlap schedule")
+
+    rng = np.random.default_rng(0)
+    batch = {"input": rng.normal(size=(64, 256)).astype(np.float32),
+             "label": rng.integers(0, 64, size=(64, 1)).astype(np.int32)}
+
+    def fresh_carry():
+        return [jax.tree.map(jnp.array, ff.params),
+                jax.tree.map(jnp.array, ff.opt_state),
+                jax.tree.map(jnp.array, ff.state)]
+
+    def run_steps(step_fn, carry, k, t0=0):
+        losses = []
+        for i in range(k):
+            p, o, s, bm = step_fn(carry[0], carry[1], carry[2],
+                                  jnp.int32(t0 + i), batch)
+            carry[:] = [p, o, s]
+            losses.append(_sync_fetch(bm["loss"]))
+        return losses
+
+    step_ser = ex_ser.make_train_step()
+    step_ov = ex_ov.make_train_step()
+    # bit-exact parity from identical initial state (compile + warm)
+    l_ser = run_steps(step_ser, fresh_carry(), 4)
+    l_ov = run_steps(step_ov, fresh_carry(), 4)
+    parity = l_ser == l_ov
+
+    chunk = max(8, steps)
+    c_ser, c_ov = fresh_carry(), fresh_carry()
+    it = [4]
+
+    def time_chunk(step_fn, carry):
+        t0 = time.perf_counter()
+        run_steps(step_fn, carry, chunk, it[0])
+        it[0] += chunk
+        return time.perf_counter() - t0
+
+    rounds = 6
+    ratios, ser_s, ov_s = [], [], []
+    for _ in range(rounds):
+        s1 = time_chunk(step_ser, c_ser)
+        o1 = time_chunk(step_ov, c_ov)
+        s2 = time_chunk(step_ser, c_ser)
+        o2 = time_chunk(step_ov, c_ov)
+        ser_s += [s1, s2]
+        ov_s += [o1, o2]
+        ratios.append(min(s1, s2) / min(o1, o2))
+    ratio = statistics.median(ratios)
+    sched = ex_ov._overlap_schedule
+    agree_ok = agree is not None and 0.5 <= agree <= 2.0
+    if sim_err and agree is None:
+        print(f"comm_overlap: tasksim estimate failed upstream: "
+              f"{sim_err}", file=sys.stderr)
+    _emit({"overlapped_vs_serial": round(ratio, 4),
+           "serial_chunk_s": round(min(ser_s), 6),
+           "overlap_chunk_s": round(min(ov_s), 6),
+           "parity_ok": parity,
+           "n_buckets": len(sched.buckets),
+           "model_vs_sim_exposed": round(agree, 4) if agree is not None
+           else None,
+           "agree_ok": agree_ok,
+           "chunk": chunk, "rounds": rounds,
+           "time_win": ratio >= 1.05,
+           "ok": parity and agree_ok and ratio >= 0.95})
 
 
 def stage_recovery(steps: int):
@@ -1316,10 +1522,12 @@ def main():
             errors.append(f"serving_overload: {err}")
 
     # -- stage 5.44: searched resharding vs naive (virtual mesh) ------
-    # ISSUE 6 acceptance: planned layout transitions must never exceed
-    # the naive gather-everything path's peak transient memory (hard
-    # gate); the paired searched-vs-naive time ratio is reported with
-    # its >= 1.0 gate deferred (noise-dominated on the 2-core CPU sim)
+    # ISSUE 6 acceptance + ISSUE 13 honest-chain fix: planned layout
+    # transitions must never exceed the naive gather-everything path's
+    # peak transient memory, and — now that the naive side executes the
+    # SAME barrier-pinned constraint chain from an on-mesh start —
+    # the time ratio must clear the 0.75 no-regression floor (both
+    # hard; the floor sits below the box's measured noise band)
     if remaining() > 90:
         xf = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in xf:
@@ -1332,10 +1540,38 @@ def main():
             out["reshard_peak_ok"] = rs["peak_ok"]
             if not rs["ok"]:
                 errors.append(
-                    "reshard: a chosen plan's peak transient bytes "
-                    "exceed the naive baseline's")
+                    f"reshard: peak_ok={rs['peak_ok']} "
+                    f"time ratio {rs['searched_vs_naive']} "
+                    f"(hard gates on the honest constraint chain: "
+                    f"peak <= naive, ratio >= 0.75)")
         else:
             errors.append(f"reshard: {err}")
+
+    # -- stage 5.46: communication-computation overlap (virtual mesh) -
+    # ISSUE 13 acceptance: the bucketed overlap schedule must stay
+    # bit-exact with the serial path, the overlap-aware evaluator's
+    # predicted exposed comm must agree with the event-driven
+    # simulator's estimate within 2x (both hard), and the paired
+    # overlapped-vs-serial step-time ratio is reported
+    if remaining() > 120:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            xf = (xf + " --xla_force_host_platform_device_count=8").strip()
+        coenv = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": xf}
+        co, err = stage(["--stage", "comm_overlap", "--steps", "16"],
+                        300, coenv)
+        if co is not None:
+            out["comm_overlap_ratio"] = co["overlapped_vs_serial"]
+            out["comm_overlap_parity_ok"] = co["parity_ok"]
+            out["comm_overlap_model_vs_sim"] = co["model_vs_sim_exposed"]
+            if not co["ok"]:
+                errors.append(
+                    f"comm_overlap: parity={co['parity_ok']} "
+                    f"model-vs-sim exposed "
+                    f"{co['model_vs_sim_exposed']} (gate within 2x), "
+                    f"ratio {co['overlapped_vs_serial']}")
+        else:
+            errors.append(f"comm_overlap: {err}")
 
     # -- stage 5.445: per-parameter ZeRO memory ratio -----------------
     # ISSUE 10 acceptance: the searched optimizer-state sharding must
@@ -1489,6 +1725,8 @@ if __name__ == "__main__":
         stage_dispatch_overlap(a.steps)
     elif a.stage == "reshard":
         stage_reshard(a.steps)
+    elif a.stage == "comm_overlap":
+        stage_comm_overlap(a.steps)
     elif a.stage == "recovery":
         stage_recovery(a.steps)
     elif a.stage == "serving_overload":

@@ -51,6 +51,12 @@ case "${1:-fast}" in
     # multi-phase reduction tree (docs/topology.md); the heavyweight
     # >= 1.1x gate lives in the multichip dryrun tier
     python tools/placement_smoke.py
+    # overlap parity smoke: the bucketed barrier-chained grad-sync
+    # schedule (FF_OVERLAP=1, runtime/overlap.py) must produce a loss
+    # history BIT-IDENTICAL to the serial update path on the same
+    # searched multi-tier plan — overlap is schedule shaping, never
+    # math, enforced on every push
+    python tools/overlap_parity_smoke.py
     # per-parameter ZeRO parity smoke: a searched optimizer-state
     # sharding assignment must be BIT-IDENTICAL to replicated training
     # (sharding is placement, not math), and a checkpoint saved under

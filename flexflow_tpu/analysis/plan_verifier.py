@@ -34,6 +34,14 @@ a step:
      epilogue (``lax.cond`` on the stage index) — must not contain
      collective ops, and subset axes must not collide with the pipeline
      axes (the banks×pipeline double transition, PR 6's NaN bug).
+     Extends to OVERLAPPED schedules (``strategy.overlap``,
+     ``runtime/overlap.py``): the bucketed grad-sync launch order must
+     be a dense total order per device, buckets disjoint with no
+     subset-group (bank/place-group/pipeline) members, and the launch
+     order must agree with backward completion order — a bucket
+     scheduled ahead of a gradient backward has not produced yet is
+     the overlapped-schedule deadlock class, rejected statically
+     (fixture-pinned).
   5. **placement** — hierarchical-placement soundness (arXiv
      2110.10548, ``parallel/placement.py``): ``axis_tiers`` must map
      real mesh axes to known hardware tiers, every serialized
@@ -267,6 +275,12 @@ def verify_plan(strategy, layers: Sequence, *,
     reshard_peak = _check_seams(report, strategy, layers, by_name,
                                 axis_sizes, spec, graph_inputs)
     _check_collective_order(report, strategy, layers, by_name, axis_sizes)
+    _check_overlap(report, getattr(strategy, "overlap", None),
+                   grouped=_overlap_grouped(strategy, layers),
+                   pos={l.name: i for i, l in enumerate(layers)},
+                   op_types={name: l.op_type
+                             for name, l in by_name.items()},
+                   have_layers=bool(by_name))
     _check_memory(report, strategy, layers, axis_sizes, spec, optimizer,
                   hbm_bytes, reshard_peak)
     _check_placement(report,
@@ -847,6 +861,132 @@ def _check_collective_order(report, strategy, layers, by_name,
                     "pipeline-prologue")
 
 
+# -- check 4.5: overlapped grad-sync schedule --------------------------------
+
+def _overlap_grouped(strategy, layers) -> Dict[str, str]:
+    """Layer name -> subset-group kind for the overlap check: bank /
+    place-group members and pipeline-region layers — the layers whose
+    gradients are NOT per-layer addressable on every rank."""
+    grouped: Dict[str, str] = {}
+    for bk in getattr(strategy, "banks", None) or ():
+        for m in bk.members:
+            grouped[m] = "bank"
+    for pg in getattr(strategy, "place_groups", None) or ():
+        for m in pg.members:
+            grouped[m] = "place-group"
+    region = getattr(strategy, "pipeline", None)
+    if region is not None:
+        for l in list(layers)[region.start:region.end]:
+            grouped[l.name] = "pipeline-region"
+    return grouped
+
+
+def _check_overlap(report, overlap_rec, *, grouped: Dict[str, str],
+                   pos: Dict[str, int], op_types: Dict[str, Any],
+                   have_layers: bool) -> None:
+    """Collective-ordering soundness of an overlapped grad-sync schedule
+    (``strategy.overlap``, built by ``runtime/overlap.py`` or imported):
+
+      - the bucket launch order must be TOTAL per device — a dense,
+        duplicate-free ``order`` sequence. Every rank derives the same
+        chain from the same record, so a total order here is a total
+        order everywhere (the no-new-deadlock-class invariant: two
+        ranks can never launch bucket collectives in different orders);
+      - bucket members must be disjoint, exist in the program, and not
+        be collective (parallel) ops;
+      - members must not sit inside a pipeline region, bank, or place
+        group: their gradients live under group keys on device subsets,
+        so a bucket naming one would launch its sync collective from a
+        SUBSET of ranks while the chain token holds the rest — the
+        rank-divergent launch sequence the total order exists to
+        prevent;
+      - the launch order must agree with backward completion order:
+        every member of bucket k must come LATER in program order than
+        every member of bucket k+1 (backward produces deep layers'
+        grads first). A bucket scheduled before a grad that backward
+        has not produced yet would stall the whole chain on it — on an
+        async multi-runtime the overlapped-schedule deadlock class
+        (rejection pinned by ``tests/fixtures/badplan_overlap_order.
+        json``).
+    """
+    if not overlap_rec:
+        return
+    from ..ffconst import PARALLEL_OPS
+    buckets = list(overlap_rec.get("buckets") or ())
+    if not buckets:
+        return
+    orders = [int(b.get("order", -1)) for b in buckets]
+    if sorted(orders) != list(range(len(buckets))):
+        report.add(
+            "collective-order", "error", "overlap-schedule",
+            f"bucket launch order {orders} is not a dense total order "
+            f"over {len(buckets)} buckets — ranks could disagree on "
+            f"the grad-sync launch sequence (deadlock)",
+            "overlap-schedule")
+        return
+    seen: Dict[str, int] = {}
+    by_order = sorted(buckets, key=lambda b: int(b.get("order", 0)))
+    for b in by_order:
+        o = int(b.get("order", 0))
+        name = f"overlap-bucket[{o}]"
+        for m in b.get("members") or ():
+            if m in seen:
+                report.add(
+                    "collective-order", "error", name,
+                    f"member {m!r} appears in buckets {seen[m]} and "
+                    f"{o} — its grad sync would launch twice, in a "
+                    f"chain position other ranks may resolve "
+                    f"differently", "overlap-schedule")
+            seen[m] = o
+            op_type = op_types.get(m)
+            if have_layers and m not in op_types:
+                report.add("collective-order", "error", name,
+                           f"member {m!r} is not in the program",
+                           "overlap-schedule")
+                continue
+            if op_type is not None and op_type in PARALLEL_OPS:
+                report.add(
+                    "collective-order", "error", name,
+                    f"collective op {getattr(op_type, 'name', op_type)}"
+                    f" cannot be an overlap-bucket member (it has no "
+                    f"weight gradient to sync; chaining it reorders "
+                    f"the per-op collective sequence across ranks)",
+                    "overlap-schedule")
+            if m in grouped:
+                report.add(
+                    "collective-order", "error", name,
+                    f"member {m!r} is a {grouped[m]} member: its "
+                    f"gradients live under a group key on a device "
+                    f"subset, so only that subset would launch the "
+                    f"bucket's sync while the chain token holds the "
+                    f"other ranks (rank-divergent launch = deadlock)",
+                    "overlap-schedule")
+    if not pos:
+        return
+    for prev, nxt in zip(by_order, by_order[1:]):
+        prev_members = [m for m in (prev.get("members") or ()) if m in pos]
+        nxt_members = [m for m in (nxt.get("members") or ()) if m in pos]
+        if not prev_members or not nxt_members:
+            continue
+        lo = min(pos[m] for m in prev_members)
+        hi = max(pos[m] for m in nxt_members)
+        if lo <= hi:
+            bad_prev = min(prev_members, key=lambda m: pos[m])
+            bad_nxt = max(nxt_members, key=lambda m: pos[m])
+            report.add(
+                "collective-order", "error",
+                f"overlap-bucket[{int(prev.get('order', 0))}]",
+                f"launch order contradicts backward completion order: "
+                f"bucket {int(prev.get('order', 0))} member "
+                f"{bad_prev!r} (program position {pos[bad_prev]}) "
+                f"launches before bucket {int(nxt.get('order', 0))} "
+                f"member {bad_nxt!r} (position {pos[bad_nxt]}), but "
+                f"backward produces {bad_nxt!r}'s gradient FIRST — "
+                f"the chain would stall every later bucket on a grad "
+                f"not yet produced (the overlapped-schedule deadlock "
+                f"class)", "overlap-schedule")
+
+
 # -- check 5: hierarchical placement -----------------------------------------
 
 def _dcn_tier_constants(spec) -> Tuple[float, float]:
@@ -1083,6 +1223,16 @@ def verify_strategy_file(path: str, doc: Optional[Dict] = None
     _check_placement(report, doc.get("axis_tiers") or {},
                      doc.get("collective_trees") or (), axis_sizes,
                      spec)
+    # subset-group membership, shared by the zero check (unaddressable
+    # state) and the overlap check (divergent bucket launch) — ONE walk
+    # so a future group kind cannot go missing from one of them
+    grouped: Dict[str, str] = {}
+    for b in doc.get("banks") or ():
+        for m in b.get("members") or ():
+            grouped[m] = "bank"
+    for g in doc.get("place_groups") or ():
+        for m in g.get("members") or ():
+            grouped[m] = "place-group"
     # per-parameter ZeRO assignment (doc["zero"]): axis soundness,
     # divisibility (when the program's weight shapes are known), and
     # the weight-axis-overlap rejection
@@ -1094,17 +1244,27 @@ def verify_strategy_file(path: str, doc: Optional[Dict] = None
                    for w, s in (os_.get("weights") or {}).items()
                    if s is not None}
             for name, os_ in (doc.get("ops") or {}).items()}
-        unaddr = {}
-        for b in doc.get("banks") or ():
-            for m in b.get("members") or ():
-                unaddr[m] = "bank"
-        for g in doc.get("place_groups") or ():
-            for m in g.get("members") or ():
-                unaddr[m] = "place-group"
         _check_zero(report, ZeroAssignment.from_json(zdoc), w_specs,
                     weight_shapes, axis_sizes,
                     have_layers=bool(weight_shapes),
-                    unaddressable=unaddr)
+                    unaddressable=grouped)
+    # overlapped grad-sync schedule (doc["overlap"]): launch-order
+    # totality, member disjointness/subset-group exclusion, and — when
+    # the file carries the serialized program — backward-completion
+    # order consistency via the recorded layer order
+    ovdoc = doc.get("overlap")
+    if ovdoc:
+        prog_layers = (prog or {}).get("layers") or ()
+        pos = {ls["name"]: i for i, ls in enumerate(prog_layers)}
+        op_types = {}
+        from ..ffconst import OperatorType
+        for ls in prog_layers:
+            try:
+                op_types[ls["name"]] = OperatorType[ls["op_type"]]
+            except KeyError:
+                op_types[ls["name"]] = None
+        _check_overlap(report, ovdoc, grouped=grouped, pos=pos,
+                       op_types=op_types, have_layers=bool(op_types))
     report.duration_s = time.perf_counter() - t0
     return report
 

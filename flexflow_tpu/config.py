@@ -161,6 +161,26 @@ class FFConfig:
     # collective overhead is within this fraction of its replicated
     # update cost
     zero_overhead_frac: float = 0.05
+    # communication–computation overlap (runtime/overlap.py): lower
+    # gradient sync as size-bucketed groups whose optimizer updates
+    # launch as each bucket's backward slice completes (barrier-chained
+    # dependency cuts — bit-exact with the serial path by construction),
+    # prefetch ZeRO param gathers one bucket ahead, and pipeline
+    # tier-staged reshard legs. Also flips the cost model into
+    # overlap-aware scoring (exposed-vs-hidden sync). "auto" honors the
+    # FF_OVERLAP env var and resolves OFF when unset — the serial path
+    # stays the bit-exact default. See docs/performance.md.
+    overlap: str = "auto"         # "auto" | "on" | "off"
+    # gradient-bucket size for the overlap schedule (MiB, fractional
+    # allowed): consecutive reverse-order layers coalesce until this
+    # many gradient bytes accumulate; a single larger parameter gets
+    # its own bucket
+    overlap_bucket_mb: float = 4.0
+    # ZeRO all-gather prefetch depth under overlap: >= 1 chains each
+    # bucket's updated (re-gathered) params into the next bucket's
+    # launch token so the gather is scheduled one bucket ahead of use;
+    # 0 chains raw grads only (gathers may sink to the step end)
+    zero_prefetch: int = 1
     # rematerialization: "none" | "blocks" (jax.checkpoint around each
     # repeated block — HBM-for-FLOPs; executor._emit_remat)
     remat: str = "none"
@@ -393,6 +413,14 @@ class FFConfig:
                 cfg.zero_policy = "auto"
             elif a == "--zero-overhead-frac":
                 cfg.zero_overhead_frac = float(take())
+            elif a == "--overlap-schedule":
+                cfg.overlap = take().lower()
+            elif a == "--no-overlap-schedule":
+                cfg.overlap = "off"
+            elif a == "--overlap-bucket-mb":
+                cfg.overlap_bucket_mb = float(take())
+            elif a == "--zero-prefetch":
+                cfg.zero_prefetch = int(take())
             elif a == "--remat":
                 cfg.remat = "blocks"
             elif a in ("--gradient-accumulation-steps", "--accum"):

@@ -33,7 +33,7 @@ from .core.layer import Layer
 from .core.tensor import Tensor
 from .dtypes import to_jnp
 from .obs import events as obs_events
-from .ops import EmitCtx, get_op_def
+from .ops import EmitCtx, ensure_weight_specs, get_op_def
 from .parallel import reshard as reshard_mod
 from .parallel.machine import DeviceMesh
 from .parallel.strategy import ShardingStrategy
@@ -409,6 +409,17 @@ class Executor:
         # ZeRO-1 (runtime/zero.py): NamedSharding pytree for the updated
         # optimizer state, set by FFModel.compile when enabled
         self.opt_state_constraints = None
+        # communication–computation overlap (runtime/overlap.py): the
+        # bucketed grad-sync schedule, or None = the serial path
+        # (bit-exact default). Built statically here so the plan
+        # verifier (which runs before the first step is traced) sees
+        # the schedule on strategy.overlap.
+        from .runtime import overlap as overlap_mod
+        self._overlap_schedule = overlap_mod.build_overlap_schedule(
+            program, strategy, config)
+        if self._overlap_schedule is not None:
+            strategy.overlap = self._overlap_schedule.record()
+            obs_events.counter("overlap.schedules_built")
         # pipeline region (parallel/pipeline_lowering): pre/post layer
         # split + GPipe lowering of the repeated-block region
         self.pipe = getattr(strategy, "pipeline", None)
@@ -524,10 +535,7 @@ class Executor:
             if layer.name in region_names:
                 continue  # initialized stacked, above
             op = get_op_def(layer.op_type)
-            specs = layer.weights or op.weights(
-                layer.params, [t.shape for t in layer.inputs],
-                [t.dtype for t in layer.inputs])
-            layer.weights = specs
+            specs = ensure_weight_specs(layer)
             if specs and layer.name in bank_names:
                 arrs = {}
                 for wi, spec in enumerate(specs):
@@ -607,11 +615,7 @@ class Executor:
         S, v = pipe.n_stages, pipe.n_chunks
         out: Dict[str, Dict[str, Any]] = {}
         for lj, layer in enumerate(pipe.template):
-            op = get_op_def(layer.op_type)
-            specs = layer.weights or op.weights(
-                layer.params, [t.shape for t in layer.inputs],
-                [t.dtype for t in layer.inputs])
-            layer.weights = specs
+            specs = ensure_weight_specs(layer)
             if not specs:
                 continue
             role = pipe.tp_roles.get(layer.name) \
@@ -666,11 +670,7 @@ class Executor:
         slot_of = self._ragged_slot_of()
         out: Dict[str, Dict[str, Any]] = {}
         for lj, layer in enumerate(pipe.template):
-            op = get_op_def(layer.op_type)
-            specs = layer.weights or op.weights(
-                layer.params, [t.shape for t in layer.inputs],
-                [t.dtype for t in layer.inputs])
-            layer.weights = specs
+            specs = ensure_weight_specs(layer)
             if not specs:
                 continue
             lp = {}
@@ -1135,6 +1135,18 @@ class Executor:
             # only the loss, and an auxiliary metric overflowing float32
             # on its own must not trigger a supervisor rollback
             bm["all_finite"] = jnp.all(jnp.isfinite(bm["loss"]))
+            if self._overlap_schedule is not None:
+                # overlap path (runtime/overlap.py): per-bucket updates
+                # chained in backward-completion order — identity math
+                # (bit-exact with the serial branch below), but the
+                # barrier chain hands XLA dependency cuts so bucket k's
+                # grad sync + update (+ ZeRO gather) interleave with
+                # the backward of buckets k+1..
+                from .runtime import overlap as overlap_mod
+                new_params, new_opt_state = overlap_mod.overlapped_update(
+                    self.optimizer, params, grads, opt_state, step + 1,
+                    self._overlap_schedule, self.opt_state_constraints)
+                return new_params, new_opt_state, new_state, bm
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, step + 1)
             if self.opt_state_constraints is not None:

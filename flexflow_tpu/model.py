@@ -722,6 +722,25 @@ class FFModel:
             pl = ReshardPlanner(self.dmesh)
             self.strategy.resharder = pl
         pl.audit_path = getattr(self, "_strategy_audit_path", None)
+        # overlap (runtime/overlap.py): multi-leg tier-staged reshard
+        # plans execute with their fabric legs pipelined when on
+        from .runtime.overlap import overlap_enabled
+        pl.overlap_on = overlap_enabled(self.config)
+        if self.config.export_strategy_file \
+                and getattr(self.strategy, "overlap", None):
+            # the search exported before the executor built the bucket
+            # schedule (same ordering as banks/zero): rewrite the
+            # overlap section so --import round-trips the exact
+            # schedule this compile audited and verified
+            try:
+                import json as _json
+                with open(self.config.export_strategy_file) as f:
+                    doc = _json.load(f)
+                doc["overlap"] = dict(self.strategy.overlap)
+                with open(self.config.export_strategy_file, "w") as f:
+                    _json.dump(doc, f, indent=1)
+            except Exception:  # noqa: BLE001 — export is best-effort
+                pass
         # per-parameter ZeRO (search/zero_plan.py, arXiv 2004.13336):
         # score each parameter's update path (replicated all-reduce vs
         # reduce-scatter + sharded update + all-gather over the placed

@@ -510,6 +510,55 @@ def _measure_xplane(ff, steps: int, predicted: List[Dict[str, Any]]
 
 
 # ----------------------------------------------------------------------
+# measured exposed-comm entry (overlap prediction coverage)
+# ----------------------------------------------------------------------
+
+def _attach_measured_overlap(side: Dict[str, Any]) -> None:
+    """Attach the measured ``overlap`` block to the measured side so
+    :mod:`.drift` can diff the overlap-aware evaluator's predicted
+    exposed comm against reality (ISSUE 13: drift detection covers the
+    overlap prediction, not just per-op costs).
+
+    Estimator: ``exposed_comm_s = max(0, fused step wall − measured
+    compute − optimizer update)`` — the step time the compute terms
+    cannot account for, i.e. communication left on the critical path.
+    The spans mode's per-op compute carries its own dispatch overhead,
+    so this is a LOWER bound on exposed comm (it can clamp to 0 on the
+    CPU sim); the drift band absorbs the bias, and the per-op
+    ``sync_s`` entries record the SERIALIZED comm cost next to it.
+    Also bumps ``ff_comm_exposed_s_total{side="measured"}``."""
+    try:
+        wall = side.get("jit_step_wall_s")
+        if wall is None:
+            return
+        compute = float(side.get("compute_s", 0.0) or 0.0)
+        update = float(side.get("update_s", 0.0) or 0.0)
+        exposed = max(0.0, float(wall) - compute - update)
+        side["overlap"] = {
+            "exposed_comm_s": exposed,
+            "comm_serial_s": float(side.get("sync_s", 0.0) or 0.0)
+            + float(side.get("xfer_s", 0.0) or 0.0),
+            "estimator": "step_wall_minus_compute",
+        }
+        from .metrics_registry import REGISTRY
+        REGISTRY.counter(
+            "ff_comm_exposed_s_total",
+            "Communication seconds exposed on the step critical path"
+        ).inc(exposed, side="measured")
+        # hidden = serialized comm the step wall did not pay — like the
+        # predicted side, an ALL-communication quantity (the counter
+        # help says so); xfer and sync are not separable in the wall
+        hidden = max(0.0, side["overlap"]["comm_serial_s"] - exposed)
+        side["overlap"]["hidden_comm_s"] = hidden
+        REGISTRY.counter(
+            "ff_comm_overlap_hidden_s_total",
+            "Communication seconds hidden behind backward compute "
+            "(overlap-aware scoring)").inc(hidden, side="measured")
+    except Exception:  # noqa: BLE001 — the entry is best-effort
+        pass
+
+
+# ----------------------------------------------------------------------
 # entry point
 # ----------------------------------------------------------------------
 
@@ -557,6 +606,7 @@ def run_attribution(ff, steps: Optional[int] = None
         log.warning("attribution harness failed: %r", e)
         obs_events.counter("attribution.failures")
         return None
+    _attach_measured_overlap(side)
     side["duration_s"] = round(time.perf_counter() - t0, 6)
     side["written_unix_s"] = time.time()
     obs_audit.annotate_strategy_audit(path, {"measured": side})

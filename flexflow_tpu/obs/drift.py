@@ -117,6 +117,31 @@ def detect_drift(doc: Dict[str, Any], band: Optional[float] = None,
                 "tables": tables,
                 "calibration_keys": keys,
             })
+    # overlap prediction coverage (ISSUE 13): diff the overlap-aware
+    # evaluator's predicted EXPOSED comm against the attribution
+    # harness's measured exposed-comm entry. Measured is a lower-bound
+    # estimator (see attribution._attach_measured_overlap), so only a
+    # measured value ABOVE the band flags — a clamped-to-zero measured
+    # side must not stale-mark a healthy prediction.
+    pred_ov = doc.get("overlap") or {}
+    meas_ov = (doc.get("measured") or {}).get("overlap") or {}
+    if pred_ov.get("enabled") and "exposed_comm_s" in meas_ov:
+        p = float(pred_ov.get("predicted_exposed_s", 0.0) or 0.0)
+        m = float(meas_ov.get("exposed_comm_s", 0.0) or 0.0)
+        if p >= min_s or m >= min_s:
+            n_compared += 1
+            ratio = m / max(p, 1e-12)
+            if ratio > band:
+                out.append({
+                    "name": "__overlap__",
+                    "op_type": "OVERLAP",
+                    "component": "exposed-comm",
+                    "predicted_s": p,
+                    "measured_s": m,
+                    "ratio": ratio,
+                    "tables": ["overlap"],
+                    "calibration_keys": [],
+                })
     stale = sorted({k for e in out for k in e["calibration_keys"]})
     return {
         "schema": SCHEMA_VERSION,

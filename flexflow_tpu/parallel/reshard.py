@@ -366,6 +366,15 @@ class ReshardPlanner:
         self._persist = persist
         self.audit_path: Optional[str] = None
         self._audit_records: List[Dict[str, Any]] = []
+        # communication–computation overlap (runtime/overlap.py): when
+        # resolved on, multi-leg TIER-STAGED plans execute PIPELINED —
+        # the tensor splits into chunks on an untouched dim so leg k+1
+        # of chunk j runs while leg k of chunk j+1 still occupies the
+        # other fabric, instead of the legs running back-to-back.
+        # None = resolve from FF_OVERLAP lazily (FFModel.compile sets
+        # it from FFConfig.overlap); bit-exact either way — chunking a
+        # collective on an untouched dim is pure data movement.
+        self.overlap_on: Optional[bool] = None
         self.mesh_key = "x".join(
             f"{a}{s}" for a, s in dmesh.axis_sizes.items())
         # multi-tier meshes key their plans per tier layout: a plan
@@ -652,7 +661,7 @@ class ReshardPlanner:
         sizes = self.dmesh.axis_sizes
         steps = list(plan.steps)
 
-        def body(xl):
+        def run_steps(xl):
             for st in steps:
                 ax = st.axes if len(st.axes) > 1 else st.axes[0]
                 if st.kind == "gather":
@@ -673,12 +682,78 @@ class ReshardPlanner:
                         xl, idx * blk, blk, st.dim)
             return xl
 
+        pipe = self._pipeline_chunks(plan, tuple(getattr(x, "shape", ())),
+                                     nbytes)
+        if pipe is None:
+            body = run_steps
+        else:
+            chunk_dim, n_chunks = pipe
+
+            def body(xl):  # noqa: F811 — pipelined variant
+                # tier-staged legs pipelined across fabric legs
+                # (runtime/overlap.py): chunks are data-independent,
+                # so leg k+1 of chunk j overlaps leg k of chunk j+1 on
+                # the other fabric. Splitting on an untouched dim
+                # commutes with every step — bit-exact with run_steps.
+                import jax.numpy as jnp
+                parts = jnp.split(xl, n_chunks, axis=chunk_dim)
+                return jnp.concatenate([run_steps(p) for p in parts],
+                                       axis=chunk_dim)
+
+            from ..obs.metrics_registry import REGISTRY
+            REGISTRY.counter(
+                "ff_reshard_pipelined_total",
+                "Tier-staged reshard plans executed with pipelined "
+                "fabric legs").inc()
+            obs_events.counter("reshard.pipelined_legs")
+
         out = shard_map(body, mesh=mesh, in_specs=src_P, out_specs=dst_P,
                         check_vma=False)(x)
         STATS.record("searched", nbytes, record={
             "src": layout_key(plan.src), "dst": layout_key(plan.dst),
             "steps": plan.describe()})
         return out
+
+    def _pipeline_chunks(self, plan: ReshardPlan, shape,
+                         nbytes: float) -> Optional[Tuple[int, int]]:
+        """(chunk_dim, n_chunks) for pipelined tier-staged execution,
+        or None for the serial (default) leg order. Pipelining applies
+        only when overlap is on, the plan has >= 2 collective legs on
+        >= 2 distinct hardware tiers (the PR 9 tier-staged lowering),
+        the payload clears 1 MiB (below that the extra per-leg launch
+        latency outweighs the overlap), and some tensor dim is touched
+        by NO step and divides into chunks at the shard-local entry
+        shape."""
+        on = self.overlap_on
+        if on is None:
+            from ..runtime.overlap import overlap_enabled
+            on = overlap_enabled(None)
+        if not on or len(plan.steps) < 2 or nbytes < (1 << 20):
+            return None
+        tiers = self.axis_tiers
+        if not tiers:
+            return None
+        leg_tiers = {tiers.get(a) for st in plan.steps
+                     if st.kind != "slice" for a in st.axes}
+        if len(leg_tiers) < 2:
+            return None
+        touched = set()
+        for st in plan.steps:
+            touched.add(st.dim)
+            if st.kind == "alltoall":
+                touched.add(st.src_dim)
+        for d in range(len(shape)):
+            if d in touched:
+                continue
+            deg = 1
+            if d < len(plan.src):
+                for a in plan.src[d]:
+                    deg *= self.dmesh.axis_sizes.get(a, 1)
+            local = shape[d] // max(deg, 1)
+            for n in (4, 2):
+                if local % n == 0 and local >= n:
+                    return d, n
+        return None
 
     def apply(self, x, src_spec, dst_spec):
         """Plan (or load) and execute one transition; the module's

@@ -1,0 +1,317 @@
+"""Communication–computation overlap: the schedulable half.
+
+The search prices overlap (``search/costmodel.py`` ``overlap_mode`` +
+``GraphCostEvaluator``'s hidden/exposed sync split); this module makes
+it *executable*: gradient sync is lowered as size-bucketed groups whose
+optimizer updates launch as each bucket's backward slice completes,
+instead of one monolithic update after the full backward pass.
+
+Mechanism — schedule shaping, never math:
+
+  - weighted layers are grouped into **size-bucketed** groups in
+    reverse program order (= backward completion order): consecutive
+    layers join a bucket until ``FFConfig.overlap_bucket_mb`` of
+    gradient bytes accumulate; a single giant parameter gets a bucket
+    of its own, many tiny parameters coalesce into one (fewer, larger
+    launch points — the classic DDP bucketing trade);
+  - inside the jitted step, each bucket's grads pass through one
+    ``jax.lax.optimization_barrier`` **chained to the previous
+    bucket's update** (the launch token). The barrier is identity —
+    bit-exact by construction — but the token chain pins a TOTAL
+    per-device launch order (the invariant the plan verifier's
+    overlapped-ordering check enforces) and hands XLA's latency-hiding
+    scheduler dependency cuts it can interleave: bucket k's gradient
+    all-reduce + update run while buckets k+1.. are still in backward;
+  - **ZeRO prefetch** (``FFConfig.zero_prefetch``): with a sharded
+    optimizer state (PR 10's per-parameter assignment), each bucket's
+    update implies a param all-gather. Depth >= 1 chains the UPDATED
+    params into the next bucket's launch token, so the gather is
+    scheduled one bucket ahead of downstream use; depth 0 chains only
+    the raw grads (gathers free to sink to the step end).
+
+The serial path — today's single ``optimizer.update`` after the full
+backward — is the bit-exact-preserved default: ``FFConfig.overlap`` is
+``"auto"``, which defers to the ``FF_OVERLAP`` env var and resolves OFF
+when unset. ``tools/overlap_parity_smoke.py`` pins FF_OVERLAP=1 vs
+serial to identical loss histories on every push.
+
+Ineligible configurations fall back to the serial path silently (the
+schedule builder returns None): pipelined regions (their params stack
+under template keys the per-layer bucketing cannot address) and
+optimizers with non-splittable state trees. Bank / place-group members
+are excluded per-layer (their weights live under group keys and update
+in the unchained tail); the plan verifier REJECTS a hand-built or
+imported schedule that names them.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["GradBucket", "OverlapSchedule", "overlap_enabled",
+           "build_overlap_schedule", "overlapped_update"]
+
+#: default gradient-bucket size (MiB) when FFConfig carries no knob
+DEFAULT_BUCKET_MB = 4
+
+
+def overlap_enabled(cfg=None) -> bool:
+    """Resolve the overlap opt-in: ``FFConfig.overlap`` "on"/"off" wins;
+    "auto" (and no config at all) honors the ``FF_OVERLAP`` env var and
+    defaults OFF — the serial path stays the bit-exact default."""
+    mode = str(getattr(cfg, "overlap", "auto") or "auto").lower()
+    if mode in ("on", "true", "1", "yes"):
+        return True
+    if mode in ("off", "false", "0", "no"):
+        return False
+    return os.environ.get("FF_OVERLAP", "").lower() \
+        in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class GradBucket:
+    """One grad-sync launch group. ``order`` is the launch position
+    (0 = first, the deepest layers — backward produces their grads
+    first); ``members`` are executable layer names whose weights update
+    together; ``nbytes`` the bucket's total gradient payload."""
+    order: int
+    members: List[str]
+    nbytes: int
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"order": self.order, "members": list(self.members),
+                "nbytes": int(self.nbytes)}
+
+
+@dataclasses.dataclass
+class OverlapSchedule:
+    """The executable bucket schedule + its audit/verifier record."""
+    buckets: List[GradBucket]
+    bucket_bytes: int
+    zero_prefetch: int
+
+    def record(self) -> Dict[str, Any]:
+        """JSON form carried as ``strategy.overlap`` — what the plan
+        verifier's overlapped-ordering check and the strategy audit
+        record consume."""
+        return {"enabled": True,
+                "bucket_bytes": int(self.bucket_bytes),
+                "zero_prefetch": int(self.zero_prefetch),
+                "buckets": [b.to_json() for b in self.buckets]}
+
+
+def _weight_bytes(layer) -> int:
+    import numpy as np
+    from ..dtypes import itemsize
+    total = 0
+    for w in layer.weights or ():
+        total += int(np.prod(w.shape)) * itemsize(w.dtype)
+    return total
+
+
+def build_overlap_schedule(program, strategy, config
+                           ) -> Optional[OverlapSchedule]:
+    """Build the bucketed grad-sync schedule for one compiled program,
+    or None when overlap is off / the configuration is ineligible
+    (pipelined region). Members are layers with weights addressable
+    under their own name in the params tree — bank / place-group
+    members (weights stacked under group keys) are excluded and update
+    in the unchained tail."""
+    if not overlap_enabled(config):
+        return None
+    if getattr(strategy, "pipeline", None) is not None:
+        # stage-stacked params are not per-layer addressable; the GPipe
+        # scan owns its own schedule — serial fallback
+        from ..obs import events as obs_events
+        obs_events.counter("overlap.pipeline_fallbacks")
+        return None
+    rec = getattr(strategy, "overlap", None)
+    if rec and rec.get("buckets"):
+        # schedule imported with the strategy (or built by a previous
+        # executor over the same strategy object): honor it VERBATIM —
+        # the plan verifier checks it against THIS program at compile,
+        # same contract as an imported zero assignment
+        buckets = [GradBucket(int(b.get("order", i)),
+                              list(b.get("members") or ()),
+                              int(b.get("nbytes", 0)))
+                   for i, b in enumerate(rec["buckets"])]
+        buckets.sort(key=lambda b: b.order)
+        return OverlapSchedule(
+            buckets,
+            int(rec.get("bucket_bytes", DEFAULT_BUCKET_MB << 20)),
+            max(0, int(rec.get("zero_prefetch", 1))))
+    grouped: set = set()
+    for bk in getattr(strategy, "banks", None) or ():
+        grouped.update(bk.members)
+    for pg in getattr(strategy, "place_groups", None) or ():
+        grouped.update(pg.members)
+    try:
+        cap_mb = float(getattr(config, "overlap_bucket_mb",
+                               DEFAULT_BUCKET_MB))
+    except (TypeError, ValueError):
+        cap_mb = float(DEFAULT_BUCKET_MB)
+    if cap_mb <= 0:
+        cap_mb = float(DEFAULT_BUCKET_MB)
+    cap = max(1, int(cap_mb * (1 << 20)))
+    prefetch = max(0, int(getattr(config, "zero_prefetch", 1)))
+
+    from ..ops import ensure_weight_specs
+    weighted: List[Tuple[str, int]] = []
+    for layer in program.layers:
+        if layer.name in grouped:
+            continue
+        if not ensure_weight_specs(layer):
+            continue
+        weighted.append((layer.name, _weight_bytes(layer)))
+    if not weighted:
+        return None
+
+    buckets: List[GradBucket] = []
+    members: List[str] = []
+    acc = 0
+    # reverse program order = backward completion order: the deepest
+    # layer's grads materialize first and launch first
+    for name, nb in reversed(weighted):
+        if members and acc + nb > cap:
+            buckets.append(GradBucket(len(buckets), members, acc))
+            members, acc = [], 0
+        members.append(name)
+        acc += nb
+    if members:
+        buckets.append(GradBucket(len(buckets), members, acc))
+    return OverlapSchedule(buckets, cap, prefetch)
+
+
+# ---------------------------------------------------------------------------
+# the barrier-chained bucketed update
+# ---------------------------------------------------------------------------
+
+def _subtree(tree: Dict[str, Any], names: Sequence[str]) -> Dict[str, Any]:
+    return {k: tree[k] for k in names if k in tree}
+
+
+def _state_subtree(opt_state: Dict[str, Any], names: Sequence[str]
+                   ) -> Dict[str, Any]:
+    keep = set(names)
+    return {slot: {k: v for k, v in layers.items() if k in keep}
+            for slot, layers in opt_state.items()}
+
+
+def _splittable_state(opt_state) -> bool:
+    """The bucketed update needs a {slot: {layer: {w: leaf}}} state tree
+    it can partition by layer; anything else (custom optimizers) takes
+    the serial path."""
+    if not isinstance(opt_state, dict):
+        return False
+    return all(isinstance(layers, dict) for layers in opt_state.values())
+
+
+def _pin_state(new_state, constraints, names) -> Any:
+    """Per-bucket ZeRO pin: keep each updated moment on its assigned
+    sharded placement (the lookup mirrors the executor's full-tree
+    ``tree.map`` pin — same constraint objects, applied per leaf)."""
+    import jax
+    if constraints is None:
+        return new_state
+    out = {}
+    for slot, layers in new_state.items():
+        c_layers = constraints.get(slot, {}) \
+            if isinstance(constraints, dict) else {}
+        new_layers = {}
+        for lname, ws in layers.items():
+            c_ws = c_layers.get(lname, {}) \
+                if isinstance(c_layers, dict) else {}
+            if isinstance(ws, dict):
+                new_layers[lname] = {
+                    w: (jax.lax.with_sharding_constraint(leaf, c_ws[w])
+                        if isinstance(c_ws, dict) and w in c_ws else leaf)
+                    for w, leaf in ws.items()}
+            else:
+                new_layers[lname] = ws
+        out[slot] = new_layers
+    return out
+
+
+def overlapped_update(optimizer, params, grads, opt_state, step,
+                      schedule: OverlapSchedule, constraints=None):
+    """The overlap path's replacement for the single
+    ``optimizer.update`` call: per-bucket updates in launch order,
+    chained by ``optimization_barrier`` tokens. Identity math — every
+    leaf sees exactly the serial path's update — so the result is
+    bit-exact with the serial step (pinned by
+    ``tools/overlap_parity_smoke.py`` and ``tests/test_overlap.py``).
+
+    ``constraints`` is the executor's ``opt_state_constraints`` pytree
+    (ZeRO): applied per-bucket so each bucket's reduce-scatter/update/
+    all-gather cluster is independently schedulable.
+    """
+    import jax
+
+    if not _splittable_state(opt_state):
+        new_params, new_state = optimizer.update(params, grads,
+                                                 opt_state, step)
+        if constraints is not None:
+            new_state = jax.tree.map(jax.lax.with_sharding_constraint,
+                                     new_state, constraints)
+        return new_params, new_state
+
+    claimed: set = set()
+    new_params: Dict[str, Any] = {}
+    new_state: Dict[str, Any] = {slot: {} for slot in opt_state}
+    tokens: List[Any] = []
+    for bucket in schedule.buckets:
+        names = [n for n in bucket.members if n in params]
+        if not names:
+            continue
+        claimed.update(names)
+        sub_g = _subtree(grads, names)
+        leaves, treedef = jax.tree.flatten(sub_g)
+        if leaves:
+            # the tokens ride as extra barrier operands: their outputs
+            # are discarded, but the barrier op stays live through the
+            # grad outputs, so every token must materialize before this
+            # bucket's grads clear — the per-device total launch order
+            barred = jax.lax.optimization_barrier(
+                tuple(leaves) + tuple(tokens))
+            leaves = list(barred[:len(leaves)])
+            sub_g = jax.tree.unflatten(treedef, leaves)
+        sub_p = _subtree(params, names)
+        sub_s = _state_subtree(opt_state, names)
+        np_, ns_ = optimizer.update(sub_p, sub_g, sub_s, step)
+        ns_ = _pin_state(ns_, constraints, names)
+        new_params.update(np_)
+        for slot, layers in ns_.items():
+            new_state.setdefault(slot, {}).update(layers)
+        # launch tokens for the next bucket: depth >= 1 chains EVERY
+        # updated param of this bucket (under ZeRO, each re-gathered
+        # full param — the prefetch: every gather is scheduled one
+        # bucket ahead of use, not just one representative leaf);
+        # depth 0 chains one barred grad only, leaving gathers free to
+        # sink to the step end
+        if schedule.zero_prefetch >= 1:
+            new_toks = [x for x in jax.tree.leaves(np_)
+                        if hasattr(x, "size")]
+            if new_toks:
+                tokens = new_toks
+        elif leaves:
+            tokens = [leaves[0]]
+
+    # unchained tail: params the schedule does not claim (bank /
+    # place-group / pipeline-template group keys, importless extras) —
+    # one standard update, exactly the serial semantics
+    tail = [k for k in params if k not in claimed]
+    if tail:
+        np_, ns_ = optimizer.update(
+            _subtree(params, tail), _subtree(grads, tail),
+            _state_subtree(opt_state, tail), step)
+        ns_ = _pin_state(ns_, constraints, tail)
+        new_params.update(np_)
+        for slot, layers in ns_.items():
+            new_state.setdefault(slot, {}).update(layers)
+    # non-dict slots (unsplittable leaves an exotic optimizer might
+    # carry) were filtered by _splittable_state above; preserve slot
+    # set exactly
+    for slot in opt_state:
+        new_state.setdefault(slot, {})
+    return new_params, new_state

@@ -105,6 +105,17 @@ class OpCostModel:
         # graph_cost_breakdown) — None keeps the search's hot loops at
         # one attribute read per call.
         self.provenance: Optional[List[Dict[str, Any]]] = None
+        # overlap-aware scoring (runtime/overlap.py's model half): when
+        # set, GraphCostEvaluator prices each gradient-sync site at its
+        # EXPOSED cost — max(0, comm − hideable backward compute) under
+        # a single-comm-channel queue model — instead of the serial
+        # full cost, and records the hidden/exposed split per site.
+        # Off (the default) keeps every prediction bit-identical to the
+        # serial model. Set by search/optimizer.py from FFConfig.overlap
+        # / FF_OVERLAP; the event-driven simulator (tasksim.py
+        # overlap_estimate) is the authority this additive split is
+        # checked against (bench comm_overlap leg, within 2x).
+        self.overlap_mode = False
         # on-device measurement (reference measure_operator_cost analog)
         self.measure_on_device = False
         self.measure_budget_s = 120.0   # total wall budget for microbenches

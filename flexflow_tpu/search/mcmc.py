@@ -34,6 +34,11 @@ class GraphCost:
     xfer: float
     sync: float
     peak_memory: int
+    # overlap-aware scoring only (OpCostModel.overlap_mode): gradient-
+    # sync seconds predicted HIDDEN behind backward compute; `sync`
+    # then carries the exposed remainder and `total` counts exposed
+    # only. 0.0 under the serial model (bit-identical legacy scores).
+    sync_hidden: float = 0.0
 
 
 class StrategySimulator:
@@ -78,6 +83,12 @@ class StrategySimulator:
         compute = xfer = sync = 0.0
         mem = 0
         entries: List[Dict] = []
+        # overlap-aware sync pricing — same contract as unity's
+        # GraphCostEvaluator: sites collected in program order, the
+        # hidden/exposed split resolved by the shared _overlap_split
+        # queue model after the walk. Serial mode is bit-identical.
+        overlap_on = bool(getattr(self.cost, "overlap_mode", False))
+        sync_sites: List[Dict] = []
         if breakdown:
             # calibration-row provenance for obs/drift.py — same
             # contract as GraphCostEvaluator.graph_cost_breakdown: each
@@ -139,11 +150,20 @@ class StrategySimulator:
                 if prov is not None:
                     del prov[:]
                 entries.append(e)
+            if overlap_on:
+                sync_sites.append({
+                    "bwd": cm.backward_time, "sync": l_sync,
+                    "entry": entries[-1] if breakdown else None})
+        sync_hidden = 0.0
+        if overlap_on and sync > 0:
+            from .unity import _overlap_split
+            sync, sync_hidden = _overlap_split(sync_sites)
         total = compute + xfer + sync
         # memory feasibility: ~4x weights (param + grad + 2 Adam moments)
         if mem * 4 > self.cost.spec.hbm_bytes:
             total *= 100.0  # infeasible penalty (memory-aware search refines)
-        return GraphCost(total, compute, xfer, sync, mem), entries
+        return GraphCost(total, compute, xfer, sync, mem,
+                         sync_hidden=sync_hidden), entries
 
 
 def data_parallel_assignment(layers: Sequence[Layer], dmesh: DeviceMesh,

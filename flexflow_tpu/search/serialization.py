@@ -56,6 +56,12 @@ def save_strategy(path: str, strategy: ShardingStrategy,
         doc["collective_trees"] = list(strategy.collective_trees)
     if getattr(strategy, "zero", None) is not None:
         doc["zero"] = strategy.zero.to_json()
+    if getattr(strategy, "overlap", None):
+        # the bucketed grad-sync schedule (runtime/overlap.py): round-
+        # trips so --import pins the audited schedule verbatim and
+        # ffcheck --verify-strategies runs the overlapped-ordering
+        # check on the exported artifact
+        doc["overlap"] = dict(strategy.overlap)
     banks_doc = banks_to_json(strategy)
     if banks_doc:
         doc["banks"] = banks_doc
@@ -471,6 +477,8 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
     if doc.get("zero"):
         from ..runtime.zero import ZeroAssignment
         st.zero = ZeroAssignment.from_json(doc["zero"])
+    if doc.get("overlap"):
+        st.overlap = dict(doc["overlap"])
     if doc.get("banks"):
         from ..parallel.banks import BankSpec
         st.banks = [BankSpec(list(b["members"]), tuple(b["axes"]),

@@ -191,6 +191,13 @@ class GraphCostEvaluator:
         mem = 0
         entries: List[Dict] = []
         n_dev = self.dmesh.num_devices
+        # overlap-aware sync pricing (OpCostModel.overlap_mode): collect
+        # every compute node's (backward compute, grad-sync cost) in
+        # topo order; the hidden/exposed split is resolved after the
+        # walk by _overlap_split. Serial mode (default) keeps the exact
+        # historical accumulation.
+        overlap_on = bool(getattr(self.cost, "overlap_mode", False))
+        sync_sites: List[Dict] = []
         if breakdown:
             # calibration-row provenance tap (obs/drift.py): the cost
             # model appends which table row answered each pricing call;
@@ -307,6 +314,10 @@ class GraphCostEvaluator:
             sync += n_sync
             note(n, fwd=cm.forward_time, bwd=cm.backward_time,
                  nx=n_xfer, ns=n_sync, nmem=n_mem)
+            if overlap_on:
+                sync_sites.append({
+                    "bwd": cm.backward_time, "sync": n_sync,
+                    "entry": entries[-1] if breakdown else None})
         # output pin: resharding from final layout to the pinned layout
         if out_pin is not None and graph.outputs:
             n0, i0 = graph.outputs[0]
@@ -326,8 +337,57 @@ class GraphCostEvaluator:
                         e["calib"] = list(prov)
                         del prov[:]
                     entries.append(e)
+        sync_hidden = 0.0
+        if overlap_on and sync > 0:
+            sync, sync_hidden = _overlap_split(sync_sites)
         total = compute + xfer + sync + self.mem_lambda * mem
-        return GraphCost(total, compute, xfer, sync, mem), entries
+        return GraphCost(total, compute, xfer, sync, mem,
+                         sync_hidden=sync_hidden), entries
+
+
+def _overlap_split(sync_sites: Sequence[Dict]) -> Tuple[float, float]:
+    """Resolve per-site hidden vs exposed gradient-sync cost under the
+    overlap schedule's execution model (``runtime/overlap.py``): the
+    backward pass runs nodes in REVERSE topo order, each weighted
+    node's sync launches when its backward slice completes, and syncs
+    drain FIFO through one comm channel concurrent with the remaining
+    backward compute. A sync's exposed cost is the part of its channel
+    occupancy that extends past the end of backward — per-site
+    ``max(0, comm − hideable backward compute)``, with the channel
+    queue keeping two syncs from hiding behind the same compute.
+
+    Mutates each site's breakdown entry (when present): ``sync_s``
+    becomes the exposed cost, ``sync_hidden_s``/``sync_full_s`` record
+    the split — so audit entries still sum exactly to the GraphCost
+    components. Returns (exposed_total, hidden_total).
+
+    The event-driven task simulator (``tasksim.TaskGraphEvaluator.
+    overlap_estimate``) is the authoritative overlap model this
+    closed-form split is checked against (bench ``comm_overlap`` leg
+    gates agreement within 2x)."""
+    t_bwd = 0.0   # backward clock at each launch point
+    chan = 0.0    # comm-channel free time
+    launches: List[Tuple[float, float, Optional[Dict]]] = []
+    for site in reversed(list(sync_sites)):
+        t_bwd += site["bwd"]
+        s = site["sync"]
+        if s <= 0:
+            continue
+        start = max(t_bwd, chan)
+        chan = start + s
+        launches.append((start, s, site.get("entry")))
+    exposed_total = hidden_total = 0.0
+    for start, s, entry in launches:
+        exposed = min(s, max(0.0, (start + s) - t_bwd))
+        hidden = s - exposed
+        exposed_total += exposed
+        hidden_total += hidden
+        if entry is not None:
+            entry["sync_full_s"] = entry["sync_s"]
+            entry["sync_hidden_s"] = hidden
+            entry["sync_s"] = exposed
+            entry["total_s"] -= hidden
+    return exposed_total, hidden_total
 
 
 def _bytes_of_spec(w) -> int:

@@ -89,7 +89,17 @@ def _scripted(default_probe_results):
             return {"searched_vs_naive": 1.15, "naive_chunk_s": 0.02,
                     "searched_chunk_s": 0.017, "peak_ok": True,
                     "chunk": 16, "rounds": 6,
-                    "time_ok_deferred": True, "ok": True}, None
+                    "time_win": True, "ok": True}, None
+        if stage == "comm_overlap":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"overlapped_vs_serial": 1.06,
+                    "serial_chunk_s": 0.23, "overlap_chunk_s": 0.217,
+                    "parity_ok": True, "n_buckets": 4,
+                    "model_vs_sim_exposed": 0.73, "agree_ok": True,
+                    "chunk": 16, "rounds": 6, "time_win": True,
+                    "ok": True}, None
         if stage == "recovery":
             assert env.get("JAX_PLATFORMS") == "cpu"
             assert "xla_force_host_platform_device_count" \
@@ -182,6 +192,11 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         assert out["reshard_searched_vs_naive"] == 1.15
         assert out["reshard_peak_ok"] is True
         assert any(a[1] == "reshard" for a, _ in calls)
+        # and the communication-computation overlap leg (ISSUE 13)
+        assert out["comm_overlap_ratio"] == 1.06
+        assert out["comm_overlap_parity_ok"] is True
+        assert out["comm_overlap_model_vs_sim"] == 0.73
+        assert any(a[1] == "comm_overlap" for a, _ in calls)
         # so does the checkpoint-overhead + time-to-recover leg
         assert out["ckpt_async_overhead_pct"] == 1.1
         assert out["ckpt_sync_overhead_pct"] == 2.3

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""CI overlap parity smoke (ci.sh fast tier) — ISSUE 13.
+
+On a virtual 2-slice (DCN-joined) 8-device CPU config, run the SAME
+searched multi-tier plan twice — once on the serial update path and
+once with ``FF_OVERLAP=1`` (the bucketed barrier-chained grad-sync
+schedule, ``runtime/overlap.py``) — and assert the loss histories are
+IDENTICAL (bit-exact, not approximately equal): the overlap schedule
+is schedule shaping, it must never change the numbers. Mirrors
+``tools/async_parity_smoke.py``.
+
+The plan is pinned across the two runs by exporting the searched
+strategy from the serial compile and importing it into the overlapped
+one (the overlap-aware cost model scores plans differently, so two
+independent searches could adopt different — individually correct but
+not bit-comparable — plans). The overlapped run must actually build a
+bucket schedule, and its strategy record must pass the plan verifier's
+overlapped-ordering check (it runs inside compile).
+
+    python tools/overlap_parity_smoke.py
+"""
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+
+
+def _machine_spec():
+    from flexflow_tpu.parallel.machine import MachineSpec
+    spec = MachineSpec.detect()
+    spec.num_devices = 8
+    spec.num_slices = 2
+    spec.num_hosts = 2
+    spec.dcn_bandwidth_gbps = 1.0
+    spec.dcn_latency_us = 20.0
+    return spec
+
+
+def run_fit(overlap: bool, strategy_file: str):
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+
+    os.environ.pop("FF_OVERLAP", None)
+    if overlap:
+        os.environ["FF_OVERLAP"] = "1"
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.seed = 11
+    cfg.search_budget = 8
+    cfg.search_floor_guard = "false"
+    if overlap:
+        cfg.import_strategy_file = strategy_file
+        # fractional cap: several buckets on this ~360 KB model
+        cfg.overlap_bucket_mb = 0.1
+    else:
+        cfg.export_strategy_file = strategy_file
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 32, in_dim=64, hidden=(256, 256),
+                    num_classes=10)
+    ff.compile(SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+               ["accuracy"], machine_spec=_machine_spec(),
+               output_tensor=out)
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(192, 64)).astype(np.float32)
+    ys = rng.integers(0, 10, size=192).astype(np.int32)
+    hist = ff.fit(x=xs, y=ys, epochs=2, verbose=False)
+    os.environ.pop("FF_OVERLAP", None)
+    return hist, ff
+
+
+def main():
+    import numpy as np
+
+    with tempfile.TemporaryDirectory(prefix="ff_overlap_smoke_") as d:
+        sf = os.path.join(d, "strategy.json")
+        h_serial, ff_serial = run_fit(False, sf)
+        if ff_serial.executor._overlap_schedule is not None:
+            raise SystemExit("serial run built an overlap schedule")
+        h_overlap, ff_overlap = run_fit(True, sf)
+        sched = ff_overlap.executor._overlap_schedule
+        if sched is None:
+            raise SystemExit("FF_OVERLAP=1 built no overlap schedule")
+        rec = getattr(ff_overlap.strategy, "overlap", None)
+        if not rec or not rec.get("buckets"):
+            raise SystemExit("strategy carries no overlap record")
+
+    if len(h_serial) != len(h_overlap):
+        raise SystemExit(f"epoch count diverged: {len(h_serial)} vs "
+                         f"{len(h_overlap)}")
+    for e, (a, b) in enumerate(zip(h_serial, h_overlap)):
+        for k in ("loss", "accuracy"):
+            if a[k] != b[k]:
+                raise SystemExit(
+                    f"epoch {e} {k}: serial {a[k]!r} != overlapped "
+                    f"{b[k]!r} — the overlap schedule changed the "
+                    f"numbers")
+    if not np.isfinite(h_overlap[-1]["loss"]):
+        raise SystemExit("non-finite final loss")
+    print(f"overlap parity smoke OK: {len(h_overlap)} epochs on a "
+          f"searched 2-slice plan, {len(sched.buckets)} bucket(s), "
+          f"final loss {h_overlap[-1]['loss']:.6f} identical serial vs "
+          f"overlapped")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,11 +20,15 @@ fi
 case "${1:-fast}" in
   fast)
     # static analysis gate (docs/static_analysis.md): the framework-
-    # invariant linter must be clean over the whole package, and every
-    # checked-in strategy artifact must pass the static plan verifier —
-    # an unsound plan or an invariant regression fails the push before
-    # a single test runs
-    python tools/ffcheck.py --lint flexflow_tpu/ --verify-strategies
+    # invariant linter, the lock-discipline/thread-lifecycle analyzer,
+    # and the SPMD-divergence checker must all be clean over the whole
+    # package, and every checked-in strategy artifact must pass the
+    # static plan verifier — an unsound plan, an invariant regression,
+    # a lock race, or a rank-gated collective fails the push before a
+    # single test runs. --budget-s asserts the analyzers' combined
+    # wall time stays under 10s so the gate cannot silently bloat.
+    python tools/ffcheck.py --lint flexflow_tpu/ --concurrency --spmd \
+      --budget-s 10 --verify-strategies
     python -m pytest tests/ -x -q
     # tier-1 smoke under FF_TRACE=1: the default run above exercises the
     # disabled (near-zero-cost) telemetry paths; this pass exercises the

@@ -1,6 +1,6 @@
-"""Static analysis: plan verification + framework-invariant linting.
+"""Static analysis: plan verification + invariant/concurrency linting.
 
-Two engines behind one CLI (``tools/ffcheck.py``) and one library API:
+Four engines behind one CLI (``tools/ffcheck.py``) and one library API:
 
   - :mod:`flexflow_tpu.analysis.plan_verifier` — proves a searched
     strategy/PCG executable on a machine model BEFORE a device runs it:
@@ -15,12 +15,28 @@ Two engines behind one CLI (``tools/ffcheck.py``) and one library API:
     dispatch window, ``-O``-safe typed errors instead of ``assert``,
     every cross-rank/thread wait bounded, no wall-clock reads inside
     jitted fns), with a ``# ffcheck: ok(<rule>)`` suppression pragma.
+  - :mod:`flexflow_tpu.analysis.concurrency` — lock-discipline proof
+    over the threaded runtime (ISSUE 14): inferred lock-guarded
+    attributes enforced at every access, a cross-module
+    lock-acquisition-order graph with cycle detection, thread
+    lifecycle (daemon or bounded join), and typed unbounded-wait.
+  - :mod:`flexflow_tpu.analysis.spmd` — SPMD-divergence checker: a
+    call-graph reachability walk flagging collective/rendezvous
+    operations reachable from only one side of rank-dependent control
+    flow (the "collective inside a rank-conditional" deadlock class).
 
-Both run in ``ci.sh``'s fast tier as a hard gate. See
-``docs/static_analysis.md``.
+All of them run in ``ci.sh``'s fast tier as a hard gate (with a
+wall-time budget). See ``docs/static_analysis.md``.
 """
-from .lint import LintFinding, lint_file, lint_paths  # noqa: F401
+from .concurrency import CONCURRENCY_RULES  # noqa: F401
+from .concurrency import analyze_paths as analyze_concurrency  # noqa: F401
+from .concurrency import analyze_sources as analyze_concurrency_sources  # noqa: F401,E501
+from .lint import (JSON_SCHEMA_VERSION, LintFinding,  # noqa: F401
+                   lint_file, lint_paths)
 from .plan_verifier import (Finding, PlanReport,  # noqa: F401
                             PlanVerificationError, StructMesh,
                             verify_model, verify_plan,
                             verify_strategy_file)
+from .spmd import SPMD_RULES, SPMD_SCOPE  # noqa: F401
+from .spmd import analyze_paths as analyze_spmd  # noqa: F401
+from .spmd import analyze_sources as analyze_spmd_sources  # noqa: F401

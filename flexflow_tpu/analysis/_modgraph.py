@@ -1,0 +1,502 @@
+"""Shared AST module models for the ffcheck v2 engines.
+
+The lock-discipline analyzer (:mod:`.concurrency`) and the
+SPMD-divergence checker (:mod:`.spmd`) both need the same substrate: a
+per-module model of classes, synchronization objects, instances, and
+imports, plus a conservative package-wide call resolver so a summary
+("locks this function acquires", "collectives this function performs")
+can propagate through ``self.method()`` / ``module.function()`` /
+``instance.method()`` call sites. This module is that substrate — pure
+``ast``, no imports of the analyzed code, so an unimportable module
+still analyzes.
+
+Resolution is deliberately conservative: a call that cannot be resolved
+statically contributes nothing (no false edges), and only modules
+handed to the same :class:`Package` participate (single-file analyses
+simply resolve less). Dotted module names are derived from the path's
+``flexflow_tpu`` component when present so relative imports
+(``from ..obs import events``) resolve across the package.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: constructor name -> synchronization-object kind. ``Condition`` wraps
+#: an RLock by default, so re-acquisition is not a self-deadlock.
+SYNC_CTORS: Dict[str, str] = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+    "Event": "event", "Thread": "thread", "Semaphore": "lock",
+    "BoundedSemaphore": "lock", "Barrier": "event",
+}
+
+#: kinds that a ``with`` block acquires (guard a critical section)
+ACQUIRABLE = ("lock", "rlock", "condition")
+
+#: method names that mutate a container in place — calling one on a
+#: guarded field is a write for lock-discipline purposes
+MUTATORS: Set[str] = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault", "sort", "reverse",
+}
+
+#: keyword names that count as a bound on a wait/join call (mirrors
+#: lint's raw-wait rule)
+TIMEOUT_KWARGS = {"timeout", "timeout_s", "timeout_ms", "deadline_s",
+                  "deadline"}
+
+
+def norm_path(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def stable_path(path: str) -> str:
+    """Repo-stable spelling of a finding path: the suffix from the
+    package component on when present (absolute/relative prefixes vary
+    per checkout and must not change finding IDs)."""
+    norm = norm_path(path)
+    parts = norm.split("/")
+    for anchor in ("flexflow_tpu", "tests", "tools"):
+        if anchor in parts:
+            return "/".join(parts[parts.index(anchor):])
+    return parts[-1]
+
+
+def dotted_name(path: str) -> str:
+    """Dotted module name derived from the path (anchored at the
+    ``flexflow_tpu`` component when present)."""
+    norm = norm_path(path)
+    parts = norm.split("/")
+    if "flexflow_tpu" in parts:
+        parts = parts[parts.index("flexflow_tpu"):]
+    else:
+        parts = parts[-1:]
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def attr_chain(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def sync_kind_of_call(call: ast.AST) -> Optional[str]:
+    """``threading.Lock()`` / ``Lock()`` -> "lock" etc., else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else \
+        fn.attr if isinstance(fn, ast.Attribute) else None
+    return SYNC_CTORS.get(name or "")
+
+
+def sync_kind_of_annotation(ann: Optional[ast.AST]) -> Optional[str]:
+    """``Optional[threading.Thread]`` -> "thread" etc. — annotations
+    type the attrs that start as None (``self._thread: Optional[
+    threading.Thread] = None``)."""
+    if ann is None:
+        return None
+    for sub in ast.walk(ann):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            # string annotations ("threading.Thread")
+            name = sub.value.rsplit(".", 1)[-1].strip("] '\"")
+        if name in SYNC_CTORS:
+            return SYNC_CTORS[name]
+    return None
+
+
+class FuncInfo:
+    """One function or method (nested defs included)."""
+
+    __slots__ = ("module", "cls", "name", "qualname", "node")
+
+    def __init__(self, module: "ModuleInfo", cls: Optional["ClassInfo"],
+                 name: str, qualname: str, node: ast.AST):
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.qualname = qualname
+        self.node = node
+
+
+class ClassInfo:
+    def __init__(self, module: "ModuleInfo", name: str):
+        self.module = module
+        self.name = name
+        self.sync: Dict[str, str] = {}        # attr -> kind
+        self.instances: Dict[str, Tuple[str, str]] = {}  # attr -> (mod, cls)
+        self.methods: Dict[str, FuncInfo] = {}
+        self.fields: Set[str] = set()          # every self.<attr> ever assigned
+
+
+class ModuleInfo:
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.norm = norm_path(path)
+        self.dotted = dotted_name(path)
+        # a package __init__ IS its package: `from . import x` there
+        # resolves against self.dotted, not its parent
+        self.is_package = os.path.basename(self.norm) == "__init__.py"
+        self.tree = tree
+        self.source = source
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}   # module-level defs
+        self.all_functions: List[FuncInfo] = []    # incl. methods/nested
+        self.sync: Dict[str, str] = {}             # global -> kind
+        self.instances: Dict[str, Tuple[str, str]] = {}
+        self.toplevel: Set[str] = set()            # names assigned at top level
+        self.imports_mod: Dict[str, str] = {}      # alias -> dotted module
+        self.imports_sym: Dict[str, Tuple[str, str]] = {}  # alias -> (mod, sym)
+
+
+class Package:
+    """A set of analyzed modules + the conservative resolver."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+
+    # ------------------------------------------------------------------
+    def add_source(self, path: str, source: str) -> Optional[ModuleInfo]:
+        """Parse + model one file; returns None on syntax error (the
+        caller reports rule ``parse-error`` through the linter)."""
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return None
+        mod = ModuleInfo(path, tree, source)
+        self._collect(mod)
+        self.modules[mod.dotted] = mod
+        return mod
+
+    def add_file(self, path: str) -> Optional[ModuleInfo]:
+        with open(path, encoding="utf-8") as f:
+            return self.add_source(path, f.read())
+
+    # ------------------------------------------------------------------
+    # model collection
+    # ------------------------------------------------------------------
+    def _collect(self, mod: ModuleInfo) -> None:
+        self._collect_imports(mod)
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(mod, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(mod, None, node.name, node.name, node)
+                mod.functions[node.name] = fi
+                mod.all_functions.append(fi)
+                self._collect_nested(mod, None, node, node.name)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._collect_toplevel_assign(mod, node)
+
+    def _collect_imports(self, mod: ModuleInfo) -> None:
+        # imports ANYWHERE in the module (this repo imports lazily
+        # inside functions throughout)
+        if mod.is_package:
+            pkg_parts = mod.dotted.split(".") if mod.dotted else []
+        else:
+            pkg_parts = mod.dotted.split(".")[:-1] \
+                if "." in mod.dotted else []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports_mod[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom):
+                base: List[str] = []
+                if node.level:
+                    if node.level - 1 <= len(pkg_parts):
+                        base = pkg_parts[:len(pkg_parts)
+                                         - (node.level - 1)]
+                    else:
+                        continue
+                if node.module:
+                    base = base + node.module.split(".")
+                target = ".".join(base)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mod.imports_sym[a.asname or a.name] = (target, a.name)
+
+    def _collect_toplevel_assign(self, mod: ModuleInfo, node) -> None:
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        value = node.value
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                names.extend(e.id for e in t.elts
+                             if isinstance(e, ast.Name))
+        mod.toplevel.update(names)
+        kind = sync_kind_of_call(value)
+        if kind is None and isinstance(node, ast.AnnAssign):
+            kind = sync_kind_of_annotation(node.annotation)
+        if kind is not None:
+            for n in names:
+                mod.sync[n] = kind
+            return
+        inst = self._instance_of_call(mod, value)
+        if inst is not None:
+            for n in names:
+                mod.instances[n] = inst
+
+    def _instance_of_call(self, mod: ModuleInfo,
+                          value) -> Optional[Tuple[str, str]]:
+        """``X = ClassName(...)`` (same module or imported class) ->
+        (dotted module, class name)."""
+        if not isinstance(value, ast.Call):
+            return None
+        fn = value.func
+        if isinstance(fn, ast.Name):
+            if fn.id in mod.classes:
+                return (mod.dotted, fn.id)
+            sym = mod.imports_sym.get(fn.id)
+            if sym is not None:
+                return sym  # resolved lazily against the package
+        elif isinstance(fn, ast.Attribute) and isinstance(fn.value,
+                                                          ast.Name):
+            target = mod.imports_mod.get(fn.value.id) \
+                or self._sym_module(mod, fn.value.id)
+            if target:
+                return (target, fn.attr)
+        return None
+
+    def _sym_module(self, mod: ModuleInfo, alias: str) -> Optional[str]:
+        """An imported symbol that is itself a module
+        (``from . import status``) -> its dotted name."""
+        sym = mod.imports_sym.get(alias)
+        if sym is None:
+            return None
+        dotted = f"{sym[0]}.{sym[1]}" if sym[0] else sym[1]
+        return dotted
+
+    def _collect_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        ci = ClassInfo(mod, node.name)
+        mod.classes[node.name] = ci
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{node.name}.{item.name}"
+                fi = FuncInfo(mod, ci, item.name, qual, item)
+                ci.methods[item.name] = fi
+                mod.all_functions.append(fi)
+                self._collect_nested(mod, ci, item, qual)
+                self._collect_self_assigns(mod, ci, item)
+            elif isinstance(item, ast.Assign):
+                for t in item.targets:
+                    if isinstance(t, ast.Name):
+                        ci.fields.add(t.id)
+
+    def _collect_nested(self, mod: ModuleInfo, ci: Optional[ClassInfo],
+                        fn, prefix: str) -> None:
+        for sub in ast.walk(fn):
+            if sub is fn or not isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = f"{prefix}.<nested>.{sub.name}"
+            mod.all_functions.append(FuncInfo(mod, ci, sub.name, qual,
+                                              sub))
+
+    def _collect_self_assigns(self, mod: ModuleInfo, ci: ClassInfo,
+                              fn) -> None:
+        for sub in ast.walk(fn):
+            ann = None
+            if isinstance(sub, ast.AnnAssign):
+                targets, value, ann = [sub.target], sub.value, \
+                    sub.annotation
+            elif isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            else:
+                continue
+            for t in targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                ci.fields.add(t.attr)
+                kind = sync_kind_of_call(value) \
+                    or sync_kind_of_annotation(ann)
+                if kind is not None:
+                    ci.sync.setdefault(t.attr, kind)
+                    continue
+                inst = self._instance_of_call(mod, value)
+                if inst is not None:
+                    ci.instances.setdefault(t.attr, inst)
+                # a list/comprehension of Threads is a thread-collection
+                elif value is not None and any(
+                        sync_kind_of_call(c) == "thread"
+                        for c in ast.walk(value)
+                        if isinstance(c, ast.Call)):
+                    ci.sync.setdefault(t.attr, "thread")
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+    def class_info(self, ref: Optional[Tuple[str, str]]
+                   ) -> Optional[ClassInfo]:
+        if ref is None:
+            return None
+        m = self.modules.get(ref[0])
+        if m is None:
+            # `from pkg.mod import Cls` — ref[0] may be the defining
+            # module with ref[1] the class
+            return None
+        ci = m.classes.get(ref[1])
+        if ci is not None:
+            return ci
+        # ref may point at (module, instance-symbol)
+        inst = m.instances.get(ref[1])
+        if inst is not None:
+            return self.class_info(inst)
+        return None
+
+    def module_of_alias(self, mod: ModuleInfo,
+                        alias: str) -> Optional[ModuleInfo]:
+        dotted = mod.imports_mod.get(alias)
+        if dotted is None:
+            dotted = self._sym_module(mod, alias)
+        if dotted is None:
+            return None
+        return self.modules.get(dotted)
+
+    def resolve_value(self, fn: FuncInfo, expr: ast.AST,
+                      local_types: Dict[str, object]):
+        """Resolve an expression to one of:
+        ``("sync", kind, lock_id)`` — a synchronization object, where
+        ``lock_id`` is the stable identity ``(module, class|None, attr)``;
+        ``("instance", ClassInfo)``; ``("module", ModuleInfo)``;
+        ``("class", ClassInfo)``; or None."""
+        if isinstance(expr, ast.Name):
+            if expr.id in local_types:
+                # locals SHADOW module scope — an untyped local
+                # (value None) resolves to nothing, never to a
+                # same-named module object
+                return local_types[expr.id]
+            mod = fn.module
+            if expr.id == "self" and fn.cls is not None:
+                return ("instance", fn.cls)
+            if expr.id in mod.sync:
+                return ("sync", mod.sync[expr.id],
+                        (mod.dotted, None, expr.id))
+            if expr.id in mod.instances:
+                ci = self.class_info(mod.instances[expr.id])
+                if ci is not None:
+                    return ("instance", ci)
+                return None
+            if expr.id in mod.classes:
+                return ("class", mod.classes[expr.id])
+            m = self.module_of_alias(mod, expr.id)
+            if m is not None:
+                return ("module", m)
+            sym = mod.imports_sym.get(expr.id)
+            if sym is not None:
+                tm = self.modules.get(sym[0])
+                if tm is not None:
+                    if sym[1] in tm.classes:
+                        return ("class", tm.classes[sym[1]])
+                    if sym[1] in tm.instances:
+                        ci = self.class_info(tm.instances[sym[1]])
+                        if ci is not None:
+                            return ("instance", ci)
+                    if sym[1] in tm.sync:
+                        return ("sync", tm.sync[sym[1]],
+                                (tm.dotted, None, sym[1]))
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.resolve_value(fn, expr.value, local_types)
+            if base is None:
+                return None
+            tag = base[0]
+            if tag == "instance":
+                ci: ClassInfo = base[1]
+                if expr.attr in ci.sync:
+                    return ("sync", ci.sync[expr.attr],
+                            (ci.module.dotted, ci.name, expr.attr))
+                if expr.attr in ci.instances:
+                    sub = self.class_info(ci.instances[expr.attr])
+                    if sub is not None:
+                        return ("instance", sub)
+                return None
+            if tag == "module":
+                m: ModuleInfo = base[1]
+                if expr.attr in m.sync:
+                    return ("sync", m.sync[expr.attr],
+                            (m.dotted, None, expr.attr))
+                if expr.attr in m.instances:
+                    ci = self.class_info(m.instances[expr.attr])
+                    if ci is not None:
+                        return ("instance", ci)
+                if expr.attr in m.classes:
+                    return ("class", m.classes[expr.attr])
+            return None
+        return None
+
+    def resolve_callee(self, fn: FuncInfo, call: ast.Call,
+                       local_types: Dict[str, object]
+                       ) -> Optional[FuncInfo]:
+        """The FuncInfo a call statically resolves to, else None."""
+        f = call.func
+        mod = fn.module
+        if isinstance(f, ast.Name):
+            if f.id in local_types and local_types[f.id] is not None:
+                return None  # calling a local object — not resolvable
+            if f.id in mod.functions:
+                return mod.functions[f.id]
+            sym = mod.imports_sym.get(f.id)
+            if sym is not None:
+                tm = self.modules.get(sym[0])
+                if tm is not None:
+                    return tm.functions.get(sym[1])
+            return None
+        if isinstance(f, ast.Attribute):
+            base = self.resolve_value(fn, f.value, local_types)
+            if base is None:
+                return None
+            if base[0] == "instance":
+                return base[1].methods.get(f.attr)
+            if base[0] == "class":
+                return base[1].methods.get(f.attr)
+            if base[0] == "module":
+                return base[1].functions.get(f.attr)
+        return None
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    """Files + directory trees, skipping ``tests``/``__pycache__`` dirs
+    and ``test_*.py`` (same walk as the linter's)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", "tests",
+                                              ".git"))
+                for fname in sorted(files):
+                    if fname.endswith(".py") \
+                            and not fname.startswith("test_"):
+                        out.append(os.path.join(root, fname))
+        else:
+            out.append(p)
+    return out
+
+
+def call_is_bounded(node: ast.Call) -> bool:
+    """A wait/join call with a positional bound or a timeout kwarg."""
+    if node.args:
+        return True
+    return bool({k.arg for k in node.keywords if k.arg}
+                & TIMEOUT_KWARGS)

@@ -1,0 +1,860 @@
+"""Lock-discipline + thread-lifecycle static analysis (ffcheck v2).
+
+The runtime grew hand-rolled threads (heartbeat daemons, async
+checkpoint writers, the serving scheduler workers, the obs ring) whose
+safety rested on convention; every recent PR's review-hardening pass
+found a lock race by hand (PR 7's ``_scan_peers`` peer-table race, PR
+5's drain-vs-unload snapshot). This engine proves the conventions — or
+names the line that breaks them:
+
+  ``guarded-field``
+      Per-class (and per-module, for module-global state like
+      ``obs/events.py``'s ring) inference of lock-guarded attributes: a
+      field WRITTEN at least once while holding a lock (outside
+      ``__init__``/module top level) is *guarded* by that lock, and
+      every other access — read or write, including container mutators
+      like ``.append``/``.clear`` and item assignment — must hold it.
+      Accesses through a same-module instance attribute resolve
+      cross-object (``self.breaker.state`` is checked against
+      ``CircuitBreaker``'s discipline). Methods named ``*_locked``
+      are assumed to run with their scope's locks held (the repo's
+      existing convention, e.g. ``events._reset_locked``).
+  ``lock-order``
+      A cross-module lock-acquisition-order graph: acquiring lock B
+      while holding lock A adds edge A→B, including acquisitions
+      reached through statically-resolvable calls (``self.m()``,
+      ``module.f()``, ``instance.m()`` — conservative: unresolvable
+      calls add nothing). Any cycle is a potential deadlock; a
+      non-reentrant lock re-acquired while held is a self-cycle.
+  ``thread-lifecycle``
+      Every ``threading.Thread`` constructed must be ``daemon=True``
+      at construction (or via a ``.daemon = True`` assignment on its
+      binding) or joined with a timeout somewhere in its owning scope
+      — a non-daemon, never-joined thread blocks interpreter exit and
+      leaks on unload.
+  ``unbounded-wait``
+      ``Event.wait()`` / ``Condition.wait()`` / ``Thread.join()``
+      without a bound, on receivers *typed* by construction-site
+      inference (``self._stop = threading.Event()``, annotations,
+      cross-object attrs) — the class-sharpened, repo-wide form of the
+      linter's name-heuristic ``raw-wait`` rule.
+
+Locks are identified per (module, class, attribute); two instances of
+one class share an identity — sound for the singleton/worker-pool
+shapes this repo uses. Suppression: the shared ``# ffcheck:
+ok(<rule>)`` pragma with a one-line justification comment (policy in
+``docs/static_analysis.md``). Findings carry the owning symbol
+(``Class.method``) for stable IDs in the schema-2 JSON report.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import _modgraph as mg
+from .lint import LintFinding, _pragmas, _suppressed
+
+__all__ = ["CONCURRENCY_RULES", "analyze_paths", "analyze_sources"]
+
+CONCURRENCY_RULES: Dict[str, str] = {
+    "guarded-field": "lock-guarded attribute accessed without its lock",
+    "lock-order": "lock-acquisition-order cycle (potential deadlock)",
+    "thread-lifecycle": "thread neither daemon nor joined with a timeout",
+    "unbounded-wait": "unbounded wait on a typed Event/Condition/Thread",
+}
+
+LockId = Tuple[str, Optional[str], str]     # (module, class|None, attr)
+FieldKey = Tuple[str, Optional[str], str]
+
+
+def _lock_sort(lock: LockId):
+    return (lock[0], lock[1] or "", lock[2])
+
+
+def _lock_name(lock: LockId) -> str:
+    mod, cls, attr = lock
+    short = mod.rsplit(".", 1)[-1]
+    return f"{short}.{cls}.{attr}" if cls else f"{short}.{attr}"
+
+
+class _Access:
+    __slots__ = ("field", "kind", "held", "node", "in_init", "fn")
+
+    def __init__(self, field: FieldKey, kind: str, held: frozenset,
+                 node: ast.AST, in_init: bool, fn: mg.FuncInfo):
+        self.field = field
+        self.kind = kind
+        self.held = held
+        self.node = node
+        self.in_init = in_init
+        self.fn = fn
+
+
+class _FuncFacts:
+    def __init__(self, fn: mg.FuncInfo):
+        self.fn = fn
+        self.accesses: List[_Access] = []
+        # (lock_id, kind, held-before, node)
+        self.acquires: List[Tuple[LockId, str, frozenset, ast.AST]] = []
+        # (callee FuncInfo, held, node)
+        self.calls: List[Tuple[mg.FuncInfo, frozenset, ast.AST]] = []
+        # (node, sync kind, bounded, receiver description)
+        self.waits: List[Tuple[ast.AST, str, bool, str]] = []
+        # (node, daemon-at-ctor, binding) binding: ("attr", attr) |
+        # ("local", name) | None
+        self.threads: List[Tuple[ast.Call, bool,
+                                 Optional[Tuple[str, str]]]] = []
+
+
+def _initial_held(pkg: mg.Package, fn: mg.FuncInfo) -> Set[LockId]:
+    """``*_locked`` helpers run with their scope's locks held (repo
+    convention; enforced at the call sites by the same analysis)."""
+    if not fn.name.endswith("_locked"):
+        return set()
+    held: Set[LockId] = set()
+    scope_sync = fn.cls.sync if fn.cls is not None else fn.module.sync
+    owner = fn.cls.name if fn.cls is not None else None
+    for attr, kind in scope_sync.items():
+        if kind in mg.ACQUIRABLE:
+            held.add((fn.module.dotted, owner, attr))
+    return held
+
+
+class _FnWalker:
+    """One function's lock-held dataflow walk."""
+
+    def __init__(self, pkg: mg.Package, fn: mg.FuncInfo):
+        self.pkg = pkg
+        self.fn = fn
+        self.facts = _FuncFacts(fn)
+        self.in_init = fn.name == "__init__"
+        self._pending_acq: List[LockId] = []
+        self._pending_rel: List[LockId] = []
+        self.locals: Dict[str, object] = {}
+        # (field, line) -> index into facts.accesses (one access per
+        # field per line; a mutator call upgrades the base read to 'w')
+        self._seen_access: Dict[Tuple[FieldKey, int], int] = {}
+        self._collect_locals(fn.node)
+
+    # -- local typing --------------------------------------------------
+    def _collect_locals(self, node) -> None:
+        args = node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            self.locals[a.arg] = None
+        if self.fn.cls is not None and "self" in self.locals:
+            self.locals["self"] = ("instance", self.fn.cls)
+        globals_: Set[str] = set()
+        for sub in self._own_nodes(node):
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                globals_.update(sub.names)
+        for sub in self._own_nodes(node):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    self._bind_target(t, sub.value, globals_)
+            elif isinstance(sub, ast.AnnAssign) and isinstance(
+                    sub.target, ast.Name):
+                if sub.target.id not in globals_:
+                    kind = mg.sync_kind_of_call(sub.value) \
+                        or mg.sync_kind_of_annotation(sub.annotation)
+                    self.locals[sub.target.id] = (
+                        ("sync", kind, None) if kind else None)
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                self._bind_target(sub.target, None, globals_)
+            elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    if item.optional_vars is not None:
+                        self._bind_target(item.optional_vars, None,
+                                          globals_)
+            elif isinstance(sub, ast.ExceptHandler) and sub.name:
+                self.locals[sub.name] = None
+            elif isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                  ast.GeneratorExp)):
+                for gen in sub.generators:
+                    self._bind_target(gen.target, None, globals_)
+            elif isinstance(sub, ast.NamedExpr) and isinstance(
+                    sub.target, ast.Name):
+                if sub.target.id not in globals_:
+                    self.locals.setdefault(sub.target.id, None)
+
+    def _own_nodes(self, fn_node):
+        """All nodes of this function EXCLUDING nested function bodies
+        (they are separate FuncInfos with their own walk)."""
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _bind_target(self, target, value, globals_: Set[str]) -> None:
+        if isinstance(target, ast.Tuple):
+            for e in target.elts:
+                self._bind_target(e, None, globals_)
+            return
+        if not isinstance(target, ast.Name) or target.id in globals_:
+            return
+        typed = None
+        if value is not None:
+            kind = mg.sync_kind_of_call(value)
+            if kind is not None:
+                typed = ("sync", kind, None)  # fresh local sync object
+            else:
+                typed = self.pkg.resolve_value(self.fn, value,
+                                               self.locals)
+        prev = self.locals.get(target.id)
+        # keep the first informative binding (t = self._thread; t = None)
+        if prev is None or target.id not in self.locals:
+            self.locals[target.id] = typed
+
+    # -- walk ----------------------------------------------------------
+    def run(self) -> _FuncFacts:
+        held = frozenset(_initial_held(self.pkg, self.fn))
+        self._walk(self.fn.node.body, held)
+        return self.facts
+
+    def _resolve(self, expr):
+        return self.pkg.resolve_value(self.fn, expr, self.locals)
+
+    def _walk(self, stmts: Sequence[ast.stmt], held: frozenset) -> None:
+        held = set(held)
+        for st in stmts:
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                newly: List[LockId] = []
+                for item in st.items:
+                    r = self._resolve(item.context_expr)
+                    if r is not None and r[0] == "sync" \
+                            and r[1] in mg.ACQUIRABLE \
+                            and r[2] is not None:
+                        self.facts.acquires.append(
+                            (r[2], r[1], frozenset(held),
+                             item.context_expr))
+                        newly.append(r[2])
+                    else:
+                        self._expr(item.context_expr, frozenset(held))
+                self._walk(st.body, frozenset(held) | set(newly))
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in st.decorator_list:
+                    self._expr(dec, frozenset(held))
+            elif isinstance(st, ast.ClassDef):
+                pass  # classes inside functions: out of scope
+            elif isinstance(st, ast.If):
+                self._expr(st.test, frozenset(held))
+                self._walk(st.body, frozenset(held))
+                self._walk(st.orelse, frozenset(held))
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._expr(st.iter, frozenset(held))
+                self._walk(st.body, frozenset(held))
+                self._walk(st.orelse, frozenset(held))
+            elif isinstance(st, ast.While):
+                self._expr(st.test, frozenset(held))
+                self._walk(st.body, frozenset(held))
+                self._walk(st.orelse, frozenset(held))
+            elif isinstance(st, ast.Try) or st.__class__.__name__ == \
+                    "TryStar":
+                self._walk(st.body, frozenset(held))
+                for h in st.handlers:
+                    if h.type is not None:
+                        self._expr(h.type, frozenset(held))
+                    self._walk(h.body, frozenset(held))
+                self._walk(st.orelse, frozenset(held))
+                self._walk(st.finalbody, frozenset(held))
+            elif st.__class__.__name__ == "Match":
+                self._expr(st.subject, frozenset(held))
+                for case in st.cases:
+                    self._walk(case.body, frozenset(held))
+            else:
+                acq, rel = self._stmt_exprs(st, frozenset(held))
+                held |= set(acq)
+                held -= set(rel)
+
+    def _stmt_exprs(self, st: ast.stmt, held: frozenset
+                    ) -> Tuple[List[LockId], List[LockId]]:
+        """Visit a simple statement's expressions; returns explicit
+        ``.acquire()``/``.release()`` lock-id lists (held state for the
+        REST of the enclosing block — coarse but sound enough)."""
+        self._pending_acq = []
+        self._pending_rel = []
+        if isinstance(st, ast.Assign):
+            self._maybe_thread_binding(st.targets, st.value)
+            for t in st.targets:
+                self._expr(t, held)
+            self._expr(st.value, held)
+        elif isinstance(st, ast.AnnAssign):
+            self._maybe_thread_binding([st.target], st.value)
+            self._expr(st.target, held)
+            if st.value is not None:
+                self._expr(st.value, held)
+        elif isinstance(st, ast.AugAssign):
+            self._expr(st.target, held, force_write=True)
+            self._expr(st.value, held)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child, held)
+        return self._pending_acq, self._pending_rel
+
+    # -- thread constructions ------------------------------------------
+    def _maybe_thread_binding(self, targets, value) -> None:
+        if value is None:
+            return
+        ctors = [c for c in ast.walk(value) if isinstance(c, ast.Call)
+                 and mg.sync_kind_of_call(c) == "thread"]
+        if not ctors:
+            return
+        binding: Optional[Tuple[str, str]] = None
+        for t in targets:
+            if isinstance(t, ast.Name):
+                binding = ("local", t.id)
+            elif isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                binding = ("attr", t.attr)
+        for c in ctors:
+            self.facts.threads.append((c, _ctor_daemon(c), binding))
+
+    # -- expressions ---------------------------------------------------
+    def _record_access(self, field: FieldKey, kind: str, held: frozenset,
+                       node: ast.AST) -> None:
+        key = (field, getattr(node, "lineno", 0))
+        idx = self._seen_access.get(key)
+        if idx is not None:
+            if kind == "w":
+                self.facts.accesses[idx].kind = "w"
+            return
+        self._seen_access[key] = len(self.facts.accesses)
+        self.facts.accesses.append(
+            _Access(field, kind, held, node, self.in_init, self.fn))
+
+    def _field_of_attribute(self, node: ast.Attribute
+                            ) -> Optional[FieldKey]:
+        base = self._resolve(node.value)
+        if base is None:
+            return None
+        if base[0] == "instance":
+            ci: mg.ClassInfo = base[1]
+            if node.attr in ci.methods or node.attr in ci.sync:
+                return None
+            return (ci.module.dotted, ci.name, node.attr)
+        if base[0] == "module":
+            # cross-module global access (mod._x) joins mod's own
+            # discipline — e.g. a package __init__ poking a submodule's
+            # guarded state
+            m: mg.ModuleInfo = base[1]
+            if node.attr in m.toplevel and node.attr not in m.sync \
+                    and node.attr not in m.functions \
+                    and node.attr not in m.classes \
+                    and node.attr not in m.imports_mod \
+                    and node.attr not in m.imports_sym:
+                return (m.dotted, None, node.attr)
+        return None
+
+    def _field_of_name(self, node: ast.Name) -> Optional[FieldKey]:
+        if node.id in self.locals:
+            return None
+        mod = self.fn.module
+        if node.id not in mod.toplevel or node.id in mod.sync \
+                or node.id in mod.functions or node.id in mod.classes \
+                or node.id in mod.imports_mod \
+                or node.id in mod.imports_sym:
+            return None
+        return (mod.dotted, None, node.id)
+
+    def _expr(self, node: ast.AST, held: frozenset,
+              force_write: bool = False) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Attribute):
+            field = self._field_of_attribute(node)
+            if field is not None:
+                kind = "w" if force_write or isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "r"
+                self._record_access(field, kind, held, node)
+            self._expr(node.value, held)
+            return
+        if isinstance(node, ast.Name):
+            field = self._field_of_name(node)
+            if field is not None:
+                kind = "w" if force_write or isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "r"
+                self._record_access(field, kind, held, node)
+            return
+        if isinstance(node, ast.Subscript):
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self._expr(node.value, held, force_write=write)
+            self._expr(node.slice, held)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+            return
+        if isinstance(node, ast.Lambda):
+            # executed later in principle; in practice this repo's
+            # lambdas are local-only — walked with the current held set
+            self._expr(node.body, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword,
+                                  ast.comprehension)):
+                self._expr(child, held)
+
+    def _call(self, node: ast.Call, held: frozenset) -> None:
+        fnx = node.func
+        if isinstance(fnx, ast.Attribute):
+            base_r = self._resolve(fnx.value)
+            # explicit acquire()/release()
+            if base_r is not None and base_r[0] == "sync" \
+                    and base_r[1] in mg.ACQUIRABLE \
+                    and base_r[2] is not None:
+                if fnx.attr == "acquire":
+                    self.facts.acquires.append(
+                        (base_r[2], base_r[1], held, node))
+                    self._pending_acq.append(base_r[2])
+                elif fnx.attr == "release":
+                    self._pending_rel.append(base_r[2])
+            # typed waits
+            if base_r is not None and base_r[0] == "sync":
+                skind = base_r[1]
+                if (fnx.attr in ("wait", "wait_for")
+                        and skind in ("event", "condition")) \
+                        or (fnx.attr == "join" and skind == "thread"):
+                    bounded = mg.call_is_bounded(node)
+                    if fnx.attr == "wait_for":
+                        # wait_for(pred) — only a timeout kwarg or a
+                        # SECOND positional bounds it
+                        bounded = len(node.args) >= 2 or bool(
+                            {k.arg for k in node.keywords if k.arg}
+                            & mg.TIMEOUT_KWARGS)
+                    self.facts.waits.append(
+                        (node, skind, bounded,
+                         mg.attr_chain(fnx) or fnx.attr))
+            # container mutators on fields = writes
+            if fnx.attr in mg.MUTATORS:
+                f = None
+                if isinstance(fnx.value, ast.Attribute):
+                    f = self._field_of_attribute(fnx.value)
+                elif isinstance(fnx.value, ast.Name):
+                    f = self._field_of_name(fnx.value)
+                if f is not None:
+                    self._record_access(f, "w", held, node)
+        # unbound thread construction (bound ones recorded at Assign)
+        if mg.sync_kind_of_call(node) == "thread" and not any(
+                node is c for c, _, _ in self.facts.threads):
+            self.facts.threads.append((node, _ctor_daemon(node), None))
+        callee = self.pkg.resolve_callee(self.fn, node, self.locals)
+        if callee is not None:
+            self.facts.calls.append((callee, held, node))
+        self._expr(fnx, held)
+        for a in node.args:
+            self._expr(a, held)
+        for k in node.keywords:
+            self._expr(k.value, held)
+
+
+def _ctor_daemon(call: ast.Call) -> bool:
+    for k in call.keywords:
+        if k.arg == "daemon" and isinstance(k.value, ast.Constant):
+            return bool(k.value.value)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# package-level analysis
+# ---------------------------------------------------------------------------
+
+class _Analysis:
+    def __init__(self, pkg: mg.Package):
+        self.pkg = pkg
+        self.facts: Dict[int, _FuncFacts] = {}
+        for mod in pkg.modules.values():
+            for fi in mod.all_functions:
+                self.facts[id(fi)] = _FnWalker(pkg, fi).run()
+
+    # -- guarded-field -------------------------------------------------
+    def guarded_field_findings(self) -> List[LintFinding]:
+        fields: Dict[FieldKey, List[_Access]] = {}
+        for facts in self.facts.values():
+            for a in facts.accesses:
+                if a.field[2].startswith("__"):
+                    continue
+                fields.setdefault(a.field, []).append(a)
+        out: List[LintFinding] = []
+        for field, accs in fields.items():
+            locked_writes = [a for a in accs
+                             if a.kind == "w" and a.held
+                             and not a.in_init]
+            if not locked_writes:
+                continue
+            guards = frozenset.intersection(
+                *[a.held for a in locked_writes])
+            if not guards:
+                # written under DIFFERENT locks in different places —
+                # fall back to the union (lenient: any of them counts)
+                guards = frozenset().union(
+                    *[a.held for a in locked_writes])
+            owner = f"{field[1]}." if field[1] else ""
+            lock_names = "/".join(sorted(_lock_name(g) for g in guards))
+            n_locked = len([a for a in accs if a.held])
+            for a in accs:
+                if a.in_init or (a.held & guards):
+                    continue
+                what = "written" if a.kind == "w" else "read"
+                out.append(_finding(
+                    "guarded-field", a.fn, a.node,
+                    f"{owner}{field[2]} is guarded by {lock_names} "
+                    f"({n_locked} locked access(es), incl. writes) but "
+                    f"{what} here without it; hold the lock or pragma "
+                    f"with a justification"))
+        return out
+
+    # -- lock-order ----------------------------------------------------
+    def _transitive_acquires(self) -> Dict[int, Set[LockId]]:
+        summary: Dict[int, Set[LockId]] = {
+            fid: {lock for lock, _, _, _ in facts.acquires}
+            for fid, facts in self.facts.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fid, facts in self.facts.items():
+                cur = summary[fid]
+                for callee, _, _ in facts.calls:
+                    extra = summary.get(id(callee))
+                    if extra and not extra <= cur:
+                        cur |= extra
+                        changed = True
+        return summary
+
+    def lock_order_findings(self) -> List[LintFinding]:
+        summary = self._transitive_acquires()
+        kinds: Dict[LockId, str] = {}
+        # edge -> (fn, node) first site
+        edges: Dict[Tuple[LockId, LockId],
+                    Tuple[mg.FuncInfo, ast.AST]] = {}
+        self_deadlocks: List[Tuple[LockId, mg.FuncInfo, ast.AST]] = []
+        for facts in self.facts.values():
+            for lock, kind, held, node in facts.acquires:
+                kinds.setdefault(lock, kind)
+                for h in held:
+                    if h == lock:
+                        if kind == "lock":
+                            self_deadlocks.append((lock, facts.fn, node))
+                    else:
+                        edges.setdefault((h, lock), (facts.fn, node))
+            for callee, held, node in facts.calls:
+                if not held:
+                    continue
+                for lock in summary.get(id(callee), ()):
+                    for h in held:
+                        if h == lock:
+                            if kinds.get(lock, "lock") == "lock":
+                                self_deadlocks.append(
+                                    (lock, facts.fn, node))
+                        else:
+                            edges.setdefault((h, lock),
+                                             (facts.fn, node))
+        out: List[LintFinding] = []
+        for lock, fn, node in self_deadlocks:
+            out.append(_finding(
+                "lock-order", fn, node,
+                f"non-reentrant {_lock_name(lock)} re-acquired while "
+                f"already held — guaranteed self-deadlock (use an "
+                f"RLock or a *_locked helper)"))
+        graph: Dict[LockId, Set[LockId]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for cycle in _cycles(graph):
+            pretty = " -> ".join(_lock_name(l) for l in cycle) \
+                + f" -> {_lock_name(cycle[0])}"
+            sites = []
+            for i, a in enumerate(cycle):
+                b = cycle[(i + 1) % len(cycle)]
+                fn, node = edges[(a, b)]
+                sites.append(f"{mg.stable_path(fn.module.path)}:"
+                             f"{getattr(node, 'lineno', 0)}")
+            fn0, node0 = edges[(cycle[0], cycle[1 % len(cycle)])]
+            out.append(_finding(
+                "lock-order", fn0, node0,
+                f"lock-order cycle {pretty} (acquisition sites: "
+                f"{', '.join(sites)}) — threads taking the locks in "
+                f"opposite orders deadlock"))
+        return out
+
+    # -- thread-lifecycle ----------------------------------------------
+    def thread_lifecycle_findings(self) -> List[LintFinding]:
+        out: List[LintFinding] = []
+        for facts in self.facts.values():
+            fn = facts.fn
+            for node, daemon, binding in facts.threads:
+                if daemon:
+                    continue
+                if binding is not None and self._binding_managed(
+                        fn, binding):
+                    continue
+                where = f"bound to {binding[1]!r}" if binding \
+                    else "unbound"
+                out.append(_finding(
+                    "thread-lifecycle", fn, node,
+                    f"Thread ({where}) is neither daemon=True nor "
+                    f"joined with a timeout in its owning scope — a "
+                    f"non-daemon leaked thread blocks interpreter "
+                    f"exit"))
+        return out
+
+    def _binding_managed(self, fn: mg.FuncInfo,
+                         binding: Tuple[str, str]) -> bool:
+        kind, name = binding
+        if kind == "attr":
+            scope_nodes = [m.node for m in fn.cls.methods.values()] \
+                if fn.cls is not None else [fn.node]
+            return any(_attr_thread_managed(n, name)
+                       for n in scope_nodes)
+        # local: daemon/join in this function, or the local escapes
+        # (returned / stored on self / passed on) — then lifecycle is
+        # the receiver's problem, checked at ITS binding
+        node = fn.node
+        if _local_thread_managed(node, name):
+            return True
+        return _local_escapes(node, name)
+
+    # -- unbounded-wait ------------------------------------------------
+    def unbounded_wait_findings(self) -> List[LintFinding]:
+        out: List[LintFinding] = []
+        for facts in self.facts.values():
+            for node, skind, bounded, recv in facts.waits:
+                if bounded:
+                    continue
+                verb = "join()" if skind == "thread" else "wait()"
+                out.append(_finding(
+                    "unbounded-wait", facts.fn, node,
+                    f"unbounded {verb} on {recv} ({skind}) can wedge "
+                    f"this thread forever if the peer never signals; "
+                    f"pass a timeout and handle expiry"))
+        return out
+
+
+def _attr_thread_managed(scope_node: ast.AST, attr: str) -> bool:
+    for sub in ast.walk(scope_node):
+        # self.<attr>.daemon = True
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and isinstance(t.value, ast.Attribute) \
+                        and t.value.attr == attr \
+                        and isinstance(sub.value, ast.Constant) \
+                        and sub.value.value is True:
+                    return True
+        # self.<attr>.join(bounded) or  t = self.<attr>; t.join(bounded)
+        if isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                    ast.Attribute) \
+                and sub.func.attr == "join" and mg.call_is_bounded(sub):
+            chain = mg.attr_chain(sub.func)
+            if f".{attr}." in f".{chain}.":
+                return True
+        # for w in self.<attr>: w.join(bounded)
+        if isinstance(sub, (ast.For, ast.AsyncFor)) \
+                and isinstance(sub.iter, ast.Attribute) \
+                and sub.iter.attr == attr \
+                and isinstance(sub.target, ast.Name):
+            if _local_thread_managed(sub, sub.target.id):
+                return True
+        # t = self.<attr>  ...  t.join(bounded)
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                and isinstance(sub.targets[0], ast.Name) \
+                and isinstance(sub.value, ast.Attribute) \
+                and sub.value.attr == attr:
+            if _local_thread_managed(scope_node, sub.targets[0].id):
+                return True
+    return False
+
+
+def _local_thread_managed(scope_node: ast.AST, name: str) -> bool:
+    for sub in ast.walk(scope_node):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == name \
+                        and isinstance(sub.value, ast.Constant) \
+                        and sub.value.value is True:
+                    return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                    ast.Attribute) \
+                and sub.func.attr == "join" \
+                and isinstance(sub.func.value, ast.Name) \
+                and sub.func.value.id == name \
+                and mg.call_is_bounded(sub):
+            return True
+    return False
+
+
+def _local_escapes(fn_node: ast.AST, name: str) -> bool:
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Return) and sub.value is not None:
+            if any(isinstance(n, ast.Name) and n.id == name
+                   for n in ast.walk(sub.value)):
+                return True
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id == name:
+                    return True
+        if isinstance(sub, ast.Call):
+            fnx = sub.func
+            is_start = isinstance(fnx, ast.Attribute) \
+                and fnx.attr in ("start", "join", "is_alive") \
+                and isinstance(fnx.value, ast.Name) \
+                and fnx.value.id == name
+            args = list(sub.args) + [k.value for k in sub.keywords]
+            if not is_start and any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in args):
+                return True
+    return False
+
+
+def _cycles(graph: Dict[LockId, Set[LockId]]) -> List[List[LockId]]:
+    """Elementary cycles via SCC + one representative cycle per SCC
+    (Tarjan; a representative is enough — the finding lists the SCC)."""
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on: Set[LockId] = set()
+    stack: List[LockId] = []
+    sccs: List[List[LockId]] = []
+    counter = [0]
+
+    def strong(v: LockId):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in graph.get(v, ()):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(list(reversed(comp)))
+
+    for v in sorted(graph, key=_lock_sort):
+        if v not in index:
+            strong(v)
+    # one ACTUAL cycle per SCC: shortest path from a successor of the
+    # root back to the root, within the component (BFS — guarantees
+    # every consecutive edge, including the closing one, exists; a
+    # greedy walk can build a path whose wrap-around edge does not,
+    # e.g. two 2-cycles sharing a lock)
+    cycles: List[List[LockId]] = []
+    for comp in sccs:
+        comp_set = set(comp)
+        root = comp[0]
+        best: Optional[List[LockId]] = None
+        for start in sorted(graph.get(root, ()), key=_lock_sort):
+            if start not in comp_set:
+                continue
+            # BFS start -> root within the SCC (guaranteed to exist)
+            prev: Dict[LockId, Optional[LockId]] = {start: None}
+            queue = [start]
+            while queue and root not in prev:
+                v = queue.pop(0)
+                for w in sorted(graph.get(v, ()), key=_lock_sort):
+                    if w in comp_set and w not in prev:
+                        prev[w] = v
+                        queue.append(w)
+            if root not in prev:
+                continue
+            rev: List[LockId] = []   # [root, pred-of-root, ..., start]
+            v: Optional[LockId] = root
+            while v is not None:
+                rev.append(v)
+                v = prev[v]
+            # cycle node order: root -> start -> ... -> pred-of-root
+            path = [root] + rev[1:][::-1]
+            if best is None or len(path) < len(best):
+                best = path
+        if best is not None:
+            cycles.append(best)
+    return cycles
+
+
+def _finding(rule: str, fn: mg.FuncInfo, node: ast.AST,
+             message: str) -> LintFinding:
+    line = getattr(node, "lineno", 0)
+    lines = fn.module.source.splitlines()
+    snippet = lines[line - 1].strip() if 0 < line <= len(lines) else ""
+    return LintFinding(rule, fn.module.path, line,
+                       getattr(node, "col_offset", 0), message,
+                       snippet, symbol=fn.qualname)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def _run(pkg: mg.Package, parse_errors: List[LintFinding],
+         rules: Optional[Iterable[str]]) -> List[LintFinding]:
+    active = set(rules) if rules is not None else set(CONCURRENCY_RULES)
+    ana = _Analysis(pkg)
+    findings: List[LintFinding] = list(parse_errors)
+    if "guarded-field" in active:
+        findings.extend(ana.guarded_field_findings())
+    if "lock-order" in active:
+        findings.extend(ana.lock_order_findings())
+    if "thread-lifecycle" in active:
+        findings.extend(ana.thread_lifecycle_findings())
+    if "unbounded-wait" in active:
+        findings.extend(ana.unbounded_wait_findings())
+    # pragma suppression (shared `# ffcheck: ok(<rule>)` syntax)
+    out: List[LintFinding] = []
+    by_path = {m.path: m for m in pkg.modules.values()}
+    pragma_cache: Dict[str, Dict[int, Optional[Set[str]]]] = {}
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None:
+            if f.path not in pragma_cache:
+                pragma_cache[f.path] = _pragmas(mod.source)
+            if _suppressed(pragma_cache[f.path], f.rule, f.line):
+                continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  rules: Optional[Iterable[str]] = None
+                  ) -> List[LintFinding]:
+    """Run the concurrency engine over files/trees (``tests`` dirs and
+    ``test_*.py`` skipped, like the linter's walk)."""
+    pkg = mg.Package()
+    parse_errors: List[LintFinding] = []
+    for path in mg.iter_py_files(paths):
+        if pkg.add_file(path) is None:
+            parse_errors.append(LintFinding(
+                "parse-error", path, 0, 0, "file does not parse"))
+    return _run(pkg, parse_errors, rules)
+
+
+def analyze_sources(sources: Dict[str, str],
+                    rules: Optional[Iterable[str]] = None
+                    ) -> List[LintFinding]:
+    """Analyze in-memory ``{path: source}`` modules (tests; multi-module
+    snippets resolve cross-module exactly like on-disk trees)."""
+    pkg = mg.Package()
+    parse_errors: List[LintFinding] = []
+    for path, src in sources.items():
+        if pkg.add_source(path, src) is None:
+            parse_errors.append(LintFinding(
+                "parse-error", path, 0, 0, "file does not parse"))
+    return _run(pkg, parse_errors, rules)

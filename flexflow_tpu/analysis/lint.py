@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -109,6 +110,14 @@ _CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "process_time"}
 _PRAGMA_RE = re.compile(r"#\s*ffcheck:\s*ok(?:\(([^)]*)\))?")
 
 
+#: version of the machine-readable finding document emitted by
+#: :func:`render_json` (and mirrored at the ffcheck CLI top level).
+#: Schema 2 (ISSUE 14): adds ``schema``, per-finding ``id`` (stable
+#: across runs — rule + repo-relative path + owning symbol, NOT line
+#: numbers, so CI output stays diffable as code shifts) and ``symbol``.
+JSON_SCHEMA_VERSION = 2
+
+
 @dataclasses.dataclass
 class LintFinding:
     rule: str
@@ -117,13 +126,30 @@ class LintFinding:
     col: int
     message: str
     snippet: str = ""
+    #: owning symbol ("Class.method" / function name) — set by the
+    #: concurrency/spmd engines; the line-based linter leaves it empty
+    symbol: str = ""
 
     def format(self) -> str:
+        sym = f" ({self.symbol})" if self.symbol else ""
         return (f"{self.path}:{self.line}:{self.col}: "
-                f"[{self.rule}] {self.message}")
+                f"[{self.rule}] {self.message}{sym}")
 
-    def to_json(self) -> Dict[str, object]:
-        return dataclasses.asdict(self)
+    def stable_id(self, seq: int = 0) -> str:
+        """Stable per-finding ID: hash of (rule, repo-stable path,
+        symbol). ``seq`` disambiguates multiple findings of one rule on
+        one symbol (ordinal in report order — stable for a fixed
+        repo)."""
+        from ._modgraph import stable_path
+        digest = hashlib.sha1(
+            f"{self.rule}|{stable_path(self.path)}|{self.symbol}"
+            .encode()).hexdigest()[:12]
+        return digest if seq == 0 else f"{digest}-{seq}"
+
+    def to_json(self, seq: int = 0) -> Dict[str, object]:
+        doc = dataclasses.asdict(self)
+        doc["id"] = self.stable_id(seq)
+        return doc
 
 
 def _norm(path: str) -> str:
@@ -468,5 +494,12 @@ def render_text(findings: Sequence[LintFinding]) -> str:
 
 
 def render_json(findings: Sequence[LintFinding]) -> str:
-    return json.dumps({"findings": [f.to_json() for f in findings],
+    seen: Dict[Tuple[str, str, str], int] = {}
+    docs = []
+    for f in findings:
+        key = (f.rule, f.path, f.symbol)
+        seq = seen.get(key, 0)
+        seen[key] = seq + 1
+        docs.append(f.to_json(seq))
+    return json.dumps({"schema": JSON_SCHEMA_VERSION, "findings": docs,
                        "count": len(findings)}, indent=1)

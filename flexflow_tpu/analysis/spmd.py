@@ -1,0 +1,342 @@
+"""SPMD-divergence static checker (ffcheck v2).
+
+The multi-rank runtime's deadliest bug class is a *collective inside a
+rank-conditional branch*: rank 0 takes the ``if``, calls a barrier (or
+a blocking KV get, or a quorum publish), and the other ranks — who
+never entered the branch — never arrive. The process hangs until the
+coordinator's bounded-barrier timeout fires, and the root cause is a
+control-flow asymmetry nothing type-checks. PR 7's two-phase checkpoint
+commit navigates this by careful convention (rank-0-only blocks contain
+ONLY file I/O; every barrier sits outside them); this engine enforces
+the convention:
+
+  ``rank-gated-collective``
+      For every ``if`` whose test is *rank-dependent* — it calls
+      ``process_index()``, compares something named ``rank``, or reads
+      a ``*RANK*`` environment variable — the sets of collective
+      operations reachable from the two branches (transitively,
+      through statically-resolvable calls) must MATCH. A collective
+      reachable from only one branch is flagged at its call site with
+      the gating condition attributed. World-*size* tests
+      (``process_count() > 1``, ``world <= 1``) are uniform across
+      ranks and deliberately NOT rank-dependent.
+
+Collective/rendezvous primitives recognized (by call name, plus
+anything that transitively reaches one): ``wait_at_barrier``,
+``blocking_key_value_get``, ``barrier``, ``process_allgather``,
+``sync_global_devices``, ``broadcast_one_to_all``, ``clock_sync``.
+
+Default scope (CLI ``--spmd`` with no paths): the modules where
+rank-divergent control flow lives — ``resilience/``,
+``runtime/checkpoint.py``, ``parallel/distributed.py``. Explicit file
+arguments are always analyzed regardless of scope (fixtures, tests).
+
+Known limitation (documented, not silently ignored): divergence via
+early ``return``/``raise`` under a rank conditional followed by a
+collective in the fall-through is NOT modeled — only branch-local
+reachability is compared. Suppression: the shared
+``# ffcheck: ok(rank-gated-collective)`` pragma with a one-line
+justification.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import _modgraph as mg
+from .lint import LintFinding, _pragmas, _suppressed
+
+__all__ = ["SPMD_RULES", "SPMD_SCOPE", "COLLECTIVE_CALLS",
+           "analyze_paths", "analyze_sources"]
+
+SPMD_RULES: Dict[str, str] = {
+    "rank-gated-collective":
+        "collective reachable from only one side of a rank-conditional "
+        "branch (divergence deadlock)",
+}
+
+#: path scope the repo-wide walk restricts to (same component-anchored
+#: matching as the linter's module scopes)
+SPMD_SCOPE: Tuple[str, ...] = ("/resilience/", "runtime/checkpoint.py",
+                               "parallel/distributed.py")
+
+#: call names that ARE collective/rendezvous operations
+COLLECTIVE_CALLS: Set[str] = {
+    "wait_at_barrier", "blocking_key_value_get", "barrier",
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+    "clock_sync",
+}
+
+_RANK_WORD = re.compile(r"(?:^|_)rank(?:$|_)", re.IGNORECASE)
+
+
+def _in_scope(path: str) -> bool:
+    norm = "/" + mg.norm_path(path)
+    for m in SPMD_SCOPE:
+        if m.startswith("/"):
+            if m in norm:
+                return True
+        elif norm.endswith("/" + m):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# rank-dependence of an expression
+# ---------------------------------------------------------------------------
+
+def _ident_is_ranky(name: str) -> bool:
+    """``rank``, ``world_rank``, ``self.rank``'s attr — identifier
+    contains the word "rank" (underscore-delimited; ``ranked`` etc.
+    stay out)."""
+    return bool(_RANK_WORD.search(name))
+
+
+def _is_rank_dependent(test: ast.AST) -> Optional[str]:
+    """A human-readable description of why the test diverges per rank,
+    or None when it is uniform. ``process_count``/``world`` size tests
+    are uniform by construction."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            chain = mg.attr_chain(node.func) if isinstance(
+                node.func, ast.Attribute) else (
+                node.func.id if isinstance(node.func, ast.Name) else "")
+            last = chain.rsplit(".", 1)[-1]
+            if last == "process_index":
+                return f"{chain}()"
+            if last in ("getenv", "get") and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) \
+                        and isinstance(a0.value, str) \
+                        and "RANK" in a0.value.upper():
+                    return f"env {a0.value!r}"
+        elif isinstance(node, ast.Subscript):
+            base = mg.attr_chain(node.value)
+            if base.endswith("environ") \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str) \
+                    and "RANK" in node.slice.value.upper():
+                return f"env {node.slice.value!r}"
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            for s in sides:
+                name = None
+                if isinstance(s, ast.Name):
+                    name = s.id
+                elif isinstance(s, ast.Attribute):
+                    name = s.attr
+                if name is not None and _ident_is_ranky(name):
+                    return f"comparison on {name!r}"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# collective reachability
+# ---------------------------------------------------------------------------
+
+class _CollectiveIndex:
+    """Per-function summaries: collective ops a function performs,
+    directly or transitively through statically-resolvable calls."""
+
+    def __init__(self, pkg: mg.Package):
+        self.pkg = pkg
+        self.summary: Dict[int, Set[str]] = {}
+        self._locals: Dict[int, Dict[str, object]] = {}
+        for mod in pkg.modules.values():
+            for fi in mod.all_functions:
+                self.summary[id(fi)] = self._direct(fi)
+        changed = True
+        while changed:
+            changed = False
+            for mod in pkg.modules.values():
+                for fi in mod.all_functions:
+                    cur = self.summary[id(fi)]
+                    for call in self._calls(fi):
+                        callee = pkg.resolve_callee(
+                            fi, call, self._locals_of(fi))
+                        if callee is None:
+                            continue
+                        extra = self.summary.get(id(callee))
+                        if extra and not extra <= cur:
+                            cur |= extra
+                            changed = True
+
+    def _locals_of(self, fi: mg.FuncInfo) -> Dict[str, object]:
+        # parameter names shadow module globals during resolution
+        if id(fi) not in self._locals:
+            args = fi.node.args
+            names = [a.arg for a in
+                     list(args.posonlyargs) + list(args.args)
+                     + list(args.kwonlyargs)]
+            env: Dict[str, object] = {n: None for n in names}
+            if fi.cls is not None and "self" in env:
+                env["self"] = ("instance", fi.cls)
+            self._locals[id(fi)] = env
+        return self._locals[id(fi)]
+
+    @staticmethod
+    def _calls(fi: mg.FuncInfo) -> List[ast.Call]:
+        out = []
+        stack = list(ast.iter_child_nodes(fi.node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs are their own FuncInfo
+            if isinstance(n, ast.Call):
+                out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def _direct(self, fi: mg.FuncInfo) -> Set[str]:
+        out: Set[str] = set()
+        for call in self._calls(fi):
+            name = _call_name(call)
+            if name in COLLECTIVE_CALLS:
+                out.add(name)
+        return out
+
+    # -- per-statement reachability ------------------------------------
+    def reachable(self, fi: mg.FuncInfo, stmts: Sequence[ast.stmt]
+                  ) -> Dict[str, ast.AST]:
+        """Collective op name -> first contributing node among
+        ``stmts`` (direct call site, or the call whose callee reaches
+        it)."""
+        out: Dict[str, ast.AST] = {}
+        for st in stmts:
+            stack = [st]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(n, ast.Call):
+                    name = _call_name(n)
+                    if name in COLLECTIVE_CALLS:
+                        out.setdefault(name, n)
+                    callee = self.pkg.resolve_callee(
+                        fi, n, self._locals_of(fi))
+                    if callee is not None:
+                        for op in self.summary.get(id(callee), ()):
+                            out.setdefault(op, n)
+                stack.extend(ast.iter_child_nodes(n))
+        return out
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _check_function(pkg: mg.Package, index: _CollectiveIndex,
+                    fi: mg.FuncInfo,
+                    findings: List[LintFinding]) -> None:
+    lines = fi.module.source.splitlines()
+
+    def add(node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        snippet = lines[line - 1].strip() \
+            if 0 < line <= len(lines) else ""
+        findings.append(LintFinding(
+            "rank-gated-collective", fi.module.path, line,
+            getattr(node, "col_offset", 0), message, snippet,
+            symbol=fi.qualname))
+
+    stack = list(fi.node.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(n, ast.If):
+            why = _is_rank_dependent(n.test)
+            if why is not None:
+                body = index.reachable(fi, n.body)
+                other = index.reachable(fi, n.orelse)
+                for op, site in sorted(body.items()):
+                    if op not in other:
+                        add(site,
+                            f"collective {op!r} reachable only when "
+                            f"the rank-conditional ({why}) holds — "
+                            f"ranks not taking this branch never "
+                            f"arrive; hoist it out or add the "
+                            f"matching call on the other path")
+                for op, site in sorted(other.items()):
+                    if op not in body:
+                        add(site,
+                            f"collective {op!r} reachable only when "
+                            f"the rank-conditional ({why}) does NOT "
+                            f"hold — ranks taking the branch never "
+                            f"arrive; hoist it out or add the "
+                            f"matching call on the other path")
+        for child in ast.iter_child_nodes(n):
+            stack.append(child)
+
+
+def _run(pkg: mg.Package, parse_errors: List[LintFinding],
+         rules: Optional[Iterable[str]]) -> List[LintFinding]:
+    active = set(rules) if rules is not None else set(SPMD_RULES)
+    findings: List[LintFinding] = list(parse_errors)
+    if "rank-gated-collective" in active:
+        index = _CollectiveIndex(pkg)
+        for mod in pkg.modules.values():
+            if not mod.__dict__.get("_spmd_check", True):
+                continue
+            for fi in mod.all_functions:
+                _check_function(pkg, index, fi, findings)
+    out: List[LintFinding] = []
+    by_path = {m.path: m for m in pkg.modules.values()}
+    pragma_cache: Dict[str, Dict[int, Optional[Set[str]]]] = {}
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None:
+            if f.path not in pragma_cache:
+                pragma_cache[f.path] = _pragmas(mod.source)
+            if _suppressed(pragma_cache[f.path], f.rule, f.line):
+                continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col))
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  rules: Optional[Iterable[str]] = None
+                  ) -> List[LintFinding]:
+    """Run the SPMD checker. Directory trees are restricted to
+    :data:`SPMD_SCOPE`, but every module in ``paths`` still loads into
+    the call-graph (a collective reached THROUGH an out-of-scope helper
+    is attributed at the in-scope call site); explicitly-named files
+    are checked regardless of scope."""
+    pkg = mg.Package()
+    parse_errors: List[LintFinding] = []
+    explicit = {mg.norm_path(p) for p in paths}
+    for path in mg.iter_py_files(paths):
+        mod = pkg.add_file(path)
+        if mod is None:
+            parse_errors.append(LintFinding(
+                "parse-error", path, 0, 0, "file does not parse"))
+            continue
+        mod.__dict__["_spmd_check"] = (
+            mg.norm_path(path) in explicit or _in_scope(path))
+    return _run(pkg, parse_errors, rules)
+
+
+def analyze_sources(sources: Dict[str, str],
+                    rules: Optional[Iterable[str]] = None
+                    ) -> List[LintFinding]:
+    """Analyze in-memory ``{path: source}`` modules (all checked —
+    tests name their scope explicitly)."""
+    pkg = mg.Package()
+    parse_errors: List[LintFinding] = []
+    for path, src in sources.items():
+        if pkg.add_source(path, src) is None:
+            parse_errors.append(LintFinding(
+                "parse-error", path, 0, 0, "file does not parse"))
+    return _run(pkg, parse_errors, rules)

@@ -53,8 +53,10 @@ def ensure_built(force: bool = False) -> bool:
 def get_lib() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None if unavailable."""
     global _lib
-    if _lib is not None:
-        return _lib
+    # benign: double-checked locking — the unlocked read is an atomic
+    # reference load; _lock orders the one-time build+publish below
+    if _lib is not None:  # ffcheck: ok(guarded-field)
+        return _lib  # ffcheck: ok(guarded-field)
     with _lock:
         if _lib is not None:
             return _lib

@@ -305,7 +305,10 @@ class ReshardStats:
         self.reset()
 
     def reset(self):
-        with getattr(self, "lock", threading.Lock()):
+        # __init__ assigns self.lock before calling reset(), so the
+        # lock always exists here — hold it so a concurrent record()
+        # never interleaves with a test's reset
+        with self.lock:
             self.planned = 0
             self.plan_cache_hits = 0
             self.executed_searched = 0

@@ -466,7 +466,9 @@ class BatchScheduler:
         Raises :class:`QueueFullError` / :class:`CircuitOpenError` /
         :class:`DrainingError` for the shedding cases (HTTP 503) and
         :class:`InvalidInputError` for malformed inputs (HTTP 400)."""
-        if self._draining:
+        with self._stat_lock:
+            draining = self._draining
+        if draining:
             self.metrics.record_rejected()
             raise DrainingError(
                 f"model {self.metrics.name!r} is draining for shutdown",
@@ -549,8 +551,12 @@ class BatchScheduler:
         of ``GET /v2/metrics`` and the ``/healthz`` serving block)."""
         s = self.metrics.snapshot(self._q.qsize())
         s["instances"] = self.num_instances
-        s["circuit"] = self.breaker.state
-        s["draining"] = self._draining
+        # benign: atomic read of the state string for a health probe —
+        # /healthz must stay cheap (PR 5) and a probe racing a breaker
+        # transition just reports the old state for one scrape
+        s["circuit"] = self.breaker.state  # ffcheck: ok(guarded-field)
+        with self._stat_lock:
+            s["draining"] = self._draining
         return s
 
     def drain(self, deadline_s: float = 10.0) -> bool:
@@ -558,7 +564,12 @@ class BatchScheduler:
         :class:`DrainingError` -> HTTP 503 + ``Retry-After``), finish
         everything queued or executing within ``deadline_s``, then
         close. Returns True when nothing was left behind."""
-        self._draining = True
+        # under the stat lock: the admission read in infer() must see
+        # either pre-drain or drain, never a torn intermediate with the
+        # backlog counters (the drain-vs-unload snapshot race PR 5's
+        # review found by hand is exactly this class)
+        with self._stat_lock:
+            self._draining = True
         end = time.perf_counter() + max(0.0, deadline_s)
         while time.perf_counter() < end:
             with self._stat_lock:

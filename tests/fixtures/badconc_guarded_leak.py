@@ -1,0 +1,21 @@
+"""Known-bad fixture (ISSUE 14): guarded-field leak.
+
+``_count`` is written under ``self._lock`` in ``bump()`` — that makes
+it a guarded field — but ``peek()`` reads it with no lock held. The
+concurrency engine must flag the read with rule ``guarded-field``
+attributed to ``Tally.peek``. (Do not "fix": tests pin the rejection.)
+"""
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def peek(self):
+        return self._count  # BAD: guarded read outside the lock

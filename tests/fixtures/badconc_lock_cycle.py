@@ -1,0 +1,24 @@
+"""Known-bad fixture (ISSUE 14): lock-order inversion.
+
+``left()`` acquires ``_audit_lock`` then ``_table_lock``; ``right()``
+acquires them in the opposite order. Two threads running one each can
+deadlock. The concurrency engine must report one ``lock-order`` cycle
+naming both locks and both acquisition sites. (Do not "fix": tests pin
+the rejection.)
+"""
+import threading
+
+_audit_lock = threading.Lock()
+_table_lock = threading.Lock()
+
+
+def left():
+    with _audit_lock:
+        with _table_lock:  # BAD: A -> B
+            return 1
+
+
+def right():
+    with _table_lock:
+        with _audit_lock:  # BAD: B -> A closes the cycle
+            return 2

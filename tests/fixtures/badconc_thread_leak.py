@@ -1,0 +1,17 @@
+"""Known-bad fixture (ISSUE 14): unmanaged thread lifecycle.
+
+``Pump`` starts a non-daemon thread it never joins: interpreter exit
+blocks on it, and an unload leaks it. The concurrency engine must flag
+the construction with rule ``thread-lifecycle`` attributed to
+``Pump.__init__``. (Do not "fix": tests pin the rejection.)
+"""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)  # BAD: not daemon
+        self._t.start()
+
+    def _run(self):
+        pass

@@ -1,0 +1,20 @@
+"""Known-bad fixture (ISSUE 14): unbounded wait on a typed Event.
+
+``Gate.block`` waits on ``self._ready`` — typed as ``threading.Event``
+by its construction site — with no timeout: if the signaling thread
+dies first, this thread wedges forever. The concurrency engine must
+flag the wait with rule ``unbounded-wait`` attributed to ``Gate.block``.
+(Do not "fix": tests pin the rejection.)
+"""
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._ready = threading.Event()
+
+    def open(self):
+        self._ready.set()
+
+    def block(self):
+        self._ready.wait()  # BAD: no timeout

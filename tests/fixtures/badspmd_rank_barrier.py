@@ -1,0 +1,24 @@
+"""Known-bad fixture (ISSUE 14): rank-gated collective.
+
+Only rank 0 reaches the commit barrier — every other rank never
+arrives and the world wedges until the bounded-barrier timeout fires.
+The SPMD checker must flag the ``barrier`` call with rule
+``rank-gated-collective`` attributed to ``commit``, naming the
+``process_index()`` gate. (Do not "fix": tests pin the rejection.)
+"""
+import jax
+
+
+def commit(coord, step):
+    stage(coord, step)
+    if jax.process_index() == 0:
+        publish_manifest(step)
+        coord.barrier(f"commit-{step}")  # BAD: rank-0-only rendezvous
+
+
+def stage(coord, step):
+    coord.barrier(f"stage-{step}")  # fine: every rank arrives
+
+
+def publish_manifest(step):
+    return step

@@ -185,6 +185,9 @@ def test_reporters():
     doc = json.loads(render_json(out))
     assert doc["count"] == 1
     assert doc["findings"][0]["rule"] == "bare-assert"
+    # schema 2 (ISSUE 14): version field + stable per-finding ID
+    assert doc["schema"] == 2
+    assert len(doc["findings"][0]["id"]) == 12
     assert render_text([]) == "ffcheck: clean"
 
 

@@ -1,0 +1,667 @@
+"""ffcheck v2: lock-discipline + SPMD-divergence engines (ISSUE 14).
+
+Covers: every new rule fires on a minimal bad snippet and is silenced
+by the shared ``# ffcheck: ok(<rule>)`` pragma; the inference
+boundaries hold (``__init__`` exempt, ``*_locked`` convention,
+cross-object and module-global scopes, container mutators count as
+writes, untyped receivers stay with the linter); the full repo passes
+both engines clean post-fixes; every rejection fixture is pinned to its
+exact rule and symbol attribution; and the CLI round-trips exit codes,
+the schema-2 JSON document, stable finding IDs, and the wall-time
+budget gate.
+"""
+import json
+import os
+import subprocess
+import sys
+
+from flexflow_tpu.analysis.concurrency import (analyze_paths as conc_paths,
+                                               analyze_sources as conc_src)
+from flexflow_tpu.analysis.lint import render_json
+from flexflow_tpu.analysis.spmd import (analyze_paths as spmd_paths,
+                                        analyze_sources as spmd_src)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "flexflow_tpu")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+FFCHECK = os.path.join(REPO, "tools", "ffcheck.py")
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def _conc1(src, path="flexflow_tpu/mod.py", rules=None):
+    return conc_src({path: src}, rules=rules)
+
+
+def _spmd1(src, path="flexflow_tpu/resilience/mod.py", rules=None):
+    return spmd_src({path: src}, rules=rules)
+
+
+# ===========================================================================
+# guarded-field
+# ===========================================================================
+
+GUARDED = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n
+"""
+
+
+def test_guarded_field_fires_and_pragma_suppresses():
+    out = _conc1(GUARDED)
+    assert [(f.rule, f.symbol) for f in out] == [("guarded-field",
+                                                  "C.peek")]
+    assert "C._n" in out[0].message and "_lock" in out[0].message
+    ok = GUARDED.replace(
+        "return self._n",
+        "return self._n  # ffcheck: ok(guarded-field)")
+    assert _conc1(ok) == []
+
+
+def test_guarded_field_init_exempt_and_unguarded_quiet():
+    # the __init__ assignment is construction (happens-before publish)
+    assert not any(f.symbol == "C.__init__" for f in _conc1(GUARDED))
+    # a field never written under a lock is not guarded at all
+    src = ("class C:\n"
+           "    def __init__(self):\n"
+           "        self._x = 0\n"
+           "    def a(self):\n"
+           "        self._x += 1\n"
+           "    def b(self):\n"
+           "        return self._x\n")
+    assert _conc1(src) == []
+
+
+def test_guarded_field_module_globals():
+    """The obs/events.py shape: a module global written under the
+    module lock is guarded; unlocked reads elsewhere fire; the
+    top-level (import-time) write is exempt."""
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "_count = 0\n"
+           "def bump():\n"
+           "    global _count\n"
+           "    with _lock:\n"
+           "        _count += 1\n"
+           "def peek():\n"
+           "    return _count\n")
+    out = _conc1(src)
+    assert [(f.rule, f.symbol) for f in out] == [("guarded-field",
+                                                  "peek")]
+
+
+def test_guarded_field_cross_object():
+    """The serving/scheduler.py shape: self.breaker.state resolves to
+    CircuitBreaker's discipline through the same-module instance
+    attribute."""
+    src = ("import threading\n"
+           "class Breaker:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.state = 'closed'\n"
+           "    def trip(self):\n"
+           "        with self._lock:\n"
+           "            self.state = 'open'\n"
+           "class Sched:\n"
+           "    def __init__(self):\n"
+           "        self.breaker = Breaker()\n"
+           "    def stats(self):\n"
+           "        return self.breaker.state\n"
+           "    def stats_locked_properly(self):\n"
+           "        with self.breaker._lock:\n"
+           "            return self.breaker.state\n")
+    out = _conc1(src)
+    assert [(f.rule, f.symbol) for f in out] == [("guarded-field",
+                                                  "Sched.stats")]
+
+
+def test_guarded_field_locked_suffix_convention():
+    """A ``*_locked`` helper is assumed to run with its scope's locks
+    held (the events._reset_locked convention)."""
+    src = GUARDED + ("\n"
+                     "    def _reset_locked(self):\n"
+                     "        self._n = 0\n")
+    out = _conc1(src)
+    assert not any(f.symbol == "C._reset_locked" for f in out)
+
+
+def test_guarded_field_container_mutator_is_write():
+    """.append() under the lock guards the ring; an unlocked .clear()
+    elsewhere is a write finding (the AST shows no assignment)."""
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "_ring = []\n"
+           "def push(x):\n"
+           "    with _lock:\n"
+           "        _ring.append(x)\n"
+           "def wipe():\n"
+           "    _ring.clear()\n")
+    out = _conc1(src)
+    assert [(f.rule, f.symbol) for f in out] == [("guarded-field",
+                                                  "wipe")]
+    assert "written" in out[0].message
+
+
+# ===========================================================================
+# lock-order
+# ===========================================================================
+
+def test_lock_order_cycle_fires_and_pragma_suppresses():
+    src = ("import threading\n"
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def f():\n"
+           "    with _a:\n"
+           "        with _b:\n"
+           "            pass\n"
+           "def g():\n"
+           "    with _b:\n"
+           "        with _a:\n"
+           "            pass\n")
+    out = _conc1(src)
+    assert _rules(out) == ["lock-order"]
+    assert "_a" in out[0].message and "_b" in out[0].message \
+        and "cycle" in out[0].message
+    ok = src.replace("        with _b:\n            pass\n",
+                     "        with _b:  # ffcheck: ok(lock-order)\n"
+                     "            pass\n")
+    assert _conc1(ok) == []
+
+
+def test_lock_order_consistent_order_clean():
+    src = ("import threading\n"
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def f():\n"
+           "    with _a:\n"
+           "        with _b:\n"
+           "            pass\n"
+           "def g():\n"
+           "    with _a:\n"
+           "        with _b:\n"
+           "            pass\n")
+    assert _conc1(src) == []
+
+
+def test_lock_order_cross_module_cycle():
+    """The graph accumulates edges across modules: module a holds its
+    lock and calls into b (which acquires b's lock) and vice versa."""
+    moda = ("import threading\n"
+            "from flexflow_tpu import modb\n"
+            "_la = threading.Lock()\n"
+            "def fa():\n"
+            "    with _la:\n"
+            "        modb.fb_inner()\n"
+            "def fa_inner():\n"
+            "    with _la:\n"
+            "        pass\n")
+    modb = ("import threading\n"
+            "from flexflow_tpu import moda\n"
+            "_lb = threading.Lock()\n"
+            "def fb():\n"
+            "    with _lb:\n"
+            "        moda.fa_inner()\n"
+            "def fb_inner():\n"
+            "    with _lb:\n"
+            "        pass\n")
+    out = conc_src({"flexflow_tpu/moda.py": moda,
+                    "flexflow_tpu/modb.py": modb})
+    assert _rules(out) == ["lock-order"]
+    assert "_la" in out[0].message and "_lb" in out[0].message
+
+
+def test_lock_order_self_deadlock_plain_lock_only():
+    bad = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            with self._lock:\n"
+           "                pass\n")
+    out = _conc1(bad)
+    assert _rules(out) == ["lock-order"]
+    assert "self-deadlock" in out[0].message
+    # an RLock is reentrant — same shape, no finding
+    assert _conc1(bad.replace("threading.Lock()",
+                              "threading.RLock()")) == []
+
+
+def test_lock_order_overlapping_cycles_no_crash():
+    """Two 2-cycles sharing a lock (A<->B, B<->C) form one SCC whose
+    greedy representative path used to hit a missing wrap-around edge
+    and crash; the BFS reconstruction must report a real cycle."""
+    src = ("import threading\n"
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "_c = threading.Lock()\n"
+           "def ab():\n"
+           "    with _a:\n"
+           "        with _b:\n"
+           "            pass\n"
+           "def ba():\n"
+           "    with _b:\n"
+           "        with _a:\n"
+           "            pass\n"
+           "def bc():\n"
+           "    with _b:\n"
+           "        with _c:\n"
+           "            pass\n"
+           "def cb():\n"
+           "    with _c:\n"
+           "        with _b:\n"
+           "            pass\n")
+    out = _conc1(src)
+    assert _rules(out) == ["lock-order"]
+    assert "cycle" in out[0].message
+
+
+def test_package_init_relative_imports_resolve():
+    """`from . import x` inside a package __init__ resolves against the
+    package itself (not its parent), so state poked through the alias
+    joins the submodule's lock discipline — in both directions."""
+    init = ("import threading\n"
+            "from . import ev\n"
+            "def set_locked():\n"
+            "    with ev._lock:\n"
+            "        ev._n = 1\n"
+            "def poke():\n"
+            "    ev._n = 2\n")
+    ev = ("import threading\n"
+          "_lock = threading.Lock()\n"
+          "_n = 0\n"
+          "def peek():\n"
+          "    return _n\n")
+    out = conc_src({"flexflow_tpu/obs/__init__.py": init,
+                    "flexflow_tpu/obs/ev.py": ev})
+    assert sorted((f.rule, f.symbol) for f in out) \
+        == [("guarded-field", "peek"), ("guarded-field", "poke")]
+
+
+def test_thread_escaping_via_kwarg_not_flagged():
+    """A thread handed off through a keyword argument escapes — its
+    lifecycle is the receiver's responsibility, not a leak here."""
+    src = ("import threading\n"
+           "def f(reg):\n"
+           "    t = threading.Thread(target=print)\n"
+           "    reg.register(worker=t)\n")
+    assert _conc1(src) == []
+
+
+def test_lock_order_self_deadlock_through_call():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            self.g()\n"
+           "    def g(self):\n"
+           "        with self._lock:\n"
+           "            pass\n")
+    out = _conc1(src)
+    assert _rules(out) == ["lock-order"]
+
+
+# ===========================================================================
+# thread-lifecycle
+# ===========================================================================
+
+def test_thread_lifecycle_fires_and_daemon_ok():
+    bad = ("import threading\n"
+           "class P:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._run)\n"
+           "        self._t.start()\n"
+           "    def _run(self):\n"
+           "        pass\n")
+    out = _conc1(bad)
+    assert [(f.rule, f.symbol) for f in out] == [("thread-lifecycle",
+                                                  "P.__init__")]
+    ok = bad.replace("threading.Thread(target=self._run)",
+                     "threading.Thread(target=self._run, daemon=True)")
+    assert _conc1(ok) == []
+
+
+def test_thread_lifecycle_bounded_join_ok():
+    src = ("import threading\n"
+           "class P:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._run)\n"
+           "        self._t.start()\n"
+           "    def stop(self):\n"
+           "        self._t.join(timeout=5)\n"
+           "    def _run(self):\n"
+           "        pass\n")
+    assert _conc1(src) == []
+    # the scheduler's worker-pool shape: list comprehension + for-join
+    pool = ("import threading\n"
+            "class Pool:\n"
+            "    def __init__(self, n):\n"
+            "        self._ws = [threading.Thread(target=self._run)\n"
+            "                    for _ in range(n)]\n"
+            "    def close(self):\n"
+            "        for w in self._ws:\n"
+            "            w.join(timeout=5)\n"
+            "    def _run(self):\n"
+            "        pass\n")
+    assert _conc1(pool) == []
+
+
+def test_thread_lifecycle_unbounded_join_still_fires():
+    src = ("import threading\n"
+           "class P:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._run)\n"
+           "    def stop(self):\n"
+           "        self._t.join()\n"
+           "    def _run(self):\n"
+           "        pass\n")
+    rules = _rules(_conc1(src))
+    # the unbounded join does not count as lifecycle management AND is
+    # itself an unbounded-wait finding
+    assert "thread-lifecycle" in rules and "unbounded-wait" in rules
+
+
+# ===========================================================================
+# unbounded-wait
+# ===========================================================================
+
+def test_unbounded_wait_fires_and_bounded_ok():
+    bad = ("import threading\n"
+           "class G:\n"
+           "    def __init__(self):\n"
+           "        self._ev = threading.Event()\n"
+           "    def block(self):\n"
+           "        self._ev.wait()\n")
+    out = _conc1(bad)
+    assert [(f.rule, f.symbol) for f in out] == [("unbounded-wait",
+                                                  "G.block")]
+    assert _conc1(bad.replace("self._ev.wait()",
+                              "self._ev.wait(5.0)")) == []
+    assert _conc1(bad.replace("self._ev.wait()",
+                              "self._ev.wait(timeout=5.0)")) == []
+
+
+def test_unbounded_wait_condition_and_local_alias():
+    src = ("import threading\n"
+           "class G:\n"
+           "    def __init__(self):\n"
+           "        self._cv = threading.Condition()\n"
+           "    def block(self):\n"
+           "        cv = self._cv\n"
+           "        with cv:\n"
+           "            cv.wait()\n")
+    out = _conc1(src)
+    assert _rules(out) == ["unbounded-wait"]
+
+
+def test_unbounded_wait_untyped_receiver_is_linters_problem():
+    """An untyped .wait() receiver stays with lint's raw-wait rule —
+    the typed engine must not guess."""
+    src = ("def block(ev):\n"
+           "    ev.wait()\n")
+    assert _conc1(src) == []
+
+
+def test_parse_error_reported():
+    out = _conc1("def f(:\n")
+    assert _rules(out) == ["parse-error"]
+    assert _rules(_spmd1("def f(:\n")) == ["parse-error"]
+
+
+# ===========================================================================
+# spmd: rank-gated collectives
+# ===========================================================================
+
+def test_rank_gated_barrier_fires_and_pragma_suppresses():
+    src = ("import jax\n"
+           "def commit(coord):\n"
+           "    if jax.process_index() == 0:\n"
+           "        coord.barrier('commit')\n")
+    out = _spmd1(src)
+    assert [(f.rule, f.symbol) for f in out] \
+        == [("rank-gated-collective", "commit")]
+    assert "process_index" in out[0].message
+    ok = src.replace(
+        "coord.barrier('commit')",
+        "coord.barrier('commit')  # ffcheck: ok(rank-gated-collective)")
+    assert _spmd1(ok) == []
+
+
+def test_rank_balanced_branches_clean():
+    src = ("def commit(coord, rank):\n"
+           "    if rank == 0:\n"
+           "        publish()\n"
+           "        coord.barrier('commit')\n"
+           "    else:\n"
+           "        coord.barrier('commit')\n"
+           "def publish():\n"
+           "    pass\n")
+    assert _spmd1(src) == []
+
+
+def test_collective_outside_conditional_clean():
+    """The PR 7 two-phase-commit shape: rank-0-only blocks hold file
+    I/O only; the barrier sits outside — clean."""
+    src = ("def commit(coord, rank):\n"
+           "    if rank == 0:\n"
+           "        write_manifest()\n"
+           "    coord.barrier('commit')\n"
+           "def write_manifest():\n"
+           "    pass\n")
+    assert _spmd1(src) == []
+
+
+def test_world_size_tests_are_uniform():
+    src = ("import jax\n"
+           "def maybe(coord, world):\n"
+           "    if jax.process_count() > 1:\n"
+           "        coord.barrier('x')\n"
+           "    if world <= 1:\n"
+           "        return\n")
+    assert _spmd1(src) == []
+
+
+def test_env_rank_gate_fires():
+    src = ("import os\n"
+           "def f(coord):\n"
+           "    if os.environ.get('FF_RANK') == '0':\n"
+           "        coord.wait_at_barrier('x', 1000)\n")
+    out = _spmd1(src)
+    assert _rules(out) == ["rank-gated-collective"]
+    assert "FF_RANK" in out[0].message
+
+
+def test_transitive_collective_through_callee():
+    src = ("def save(coord, rank):\n"
+           "    if rank == 0:\n"
+           "        finish(coord)\n"
+           "def finish(coord):\n"
+           "    coord.barrier('x')\n")
+    out = _spmd1(src)
+    assert _rules(out) == ["rank-gated-collective"]
+    # attributed at the gated CALL SITE, not inside the callee
+    assert out[0].symbol == "save"
+
+
+def test_else_only_collective_fires():
+    src = ("def f(coord, rank):\n"
+           "    if rank == 0:\n"
+           "        pass\n"
+           "    else:\n"
+           "        coord.barrier('x')\n")
+    out = _spmd1(src)
+    assert _rules(out) == ["rank-gated-collective"]
+    assert "does NOT hold" in out[0].message
+
+
+# ===========================================================================
+# fixtures: each new rule rejection-pinned
+# ===========================================================================
+
+def test_fixture_guarded_leak_pinned():
+    out = conc_paths([os.path.join(FIXTURES,
+                                   "badconc_guarded_leak.py")])
+    assert [(f.rule, f.symbol) for f in out] == [("guarded-field",
+                                                  "Tally.peek")]
+
+
+def test_fixture_lock_cycle_pinned():
+    out = conc_paths([os.path.join(FIXTURES, "badconc_lock_cycle.py")])
+    assert _rules(out) == ["lock-order"]
+    assert "_audit_lock" in out[0].message \
+        and "_table_lock" in out[0].message
+
+
+def test_fixture_thread_leak_pinned():
+    out = conc_paths([os.path.join(FIXTURES, "badconc_thread_leak.py")])
+    assert [(f.rule, f.symbol) for f in out] == [("thread-lifecycle",
+                                                  "Pump.__init__")]
+
+
+def test_fixture_unbounded_wait_pinned():
+    out = conc_paths([os.path.join(FIXTURES,
+                                   "badconc_unbounded_wait.py")])
+    assert [(f.rule, f.symbol) for f in out] == [("unbounded-wait",
+                                                  "Gate.block")]
+
+
+def test_fixture_rank_barrier_pinned():
+    out = spmd_paths([os.path.join(FIXTURES, "badspmd_rank_barrier.py")])
+    assert [(f.rule, f.symbol) for f in out] \
+        == [("rank-gated-collective", "commit")]
+    assert "process_index" in out[0].message
+
+
+# ===========================================================================
+# THE gates: the full repo passes both engines clean post-fixes
+# ===========================================================================
+
+def test_full_repo_concurrency_clean():
+    findings = conc_paths([PKG])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_full_repo_spmd_clean():
+    findings = spmd_paths([PKG])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# ===========================================================================
+# JSON schema 2 + stable IDs
+# ===========================================================================
+
+def test_json_schema2_roundtrip_and_ids():
+    out = conc_paths([os.path.join(FIXTURES,
+                                   "badconc_guarded_leak.py")])
+    doc = json.loads(render_json(out))
+    assert doc["schema"] == 2 and doc["count"] == 1
+    f0 = doc["findings"][0]
+    assert f0["rule"] == "guarded-field" \
+        and f0["symbol"] == "Tally.peek"
+    assert len(f0["id"]) == 12
+
+
+def test_finding_ids_stable_across_line_shifts():
+    """IDs hash (rule, repo-stable path, symbol) — NOT line numbers —
+    so a finding keeps its identity as unrelated code shifts."""
+    src = GUARDED
+    shifted = "# a new comment line\n" + GUARDED
+    a = _conc1(src)[0]
+    b = _conc1(shifted)[0]
+    assert a.line != b.line
+    assert a.stable_id() == b.stable_id()
+    # and absolute-vs-relative path spellings agree
+    c = conc_src({"/somewhere/else/flexflow_tpu/mod.py": src})[0]
+    assert c.stable_id() == a.stable_id()
+
+
+def test_duplicate_findings_get_ordinal_ids():
+    src = GUARDED + ("\n"
+                     "    def peek2(self):\n"
+                     "        a = self._n\n"
+                     "        b = self._n + a\n"
+                     "        return b\n")
+    out = _conc1(src)
+    doc = json.loads(render_json(out))
+    ids = [f["id"] for f in doc["findings"]]
+    assert len(ids) == len(set(ids)) == 3
+    dup = [i for i in ids if "-" in i]
+    assert len(dup) == 1 and dup[0].endswith("-1")
+
+
+# ===========================================================================
+# CLI: exit codes, JSON document, budget gate
+# ===========================================================================
+
+def _run_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, FFCHECK, *argv],
+                          capture_output=True, text=True, env=env)
+
+
+def test_cli_concurrency_and_spmd_exit_codes(tmp_path):
+    r = _run_cli("--concurrency",
+                 os.path.join(FIXTURES, "badconc_guarded_leak.py"))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "guarded-field" in r.stdout and "Tally.peek" in r.stdout
+    r = _run_cli("--spmd",
+                 os.path.join(FIXTURES, "badspmd_rank_barrier.py"))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "rank-gated-collective" in r.stdout
+    good = tmp_path / "flexflow_tpu" / "good.py"
+    good.parent.mkdir()
+    good.write_text("def f(x):\n    return x\n")
+    r = _run_cli("--lint", str(good), "--concurrency", str(good),
+                 "--spmd", str(good))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_json_document_schema2(tmp_path):
+    r = _run_cli("--concurrency",
+                 os.path.join(FIXTURES, "badconc_lock_cycle.py"),
+                 "--spmd",
+                 os.path.join(FIXTURES, "badspmd_rank_barrier.py"),
+                 "--json")
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["schema"] == 2 and doc["ok"] is False
+    assert doc["concurrency"]["count"] == 1
+    assert doc["spmd"]["count"] == 1
+    assert "analysis_s" in doc
+    # IDs are stable across runs: same fixture, same document
+    r2 = _run_cli("--concurrency",
+                  os.path.join(FIXTURES, "badconc_lock_cycle.py"),
+                  "--spmd",
+                  os.path.join(FIXTURES, "badspmd_rank_barrier.py"),
+                  "--json")
+    doc2 = json.loads(r2.stdout)
+    assert [f["id"] for f in doc["concurrency"]["findings"]] \
+        == [f["id"] for f in doc2["concurrency"]["findings"]]
+
+
+def test_cli_budget_gate(tmp_path):
+    good = tmp_path / "flexflow_tpu" / "good.py"
+    good.parent.mkdir()
+    good.write_text("def f(x):\n    return x\n")
+    r = _run_cli("--concurrency", str(good), "--budget-s", "60")
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_cli("--concurrency", str(good), "--budget-s", "0.0000001")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "budget" in r.stderr

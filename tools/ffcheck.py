@@ -1,14 +1,25 @@
 #!/usr/bin/env python
-"""ffcheck: static plan verifier + framework-invariant linter CLI.
+"""ffcheck: static plan verifier + invariant/concurrency/SPMD linters.
 
 The command-line front end of ``flexflow_tpu.analysis`` (see
 ``docs/static_analysis.md``), run by ``ci.sh``'s fast tier as a hard
 gate:
 
-    python tools/ffcheck.py --lint flexflow_tpu/ --verify-strategies
+    python tools/ffcheck.py --lint --concurrency --spmd \\
+        --verify-strategies --budget-s 10
 
-  --lint PATH [PATH ...]   run the invariant linter over files/trees
-  --rules r1,r2            restrict the lint rule set
+  --lint [PATH ...]        run the invariant linter over files/trees
+                           (no paths: the whole package)
+  --concurrency [PATH ...] run the lock-discipline/thread-lifecycle
+                           analyzer (analysis/concurrency.py; no
+                           paths: the whole package)
+  --spmd [PATH ...]        run the SPMD-divergence checker
+                           (analysis/spmd.py; no paths: the package,
+                           scope-filtered to the multi-rank modules)
+  --rules r1,r2            restrict the rule set (applies per engine)
+  --budget-s S             fail (exit 1) if the analyzers' combined
+                           wall time exceeds S seconds — the CI gate
+                           cannot silently bloat
   --verify-strategies [DIR]
                            statically verify every strategy JSON under
                            DIR (default: strategies/): structural
@@ -17,9 +28,12 @@ gate:
                            collective order) for strategies whose
                            workload builder is known (bert/dlrm)
   --json                   machine-readable report on stdout
+                           (``schema: 2``: stable per-finding IDs —
+                           rule + path + symbol hash — diffable across
+                           runs)
   --verbose                print per-strategy pass lines
 
-Exit status: 0 = clean, 1 = findings, 2 = usage/internal error.
+Exit status: 0 = clean, 1 = findings/budget exceeded, 2 = usage error.
 """
 from __future__ import annotations
 
@@ -27,9 +41,12 @@ import argparse
 import json
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+DEFAULT_PATHS = [os.path.join(REPO, "flexflow_tpu")]
 
 
 # ---------------------------------------------------------------------------
@@ -144,10 +161,19 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ffcheck", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--lint", nargs="+", metavar="PATH",
-                    help="lint these files/trees")
+    ap.add_argument("--lint", nargs="*", metavar="PATH",
+                    help="lint these files/trees (default: the "
+                         "package)")
+    ap.add_argument("--concurrency", nargs="*", metavar="PATH",
+                    help="lock-discipline/thread-lifecycle analysis "
+                         "(default: the package)")
+    ap.add_argument("--spmd", nargs="*", metavar="PATH",
+                    help="SPMD-divergence analysis (default: the "
+                         "package, scope-filtered)")
     ap.add_argument("--rules", default=None,
-                    help="comma-separated lint rule subset")
+                    help="comma-separated rule subset (per engine)")
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="fail if analyzer wall time exceeds this")
     ap.add_argument("--verify-strategies", nargs="?", metavar="DIR",
                     const=os.path.join(REPO, "strategies"), default=None,
                     help="verify strategy JSONs (default dir: "
@@ -156,22 +182,54 @@ def main(argv=None) -> int:
                     help="JSON report on stdout")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
-    if not args.lint and not args.verify_strategies:
-        ap.error("nothing to do: pass --lint and/or --verify-strategies")
+    if args.lint is None and args.concurrency is None \
+            and args.spmd is None and not args.verify_strategies:
+        ap.error("nothing to do: pass --lint / --concurrency / --spmd "
+                 "and/or --verify-strategies")
 
+    from flexflow_tpu.analysis.lint import JSON_SCHEMA_VERSION
     rc = 0
-    doc = {}
-    if args.lint:
-        from flexflow_tpu.analysis.lint import (lint_paths, render_json,
-                                                render_text)
-        rules = [r.strip() for r in args.rules.split(",")] \
-            if args.rules else None
-        findings = lint_paths(args.lint, rules=rules)
-        if args.as_json:
-            doc["lint"] = json.loads(render_json(findings))
-        else:
-            print(render_text(findings))
-        if findings:
+    doc = {"schema": JSON_SCHEMA_VERSION}
+    rules = [r.strip() for r in args.rules.split(",")] \
+        if args.rules else None
+    analysis_s = 0.0
+    engines = []
+    if args.lint is not None:
+        from flexflow_tpu.analysis.lint import lint_paths
+        engines.append(("lint", lint_paths, args.lint or DEFAULT_PATHS))
+    if args.concurrency is not None:
+        from flexflow_tpu.analysis.concurrency import \
+            analyze_paths as conc_paths
+        engines.append(("concurrency", conc_paths,
+                        args.concurrency or DEFAULT_PATHS))
+    if args.spmd is not None:
+        from flexflow_tpu.analysis.spmd import \
+            analyze_paths as spmd_paths
+        engines.append(("spmd", spmd_paths, args.spmd or DEFAULT_PATHS))
+    if engines:
+        from flexflow_tpu.analysis.lint import render_json, render_text
+        for name, run, paths in engines:
+            t0 = time.perf_counter()
+            findings = run(paths, rules=rules)
+            analysis_s += time.perf_counter() - t0
+            if args.as_json:
+                doc[name] = json.loads(render_json(findings))
+            elif findings:
+                print(render_text(findings))
+            elif args.verbose:
+                print(f"ffcheck: {name} clean")
+            if findings:
+                rc = 1
+        if not args.as_json and rc == 0:
+            print(f"ffcheck: clean "
+                  f"({'/'.join(n for n, _, _ in engines)}, "
+                  f"{analysis_s:.2f}s)")
+        doc["analysis_s"] = round(analysis_s, 4)
+        if args.budget_s is not None and analysis_s > args.budget_s:
+            print(f"ffcheck: analyzers took {analysis_s:.2f}s — over "
+                  f"the {args.budget_s:.0f}s budget (the CI gate must "
+                  f"not silently bloat; profile or split the pass)",
+                  file=sys.stderr)
             rc = 1
     if args.verify_strategies:
         if not os.path.isdir(args.verify_strategies):

@@ -1131,6 +1131,102 @@ def stage_zero_memory(steps: int):
            "ok": bool(n_sharded > 0 and ratio <= 0.6)})
 
 
+def stage_quantized_sync(steps: int):
+    """Quantized-collectives leg (ISSUE 15 acceptance): on the
+    8-virtual-device 2-slice mesh, training with the DCN gradient-sync
+    leg quantized to int8 (``quantized_collectives=dcn_only``,
+    ops/quantized_collectives.py — explicit staged sync with error
+    feedback) vs the full-precision implicit baseline. Three gates:
+
+      - **loss gap** (HARD): per-step losses must track the baseline
+        within 5% relative — precision is traded only where error
+        feedback recovers it;
+      - **bit-exact off** (HARD): two runs with the flag off produce
+        identical loss histories (the default path is untouched);
+      - **step time** (HARD): paired interleaved rounds, median of
+        baseline/quantized ratios >= 1.0 — the narrowed DCN leg must
+        buy a measured end-to-end win, not just a predicted one.
+    """
+    _apply_platform_env()
+    import statistics
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    from flexflow_tpu.parallel.machine import MachineSpec
+
+    def spec2():
+        spec = MachineSpec.detect()
+        spec.num_devices = 8
+        spec.num_slices = 2
+        spec.num_hosts = 2
+        spec.dcn_bandwidth_gbps = 1.0
+        spec.dcn_latency_us = 20.0
+        return spec
+
+    def build(mode):
+        cfg = FFConfig()
+        cfg.batch_size = 32
+        cfg.only_data_parallel = True
+        cfg.quantized_collectives = mode
+        cfg.seed = 1
+        ff = FFModel(cfg)
+        out = build_mlp(ff, 32, in_dim=512, hidden=(1024, 1024),
+                        num_classes=32)
+        ff.compile(SGDOptimizer(0.01),
+                   "sparse_categorical_crossentropy", [],
+                   machine_spec=spec2(), output_tensor=out)
+        return ff
+
+    rng = np.random.default_rng(0)
+    b = {"input": rng.normal(size=(32, 512)).astype(np.float32),
+         "label": rng.integers(0, 32, size=(32, 1)).astype(np.int32)}
+
+    def losses(ff, n):
+        step = ff.executor.make_train_step()
+        return [float(np.asarray(ff._run_train_step(step, b)["loss"]))
+                for _ in range(n)]
+
+    # parity + bit-exactness on fresh models (loss-gap gate HARD)
+    l_q = losses(build("dcn_only"), 5)
+    l_b = losses(build("off"), 5)
+    l_b2 = losses(build("off"), 5)
+    bitexact_off = l_b == l_b2
+    loss_gap = max(abs(a - c) / max(abs(c), 1e-9)
+                   for a, c in zip(l_q, l_b))
+
+    # paired timing (fresh models so state/donation is symmetric)
+    ff_q, ff_b = build("dcn_only"), build("off")
+    n_quant = len(ff_q.strategy.qsync.quantized_params()) \
+        if ff_q.strategy.qsync else 0
+    runtime_on = ff_q.executor._qsync is not None
+    step_q = ff_q.executor.make_train_step()
+    step_b = ff_b.executor.make_train_step()
+    _sync_fetch(ff_q._run_train_step(step_q, b)["loss"])   # warm jits
+    _sync_fetch(ff_b._run_train_step(step_b, b)["loss"])
+
+    def chunk(ff, step):
+        t0 = time.perf_counter()
+        for _ in range(max(steps // 4, 3)):
+            bm = ff._run_train_step(step, b)
+        _sync_fetch(bm["loss"])
+        return time.perf_counter() - t0
+
+    ratios = []
+    for _ in range(5):
+        tq = chunk(ff_q, step_q)
+        tb = chunk(ff_b, step_b)
+        ratios.append(tb / max(tq, 1e-9))
+    ratio = statistics.median(ratios)
+    _emit({"baseline_vs_quantized": round(ratio, 4),
+           "rounds": [round(r, 4) for r in ratios],
+           "loss_gap": round(loss_gap, 5),
+           "bitexact_off": bitexact_off,
+           "n_quantized": n_quant,
+           "runtime_on": runtime_on,
+           "ok": bool(runtime_on and n_quant > 0 and bitexact_off
+                      and loss_gap <= 0.05 and ratio >= 1.0)})
+
+
 def stage_serving_overload(steps: int):
     """Serving-overload leg (ISSUE 5 acceptance): goodput (requests
     completed WITHIN their deadline per second) at 2x offered load,
@@ -1573,6 +1669,32 @@ def main():
         else:
             errors.append(f"comm_overlap: {err}")
 
+    # -- stage 5.47: quantized gradient collectives (2-slice mesh) ----
+    # ISSUE 15 acceptance: int8-quantized DCN gradient sync must buy a
+    # measured step-time win over the full-precision baseline on the
+    # 2-slice virtual mesh, with the parity losses inside tolerance
+    # and the off-mode path bit-exact (all hard)
+    if remaining() > 120:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            xf = (xf + " --xla_force_host_platform_device_count=8").strip()
+        qenv = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": xf}
+        qs, err = stage(["--stage", "quantized_sync", "--steps", "16"],
+                        300, qenv)
+        if qs is not None:
+            out["quantized_sync_ratio"] = qs["baseline_vs_quantized"]
+            out["quantized_sync_loss_gap"] = qs["loss_gap"]
+            out["quantized_sync_bitexact_off"] = qs["bitexact_off"]
+            if not qs["ok"]:
+                errors.append(
+                    f"quantized_sync: ratio "
+                    f"{qs['baseline_vs_quantized']} (gate >= 1.0), "
+                    f"loss gap {qs['loss_gap']} (gate <= 0.05), "
+                    f"bitexact_off={qs['bitexact_off']}, "
+                    f"n_quantized={qs['n_quantized']}")
+        else:
+            errors.append(f"quantized_sync: {err}")
+
     # -- stage 5.445: per-parameter ZeRO memory ratio -----------------
     # ISSUE 10 acceptance: the searched optimizer-state sharding must
     # measurably shrink per-device opt-state bytes — ratio <= 0.6 at
@@ -1733,5 +1855,7 @@ if __name__ == "__main__":
         stage_serving_overload(a.steps)
     elif a.stage == "zero_memory":
         stage_zero_memory(a.steps)
+    elif a.stage == "quantized_sync":
+        stage_quantized_sync(a.steps)
     else:
         raise SystemExit(f"unknown stage {a.stage!r}")

@@ -66,6 +66,13 @@ case "${1:-fast}" in
     # (sharding is placement, not math), and a checkpoint saved under
     # it must restore into a shrunken 4-device world at the same loss
     python tools/zero_parity_smoke.py
+    # quantized-collectives parity smoke: int8 gradient sync with
+    # error feedback (quantized_collectives=auto) must converge
+    # bit-comparably with the full-precision baseline on the BERT
+    # encoder, the off-mode path must stay bit-exact, and an exported
+    # strategy must round-trip its per-tensor/per-phase wire plan
+    # through --import verbatim
+    python tools/quantized_sync_smoke.py
     # attribution smoke: search -> 3 train steps under FF_ATTRIB=1 ->
     # the strategy audit record must carry a measured per-op side keyed
     # 1:1 to the predicted entries AND a drift report must exist — the

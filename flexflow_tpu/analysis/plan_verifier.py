@@ -296,6 +296,26 @@ def verify_plan(strategy, layers: Sequence, *,
                  for name, l in by_name.items()},
                 axis_sizes, have_layers=bool(by_name),
                 unaddressable=unaddressable)
+    qsync = getattr(strategy, "qsync", None)
+    qsync_tiers = dict(getattr(strategy, "axis_tiers", None) or {})
+    if not qsync_tiers:
+        # a non-searched (preset) strategy carries no placement record:
+        # the mesh's own axis→tier derivation is the ground truth the
+        # plan was built against
+        try:
+            qsync_tiers = dict(dmesh.axis_tiers)
+        except Exception:  # noqa: BLE001 — tierless mesh
+            qsync_tiers = {}
+    _check_qsync(report,
+                 qsync.to_json() if qsync is not None
+                 and hasattr(qsync, "to_json") else qsync,
+                 qsync_tiers,
+                 {name: getattr(os_, "weights", {}) or {}
+                  for name, os_ in getattr(strategy, "ops",
+                                           {}).items()},
+                 axis_sizes, have_layers=bool(by_name),
+                 known_layers=set(by_name),
+                 unaddressable=unaddressable)
 
     report.duration_s = time.perf_counter() - t0
     REGISTRY.counter("ff_plan_verify_runs_total",
@@ -794,6 +814,115 @@ def _check_zero(report, zero_a, weight_specs, weight_shapes, axis_sizes,
                     "zero-assignment")
 
 
+# -- check 3.75: quantized grad-sync plan -------------------------------------
+
+def _check_qsync(report, qsync_doc, axis_tiers, weight_specs,
+                 axis_sizes, have_layers: bool = True,
+                 known_layers=(), unaddressable=None) -> None:
+    """Soundness of a quantized-collectives plan (``strategy.qsync``,
+    ops/quantized_collectives.py):
+
+      - a quantized phase is legal only on its DECLARED tier path —
+        every axis a phase names must exist and sit on the phase's
+        declared tier per ``axis_tiers`` (a plan that labels an ICI
+        axis as a "dcn" leg would narrow the FAST fabric while the
+        accuracy-risk gate believed only the slow one was touched);
+      - replicated-math seams stay full-precision: only the gradient
+        all-reduce of a REPLICATED weight may quantize — a decision on
+        a sharded weight (whose gradient flows through per-op
+        collectives) or a bank / place-group / pipeline member is an
+        error;
+      - wire dtypes must be known, and an axis may appear in at most
+        one phase of a decision.
+    """
+    if not qsync_doc:
+        return
+    from ..parallel.placement import WIRE_ITEMSIZE
+    from ..parallel.topology import TIER_ORDER
+    from ..runtime.zero import spec_degree
+    unaddressable = unaddressable or {}
+    known_layers = set(known_layers or ())
+    decisions = (qsync_doc or {}).get("decisions", {})
+    for lname, ws in decisions.items():
+        lw_specs = weight_specs.get(lname, {})
+        quantized = any(
+            p.get("wire") for rec in ws.values()
+            for p in rec.get("phases", ()))
+        if not quantized:
+            continue
+        if lname in unaddressable:
+            report.add(
+                "qsync", "error", lname,
+                f"qsync plan quantizes gradient sync of "
+                f"{unaddressable[lname]} member {lname!r}, whose "
+                f"gradients live under a group key on a device subset "
+                f"— the explicit sync cannot address them and the "
+                f"implicit one would stay full-precision while the "
+                f"plan claimed otherwise", "qsync-plan")
+            continue
+        if have_layers and known_layers and lname not in known_layers:
+            report.add("qsync", "error", lname,
+                       f"qsync plan names op {lname!r}, which is not "
+                       f"in the program", "qsync-plan")
+            continue
+        for wname, rec in ws.items():
+            phases = rec.get("phases", ())
+            if not any(p.get("wire") for p in phases):
+                continue
+            wspec = lw_specs.get(wname)
+            if wspec is not None \
+                    and spec_degree(wspec, axis_sizes) > 1:
+                report.add(
+                    "qsync", "error", lname,
+                    f"qsync plan quantizes the gradient of weight "
+                    f"{wname!r}, whose placement {wspec} is SHARDED — "
+                    f"its gradient flows through per-op (replicated-"
+                    f"math) collectives, which must stay full-"
+                    f"precision; only the data-parallel all-reduce of "
+                    f"a replicated weight may quantize", "qsync-plan")
+            seen_axes: set = set()
+            for p in phases:
+                wire = p.get("wire")
+                tier = str(p.get("tier", "ici"))
+                if wire is not None and wire not in WIRE_ITEMSIZE:
+                    report.add("qsync", "error", lname,
+                               f"phase on tier {tier!r} names unknown "
+                               f"wire dtype {wire!r} (known: "
+                               f"{sorted(WIRE_ITEMSIZE)})",
+                               "qsync-plan")
+                if tier not in TIER_ORDER:
+                    report.add("qsync", "error", lname,
+                               f"phase declares unknown tier {tier!r} "
+                               f"(tiers: {list(TIER_ORDER)})",
+                               "qsync-plan")
+                for a in p.get("axes", ()):
+                    if axis_sizes and a not in axis_sizes:
+                        report.add(
+                            "qsync", "error", lname,
+                            f"phase on tier {tier!r} names unknown "
+                            f"mesh axis {a!r} (axes: "
+                            f"{sorted(axis_sizes)})", "qsync-plan")
+                        continue
+                    if a in seen_axes:
+                        report.add(
+                            "qsync", "error", lname,
+                            f"axis {a!r} appears in more than one "
+                            f"phase of {wname!r}'s sync — the staged "
+                            f"reduction would traverse it twice",
+                            "qsync-plan")
+                    seen_axes.add(a)
+                    actual = (axis_tiers or {}).get(a, "ici")
+                    if wire is not None and actual != tier:
+                        report.add(
+                            "qsync", "error", lname,
+                            f"quantized phase declares tier {tier!r} "
+                            f"but its axis {a!r} is placed on tier "
+                            f"{actual!r} — a quantized leg is legal "
+                            f"only on its declared tier path (the "
+                            f"accuracy-risk gate scoped the narrowing "
+                            f"to {tier!r} fabric)", "qsync-plan")
+
+
 # -- check 4: collective-ordering consistency --------------------------------
 
 def _check_collective_order(report, strategy, layers, by_name,
@@ -1248,6 +1377,21 @@ def verify_strategy_file(path: str, doc: Optional[Dict] = None
                     weight_shapes, axis_sizes,
                     have_layers=bool(weight_shapes),
                     unaddressable=grouped)
+    # quantized grad-sync plan (doc["qsync"]): wire/tier soundness,
+    # the quantized-phase-on-declared-tier rule, and the replicated-
+    # math-seam rejection (sharded weights stay full-precision)
+    qdoc = doc.get("qsync")
+    if qdoc:
+        w_specs = {
+            name: {w: _json_spec(s)
+                   for w, s in (os_.get("weights") or {}).items()
+                   if s is not None}
+            for name, os_ in (doc.get("ops") or {}).items()}
+        _check_qsync(report, qdoc, doc.get("axis_tiers") or {},
+                     w_specs, axis_sizes,
+                     have_layers=bool(weight_shapes),
+                     known_layers=set(weight_shapes),
+                     unaddressable=grouped)
     # overlapped grad-sync schedule (doc["overlap"]): launch-order
     # totality, member disjointness/subset-group exclusion, and — when
     # the file carries the serialized program — backward-completion

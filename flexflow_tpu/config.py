@@ -181,6 +181,28 @@ class FFConfig:
     # launch token so the gather is scheduled one bucket ahead of use;
     # 0 chains raw grads only (gathers may sink to the step end)
     zero_prefetch: int = 1
+    # quantized gradient collectives (ops/quantized_collectives.py,
+    # arXiv 2506.17615): int8/fp8 wire payloads with per-chunk scaling
+    # and error feedback, planned per-tensor (flat grad sync) and
+    # per-phase (PR 9's reduction trees — quantize the DCN leg, keep
+    # ICI legs full-precision), scored by the calibrated cost model.
+    #   "off"      — plan nothing (default; the bit-exact path — but a
+    #                strategy IMPORTED with a qsync plan is still
+    #                honored verbatim, like zero/overlap);
+    #   "auto"     — quantize where the model predicts a win;
+    #   "dcn_only" — quantize only inter-slice (DCN) legs;
+    #   "all"      — quantize every eligible leg;
+    #   "disable"  — force full precision even for an imported plan
+    #                (what --no-quantized-collectives parses to).
+    # FF_QUANTIZED_COLLECTIVES overrides when set (an explicit off
+    # value there also strips imported plans). Replicated-math seams
+    # (sharded weights, per-op collectives) always stay full-precision
+    # — the structural accuracy-risk gate.
+    quantized_collectives: str = "off"
+    # wire dtype for quantized legs: "int8" (default) |
+    # "float8_e4m3" | "float8_e5m2" (FF_QSYNC_WIRE overrides; fp8
+    # falls back to int8 when the installed jax lacks the dtype)
+    qsync_wire: str = "int8"
     # rematerialization: "none" | "blocks" (jax.checkpoint around each
     # repeated block — HBM-for-FLOPs; executor._emit_remat)
     remat: str = "none"
@@ -421,6 +443,14 @@ class FFConfig:
                 cfg.overlap_bucket_mb = float(take())
             elif a == "--zero-prefetch":
                 cfg.zero_prefetch = int(take())
+            elif a == "--quantized-collectives":
+                cfg.quantized_collectives = take().lower()
+            elif a == "--no-quantized-collectives":
+                # "disable", not "off": strips an imported strategy's
+                # qsync plan too (the explicit full-precision A/B knob)
+                cfg.quantized_collectives = "disable"
+            elif a == "--qsync-wire":
+                cfg.qsync_wire = take().lower()
             elif a == "--remat":
                 cfg.remat = "blocks"
             elif a in ("--gradient-accumulation-steps", "--accum"):

@@ -2,6 +2,10 @@
 
 TPU-first policy: DT_HALF maps to bfloat16 (the MXU-native 16-bit type),
 not IEEE fp16; DT_DOUBLE falls back to float32 unless jax x64 is enabled.
+The narrow wire dtypes (DT_INT8 / DT_FLOAT8_*) are the quantized-
+collective payload types (ops/quantized_collectives.py) — fp8 maps to
+the ml_dtypes types jax ships (e4m3 is the "fn" finite-only variant,
+the accelerator-native encoding).
 """
 from __future__ import annotations
 
@@ -18,10 +22,14 @@ _TO_JNP = {
     DataType.DT_BFLOAT16: jnp.bfloat16,
     DataType.DT_FLOAT: jnp.float32,
     DataType.DT_DOUBLE: jnp.float32,
+    DataType.DT_INT8: jnp.int8,
+    DataType.DT_FLOAT8_E4M3: jnp.float8_e4m3fn,
+    DataType.DT_FLOAT8_E5M2: jnp.float8_e5m2,
 }
 
 _FROM_NP = {
     np.dtype(np.bool_): DataType.DT_BOOLEAN,
+    np.dtype(np.int8): DataType.DT_INT8,
     np.dtype(np.int32): DataType.DT_INT32,
     np.dtype(np.int64): DataType.DT_INT64,
     np.dtype(np.float16): DataType.DT_HALF,
@@ -38,6 +46,10 @@ def from_numpy_dtype(dtype) -> DataType:
     dtype = np.dtype(dtype)
     if dtype == jnp.bfloat16:
         return DataType.DT_BFLOAT16
+    if dtype == np.dtype(jnp.float8_e4m3fn):
+        return DataType.DT_FLOAT8_E4M3
+    if dtype == np.dtype(jnp.float8_e5m2):
+        return DataType.DT_FLOAT8_E5M2
     return _FROM_NP.get(dtype, DataType.DT_FLOAT)
 
 

@@ -420,6 +420,16 @@ class Executor:
         if self._overlap_schedule is not None:
             strategy.overlap = self._overlap_schedule.record()
             obs_events.counter("overlap.schedules_built")
+        # quantized gradient collectives (ops/quantized_collectives.py):
+        # when the strategy carries a QsyncPlan the runtime can honor
+        # (pure-DP program, replicated weights), gradients are computed
+        # and synced explicitly — quantized legs on the wire dtype,
+        # error-feedback residuals as runtime state. None = the
+        # implicit GSPMD sync, bit-exact legacy behavior. An imported
+        # plan resolves here; a plan adopted post-build (FFModel.
+        # _plan_qsync) re-resolves via attach_qsync().
+        self._qsync = None
+        self.attach_qsync()
         # pipeline region (parallel/pipeline_lowering): pre/post layer
         # split + GPipe lowering of the repeated-block region
         self.pipe = getattr(strategy, "pipeline", None)
@@ -464,6 +474,22 @@ class Executor:
             prod = final_t.owner_layer
             if prod is not None and prod.op_type == OperatorType.OP_SOFTMAX:
                 self._logits_tensor = prod.inputs[0]
+
+    # ------------------------------------------------------------------
+    def attach_qsync(self) -> None:
+        """(Re)resolve the strategy's quantized-sync plan into an
+        executable schedule. FFModel.compile calls this again after
+        ``_plan_qsync`` adopts a plan (the executor may predate it —
+        the floor guard builds executors mid-search), invalidating the
+        cached train step when the schedule changes."""
+        from .ops import quantized_collectives as qsync_mod
+        sched = qsync_mod.runtime_schedule(
+            self.program, self.strategy, self.config, self.dmesh)
+        if (sched is None) != (self._qsync is None):
+            self._train_step = None
+        self._qsync = sched
+        if sched is not None:
+            obs_events.counter("qsync.schedules_built")
 
     # ------------------------------------------------------------------
     def init_params_and_state(self, rng: Optional[jax.Array] = None):
@@ -966,28 +992,46 @@ class Executor:
         return ys.reshape((-1,) + ys.shape[2:])
 
     # ------------------------------------------------------------------
-    def _rngs_for_step(self, step):
+    def _rngs_for_step(self, step, shard_index=None):
         base = jax.random.key(self.seed + 1)
         base = jax.random.fold_in(base, step)
+        if shard_index is not None:
+            # shard-local emission (quantized sync): each device draws
+            # INDEPENDENT dropout masks for its batch shard — the
+            # distributional match for the global path's one mask
+            # partitioned across shards (a shared key would correlate
+            # masks across devices)
+            base = jax.random.fold_in(base, shard_index)
         rngs = {}
         for li, layer in enumerate(self.program.layers):
             if _needs_rng(layer):
                 rngs[layer.name] = jax.random.fold_in(base, li)
         return rngs
 
-    def _forward(self, params, state, batch, training: bool, step):
-        rngs = self._rngs_for_step(step) if training else {}
+    def _forward(self, params, state, batch, training: bool, step,
+                 strategy="__use_own__", shard_index=None):
+        """``strategy`` overrides the emission strategy — the quantized-
+        sync path runs the forward INSIDE a shard_map on local batch
+        shards and passes None (sharding constraints are meaningless in
+        a manual shard region; weights arrive replicated).
+        ``shard_index`` (a traced device index) marks that shard-local
+        execution: absolute-batch-shape ops rescale (ctx.local_shape)
+        and per-device rng streams decorrelate."""
+        st = self.strategy if strategy == "__use_own__" else strategy
+        rngs = self._rngs_for_step(step, shard_index) if training else {}
         ctx = EmitCtx(training=training, rngs=rngs, state=state,
                       config=self.config)
+        if shard_index is not None:
+            ctx.local_shape = True
         capture: Dict[int, Any] = {}
         # checkpointing only matters under differentiation: eval/serving
         # forwards skip the remat path (prevent_cse barriers would only
         # inhibit XLA fusion there)
         if self.pipe is None and self._remat is not None and training:
-            outs = self._emit_remat(params, batch, ctx, capture)
+            outs = self._emit_remat(params, batch, ctx, capture,
+                                    strategy=st)
         elif self.pipe is None:
-            outs = self.program.emit(params, batch, ctx, self.strategy,
-                                     capture)
+            outs = self.program.emit(params, batch, ctx, st, capture)
         else:
             env = self.program.init_env(batch)
             self.program.emit_layers(self._pre_layers, env, params, ctx,
@@ -1010,15 +1054,17 @@ class Executor:
             new_state[k] = v
         return outs, new_state, ctx.aux_losses, capture
 
-    def _emit_remat(self, params, batch, ctx, capture):
+    def _emit_remat(self, params, batch, ctx, capture,
+                    strategy="__use_own__"):
         """Forward with each repeated block wrapped in ``jax.checkpoint``:
         block-internal activations are recomputed in the backward pass
         instead of living in HBM for the whole step."""
+        st = self.strategy if strategy == "__use_own__" else strategy
         start, unit, reps, entries, exits = self._remat
         layers = self.program.layers
         env = self.program.init_env(batch)
         self.program.emit_layers(layers[:start], env, params, ctx,
-                                 self.strategy, capture)
+                                 st, capture)
         x = env[entries[0]]
         for b in range(reps):
             block = layers[start + b * unit:start + (b + 1) * unit]
@@ -1030,8 +1076,9 @@ class Executor:
                 bctx = EmitCtx(training=ctx.training, rngs=ctx.rngs,
                                state=ctx.state, config=self.config,
                                seq_length=ctx.seq_length)
+                bctx.local_shape = getattr(ctx, "local_shape", False)
                 self.program.emit_layers(_block, benv, p_, bctx,
-                                         self.strategy, None)
+                                         st, None)
                 if bctx.new_state or bctx.aux_losses:
                     raise RuntimeError(
                         "stateful/aux op inside a rematted block")
@@ -1043,7 +1090,7 @@ class Executor:
             env[exit_g] = x
             capture[exit_g] = x
         self.program.emit_layers(layers[start + reps * unit:], env,
-                                 params, ctx, self.strategy, capture)
+                                 params, ctx, st, capture)
         return [env[t.guid] for t in self.program.output_tensors]
 
     def _loss_and_metrics(self, outs, capture, label, aux_losses):
@@ -1084,7 +1131,22 @@ class Executor:
             return loss, (new_state, bm)
 
         def step_fn(params, opt_state, state, step, batch):
-            if accum <= 1:
+            new_residual = None
+            if self._qsync is not None:
+                # explicit quantized gradient sync (ops/
+                # quantized_collectives.py): one shard_map computes the
+                # per-device local gradients and syncs every tensor on
+                # the plan's wire dtypes, error-feedback residuals
+                # riding the optimizer-state tree under a reserved slot
+                # (stripped before the update below)
+                from .ops import quantized_collectives as qsync_mod
+                residual, opt_state = qsync_mod.strip_residual(opt_state)
+                grads, bm, new_residual = qsync_mod.sharded_grads(
+                    self, params, state, batch, step, residual)
+                if residual is None and not new_residual:
+                    new_residual = None   # keep the opt-state structure
+                new_state = state   # stateful ops are qsync-ineligible
+            elif accum <= 1:
                 grads, (new_state, bm) = jax.grad(
                     loss_fn, has_aux=True)(params, state, batch, step)
             else:
@@ -1146,16 +1208,21 @@ class Executor:
                 new_params, new_opt_state = overlap_mod.overlapped_update(
                     self.optimizer, params, grads, opt_state, step + 1,
                     self._overlap_schedule, self.opt_state_constraints)
-                return new_params, new_opt_state, new_state, bm
-            new_params, new_opt_state = self.optimizer.update(
-                params, grads, opt_state, step + 1)
-            if self.opt_state_constraints is not None:
-                # ZeRO-1 pin: keep the updated moments on their sharded
-                # placement (GSPMD lowers the update to reduce-scatter +
-                # sharded math instead of replicating the state back)
-                new_opt_state = jax.tree.map(
-                    jax.lax.with_sharding_constraint,
-                    new_opt_state, self.opt_state_constraints)
+            else:
+                new_params, new_opt_state = self.optimizer.update(
+                    params, grads, opt_state, step + 1)
+                if self.opt_state_constraints is not None:
+                    # ZeRO-1 pin: keep the updated moments on their
+                    # sharded placement (GSPMD lowers the update to
+                    # reduce-scatter + sharded math instead of
+                    # replicating the state back)
+                    new_opt_state = jax.tree.map(
+                        jax.lax.with_sharding_constraint,
+                        new_opt_state, self.opt_state_constraints)
+            if new_residual is not None:
+                from .ops.quantized_collectives import RESIDUAL_SLOT
+                new_opt_state = dict(new_opt_state)
+                new_opt_state[RESIDUAL_SLOT] = new_residual
             return new_params, new_opt_state, new_state, bm
 
         self._train_step = _instrument_step(

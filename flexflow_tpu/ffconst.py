@@ -52,6 +52,14 @@ class DataType(enum.IntEnum):
     DT_BFLOAT16 = 46  # TPU-native addition (not in reference)
     DT_FLOAT = 44
     DT_DOUBLE = 45
+    # narrow wire dtypes (not in reference): quantized gradient
+    # collectives (ops/quantized_collectives.py) move int8 / fp8
+    # payloads over the slow fabric legs; values chosen past the
+    # reference's enum range so serialized reference strategies never
+    # collide
+    DT_INT8 = 50
+    DT_FLOAT8_E4M3 = 51
+    DT_FLOAT8_E5M2 = 52
     DT_NONE = 49
 
     @classmethod
@@ -61,7 +69,10 @@ class DataType(enum.IntEnum):
                        "half": "HALF", "float16": "HALF",
                        "bfloat16": "BFLOAT16", "float": "FLOAT",
                        "float32": "FLOAT", "double": "DOUBLE",
-                       "float64": "DOUBLE"}
+                       "float64": "DOUBLE", "int8": "INT8",
+                       "float8_e4m3": "FLOAT8_E4M3", "e4m3": "FLOAT8_E4M3",
+                       "float8_e4m3fn": "FLOAT8_E4M3",
+                       "float8_e5m2": "FLOAT8_E5M2", "e5m2": "FLOAT8_E5M2"}
             key = aliases.get(value.lower(), value.upper())
             try:
                 return cls[f"DT_{key}" if not key.startswith("DT_") else key]

@@ -750,6 +750,12 @@ class FFModel:
         # assignment the run will actually use. The uniform --zero flag
         # bypasses this entirely (pinned legacy behavior below).
         self._plan_zero()
+        # quantized gradient collectives (ops/quantized_collectives.py,
+        # arXiv 2506.17615): plan per-tensor/per-phase wire dtypes for
+        # gradient sync, scored by the same calibrated cost model.
+        # Runs BEFORE plan verification so the qsync check binds on the
+        # plan the run will actually use.
+        self._plan_qsync()
         # static plan verification (analysis/plan_verifier.py): prove
         # the adopted strategy executable — axis soundness, shard
         # divisibility, legal reshard lowerings at every seam, memory
@@ -794,6 +800,18 @@ class FFModel:
                 self.opt_state, self.dmesh, self.strategy.zero)
             self.executor.opt_state_constraints = \
                 state_constraints(self.opt_state)
+        if getattr(self.executor, "_qsync", None) is not None \
+                and isinstance(self.opt_state, dict):
+            # error-feedback residuals for the quantized grad sync:
+            # sharding-aware runtime state seeded at zero, one
+            # (degree,) + shape leaf per quantized tensor, riding the
+            # optimizer-state tree (checkpointed with it; the executor
+            # strips the slot before the optimizer update)
+            from .ops import quantized_collectives as qsync_mod
+            res = qsync_mod.init_residuals(
+                self.executor._qsync, self.executor.program, self.dmesh)
+            if res:
+                self.opt_state[qsync_mod.RESIDUAL_SLOT] = res
         self._step = 0
         self.__dict__.setdefault("_compile_phases", {})["compile_s"] = \
             round(time.perf_counter() - _compile_t0, 6)
@@ -901,6 +919,92 @@ class FFModel:
                   f"{s['bytes_saved_total'] / 2**20:.2f} MiB/device "
                   f"saved, predicted overhead "
                   f"{s['overhead_s_total'] * 1e3:.3f} ms/step")
+
+    def _plan_qsync(self):
+        """Adopt a per-tensor, per-phase quantized grad-sync plan
+        (``FFConfig.quantized_collectives``, ops/quantized_collectives.
+        py). A plan already on the strategy (``--import`` round-trip)
+        is honored verbatim; ``off`` (the default) leaves the implicit
+        full-precision sync untouched — bit-exact."""
+        cfg = self.config
+        if self.strategy is None:
+            return
+        from .ops.quantized_collectives import (audit_record, plan_qsync,
+                                                qsync_disabled,
+                                                resolve_qsync_mode,
+                                                resolve_qsync_wire)
+        if getattr(self.strategy, "qsync", None) is not None:
+            if qsync_disabled(cfg):
+                # explicit disable (--no-quantized-collectives /
+                # FF_QUANTIZED_COLLECTIVES=off) beats an imported
+                # plan: the user asked for the full-precision path —
+                # the A/B knob against an exported quantized strategy
+                import logging
+                logging.getLogger("flexflow_tpu").warning(
+                    "stripping the imported strategy's quantized-"
+                    "collectives plan (explicitly disabled)")
+                self.strategy.qsync = None
+            # else: imported with the strategy — honor it verbatim.
+            # Either way the executor may predate the resolution, so
+            # re-resolve the runtime schedule.
+            self.executor.attach_qsync()
+            return
+        mode = resolve_qsync_mode(cfg)
+        if mode == "off" or self.dmesh.num_devices <= 1:
+            return
+        wire = resolve_qsync_wire(cfg)
+        cost_model = getattr(self, "_search_cost_model", None)
+        if cost_model is None or cost_model.spec is not self.dmesh.spec:
+            # non-searched paths (DP preset, --tp): a bare cost model,
+            # placement-aware on multi-tier machines so DCN legs price
+            # against their real fabric tier (PR 9)
+            from .search.costmodel import OpCostModel
+            from .search.optimizer import _attach_placement
+            cost_model = OpCostModel(self.dmesh.spec)
+            _attach_placement(cfg, cost_model, self.dmesh)
+        cost_model.attach_quantization(mode, wire)
+        plan = plan_qsync(self.strategy, self.executor.program.layers,
+                          self.dmesh, cost_model, mode=mode, wire=wire)
+        self.strategy.qsync = plan
+        self.executor.attach_qsync()
+        if plan is None:
+            return
+        if not getattr(self.strategy, "axis_tiers", None):
+            # make the exported artifact self-describing: the plan's
+            # per-phase tiers were derived from the mesh — record the
+            # axis→tier map the verifier (and a later --import on a
+            # different machine) checks the quantized legs against
+            try:
+                self.strategy.axis_tiers = dict(self.dmesh.axis_tiers)
+            except Exception:  # noqa: BLE001 — tierless machine
+                pass
+        record = audit_record(plan)
+        self._qsync_record = record
+        audit_path = getattr(self, "_strategy_audit_path", None)
+        if audit_path:
+            from .obs.audit import annotate_strategy_audit
+            annotate_strategy_audit(audit_path,
+                                    {"quantized_sync": record})
+        if cfg.export_strategy_file:
+            # the search exported before the plan existed (same
+            # ordering as banks/zero/overlap): rewrite the qsync
+            # section so --import round-trips the decision
+            try:
+                import json as _json
+                with open(cfg.export_strategy_file) as f:
+                    doc = _json.load(f)
+                doc["qsync"] = plan.to_json()
+                with open(cfg.export_strategy_file, "w") as f:
+                    _json.dump(doc, f, indent=1)
+            except Exception:  # noqa: BLE001 — export is best-effort
+                pass
+        if cfg.profiling:
+            s = plan.summary()
+            print(f"qsync plan ({mode}, wire {wire}): "
+                  f"{s['n_quantized']}/{s['n_params']} grad syncs "
+                  f"quantized, predicted "
+                  f"{s['baseline_s_total'] * 1e3:.3f} -> "
+                  f"{s['quantized_s_total'] * 1e3:.3f} ms/step")
 
     # ------------------------------------------------------------------
     def create_data_loader(self, tensor: Tensor, data: np.ndarray):

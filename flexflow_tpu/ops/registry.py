@@ -49,6 +49,12 @@ class EmitCtx:
         self.kv_index: Any = None
         self.kv_prefill_len: Any = None
         self.new_kv: Dict[str, Any] = {}
+        # local-shape execution (the quantized-sync shard_map runs the
+        # graph on per-device batch SHARDS): ops whose params bake
+        # absolute batch-sized shapes (Reshape) rescale their batch dim
+        # by the shard factor ONLY when this is set — global emission
+        # keeps the exact historical error behavior
+        self.local_shape: bool = False
 
     def rng_for(self, name: str):
         return self.rngs.get(name)

@@ -55,7 +55,20 @@ class ReshapeOp(OpDef):
 
     def emit(self, params, inputs, weights, ctx, name):
         shape = tuple(params["shape"])
-        return [inputs[0].reshape(shape)]
+        x = inputs[0]
+        vol = int(np.prod(shape))
+        if getattr(ctx, "local_shape", False) and -1 not in shape \
+                and shape and vol != x.size:
+            # local-shape execution (ctx.local_shape — the quantized-
+            # sync shard_map runs the graph on batch SHARDS): the
+            # recorded target shape is global, so rescale its batch dim
+            # by the shard factor. Scoped to that context only: global
+            # emission keeps the exact historical error on any
+            # volume-mismatched reshape.
+            rest = vol // shape[0] if shape[0] > 0 else 0
+            if rest > 0 and x.size % rest == 0:
+                shape = (x.size // rest,) + shape[1:]
+        return [x.reshape(shape)]
 
 
 @register

@@ -37,12 +37,30 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from .topology import Tier, TierGraph, TIER_ORDER, TIER_RANK
 
 __all__ = ["AxisPlacement", "Phase", "TreeChoice",
-           "choose_reduction_tree", "tree_algorithms"]
+           "choose_reduction_tree", "tree_algorithms",
+           "wire_byte_scale", "WIRE_ITEMSIZE", "QSYNC_CHUNK"]
 
 
 #: algorithms the selector enumerates (per-collective search space)
 TREE_ALGORITHMS = ("ring", "halving_doubling", "two_phase",
                    "three_phase")
+
+#: quantized-collective wire dtypes and their payload itemsize
+#: (ops/quantized_collectives.py owns the kernels; this table owns the
+#: byte accounting the cost model prices against)
+WIRE_ITEMSIZE = {"int8": 1, "float8_e4m3": 1, "float8_e5m2": 1}
+
+#: elements per quantization scale (one fp32 scale rides per chunk)
+QSYNC_CHUNK = 1024
+
+
+def wire_byte_scale(wire: Optional[str]) -> float:
+    """Wire-bytes / logical-fp32-bytes ratio of one quantized leg: the
+    narrow payload plus the per-chunk fp32 scales that ride with it.
+    ``None`` (full precision) is 1.0."""
+    if not wire:
+        return 1.0
+    return (WIRE_ITEMSIZE[wire] + 4.0 / QSYNC_CHUNK) / 4.0
 
 
 def tree_algorithms() -> Tuple[str, ...]:
@@ -53,16 +71,22 @@ def tree_algorithms() -> Tuple[str, ...]:
 class Phase:
     """One staged collective of a reduction tree: ``collective`` over
     ``degree`` participants confined to ``tier``, moving
-    ``volume_bytes`` per group."""
+    ``volume_bytes`` per group. ``wire`` is the leg's wire dtype when a
+    quantized-collectives plan narrows it (``None`` = the element
+    dtype, full precision)."""
     collective: str
     tier: str
     degree: int
     volume_bytes: float
+    wire: Optional[str] = None
 
     def to_json(self) -> Dict:
-        return {"collective": self.collective, "tier": self.tier,
-                "degree": self.degree,
-                "volume_bytes": float(self.volume_bytes)}
+        out = {"collective": self.collective, "tier": self.tier,
+               "degree": self.degree,
+               "volume_bytes": float(self.volume_bytes)}
+        if self.wire:
+            out["wire"] = self.wire
+        return out
 
 
 @dataclasses.dataclass
@@ -201,7 +225,8 @@ def tree_bandwidth_cost(phases: Sequence[Phase],
         tier = tier_graph.tier(p.tier)
         total += (bandwidth_multiplier(p.collective, p.degree)
                   * (p.degree - 1) / p.degree
-                  * p.volume_bytes / tier.bandwidth)
+                  * p.volume_bytes * wire_byte_scale(p.wire)
+                  / tier.bandwidth)
     return total
 
 

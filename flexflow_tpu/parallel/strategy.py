@@ -83,6 +83,14 @@ class ShardingStrategy:
         # Serializes with the strategy and is statically checked by
         # analysis/plan_verifier's zero pass.
         self.zero = None
+        # quantized gradient collectives (ops/quantized_collectives.py
+        # QsyncPlan, arXiv 2506.17615): per-tensor, per-phase wire
+        # dtype of each gradient sync — quantize the slow (DCN) legs,
+        # keep ICI legs and every replicated-math seam full-precision.
+        # None = every sync at the element dtype. Serializes with the
+        # strategy (--import honors it verbatim) and is statically
+        # checked by analysis/plan_verifier's qsync pass.
+        self.qsync = None
 
     # ------------------------------------------------------------------
     def set_op(self, layer_name: str, outputs: Sequence[Optional[P]],
@@ -165,6 +173,11 @@ class ShardingStrategy:
                 f"zero: {s['n_sharded']}/{s['n_params']} opt states "
                 f"sharded ({s['policy']}), "
                 f"{s['bytes_saved_total'] / 2**20:.1f} MiB/device saved")
+        if self.qsync is not None:
+            s = self.qsync.summary()
+            lines.append(
+                f"qsync: {s['n_quantized']}/{s['n_params']} grad syncs "
+                f"quantized ({s['mode']}, wire {s['wire']})")
         for name, os in self.ops.items():
             lines.append(f"  {name}: out={os.outputs} w={os.weights}")
         for bk in self.banks:

@@ -813,10 +813,50 @@ def restore_model_checkpoint(ff, directory: str,
             tmpl, new)
 
     ff.params = replace(ff.params, state["params"])
-    ff.opt_state = replace(ff.opt_state, state["opt_state"])
+    ff.opt_state = _restore_opt_state(ff, state["opt_state"], replace)
     if state.get("state"):
         ff.state = replace(ff.state, state["state"])
     ff._step = int(meta["step"])
     if with_meta:
         return ff._step, meta
     return ff._step
+
+
+def _restore_opt_state(ff, saved, replace):
+    """Restore the optimizer state with the quantized-sync residual
+    slot (ops/quantized_collectives.RESIDUAL_SLOT) handled out of band:
+    residuals are per-participant error-feedback state whose leading
+    dim is the SYNC DEGREE, so a checkpoint from a different world
+    sum-folds onto the live degree (``refit_residual`` — withheld
+    gradient mass is preserved exactly) and re-places via
+    ``reshard.place_host``; a checkpoint without residuals restores
+    into zeros, one with extras drops them. Everything else keeps the
+    congruent-tree fast path."""
+    from ..ops.quantized_collectives import RESIDUAL_SLOT, refit_residual
+    from ..parallel.reshard import place_host
+    live = ff.opt_state
+    if not isinstance(live, dict) or not isinstance(saved, dict):
+        return replace(live, saved)
+    live_res = live.get(RESIDUAL_SLOT)
+    saved = dict(saved)
+    saved_res = saved.pop(RESIDUAL_SLOT, None)
+    live_rest = {k: v for k, v in live.items() if k != RESIDUAL_SLOT}
+    out = replace(live_rest, saved)
+    if live_res is None:
+        return out
+    placed: Dict[str, Dict[str, Any]] = {}
+    for lname, ws in live_res.items():
+        for wname, tmpl in ws.items():
+            src = (saved_res or {}).get(lname, {}).get(wname)
+            if src is None:
+                arr = np.zeros(tmpl.shape, np.float32)
+            else:
+                arr = refit_residual(
+                    np.asarray(src, np.float32).reshape(
+                        (-1,) + tuple(tmpl.shape[1:])),
+                    int(tmpl.shape[0]))
+            placed.setdefault(lname, {})[wname] = place_host(
+                arr.astype(np.dtype(tmpl.dtype)),
+                tmpl.sharding if hasattr(tmpl, "sharding") else None)
+    out[RESIDUAL_SLOT] = placed
+    return out

@@ -290,17 +290,26 @@ def _bench_parallel_eff(mesh, n_dev: int) -> float:
 
 
 def _bench_collective(mesh, coll: str, nbytes: int,
-                      n_axes: Optional[int] = None) -> float:
+                      n_axes: Optional[int] = None,
+                      dtype: str = "float32") -> float:
     """One logical collective over the first ``n_axes`` mesh axes (all
     by default) at ``nbytes`` payload per group, on the live backend.
     With a subset, the remaining axes run the same collective
     concurrently in independent groups — exactly how a sub-degree
-    collective executes inside a larger mesh, contention included."""
+    collective executes inside a larger mesh, contention included.
+    ``dtype`` sets the wire payload type — the quantized-collective
+    rows (int8/fp8) time the same logical collectives at narrow
+    payloads; a backend that cannot lower them raises and the caller
+    records nothing (itemsize-scaled float32 rows stand in)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from ..utils.jax_compat import shard_map
+    jdt = {"float32": jnp.float32, "int8": jnp.int8,
+           "float8_e4m3": jnp.float8_e4m3fn,
+           "float8_e5m2": jnp.float8_e5m2}[dtype]
+    isz = np.dtype(jdt).itemsize
     axes = tuple(mesh.axis_names)
     coll_axes = axes[:n_axes] if n_axes else axes
     n_dev = int(np.prod([mesh.shape[a] for a in axes]))
@@ -308,27 +317,31 @@ def _bench_collective(mesh, coll: str, nbytes: int,
     # ``nbytes`` is the PER-GROUP payload (what xfer_cost queries); a
     # subset collective has n_dev/deg concurrent groups, so the global
     # array scales up to keep each group's volume at nbytes
-    m = max(nbytes // 4 * (n_dev // deg), n_dev * n_dev)
+    m = max(nbytes // isz * (n_dev // deg), n_dev * n_dev)
     m -= m % (n_dev * n_dev)       # shardable + all_to_all reshapable
-    x = jnp.ones((m,), jnp.float32)
+    x = jnp.ones((m,), jdt)
+
+    def acc(y):
+        # per-shard (1,) value; integer/fp8 payloads fold in fp32 so
+        # the sync-fetch scalar is well-defined on every backend
+        return jnp.sum(y.astype(jnp.float32))[None]
 
     # every body returns a (1,) per-shard value gathered with
     # out_specs=P(axes): no replication claim, works for all kinds
     if coll == "all_reduce":
         def body(xl):
-            return jnp.sum(jax.lax.psum(xl, coll_axes))[None]
+            return acc(jax.lax.psum(xl, coll_axes))
     elif coll == "all_gather":
         def body(xl):
-            return jnp.sum(jax.lax.all_gather(
-                xl, coll_axes, tiled=True))[None]
+            return acc(jax.lax.all_gather(xl, coll_axes, tiled=True))
     elif coll == "reduce_scatter":
         def body(xl):
-            return jnp.sum(jax.lax.psum_scatter(
-                xl, coll_axes, scatter_dimension=0, tiled=True))[None]
+            return acc(jax.lax.psum_scatter(
+                xl, coll_axes, scatter_dimension=0, tiled=True))
     elif coll == "all_to_all":
         def body(xl):
-            y = jax.lax.all_to_all(xl.reshape(deg, -1), coll_axes, 0, 0)
-            return jnp.sum(y)[None]
+            return acc(jax.lax.all_to_all(
+                xl.reshape(deg, -1), coll_axes, 0, 0))
     else:
         raise ValueError(coll)
 
@@ -365,18 +378,23 @@ class MeshCalibration:
     _degs: Dict = dataclasses.field(default_factory=dict, repr=False)
 
     def _points(self, coll: str, degree: int,
-                tier: Optional[str] = None) -> List[Tuple[int, float]]:
+                tier: Optional[str] = None,
+                dtype: Optional[str] = None) -> List[Tuple[int, float]]:
         """Measured (shape_class, seconds) points for one collective at
         one degree. ``tier`` selects the tier-keyed rows
         (``coll_<kind>@<tier>``, written by :func:`calibrate_mesh` on
         multi-tier meshes); flat rows remain the fallback so warm
-        pre-tier tables keep answering without re-measurement."""
+        pre-tier tables keep answering without re-measurement.
+        ``dtype`` selects wire-dtype rows (``int8``/``float8_*``,
+        measured by :func:`calibrate_mesh` when quantized collectives
+        are enabled) instead of the default element dtype."""
         kind = f"{coll}@{tier}" if tier else coll
-        key = (kind, degree)
+        dt = dtype or self.dtype
+        key = (kind, degree, dt)
         hit = self._pts.get(key)
         if hit is None:
             hit = self.table.entries(self.backend, f"coll_{kind}",
-                                     self.dtype, axis_size=degree)
+                                     dt, axis_size=degree)
             self._pts[key] = hit
         return hit
 
@@ -415,9 +433,19 @@ class MeshCalibration:
         return hit
 
     def collective_time(self, coll: str, degree: int, nbytes: float,
-                        tier: Optional[str] = None) -> Optional[float]:
+                        tier: Optional[str] = None,
+                        dtype: Optional[str] = None) -> Optional[float]:
         if self.table is None or degree <= 1 or nbytes <= 0:
             return None
+        if dtype is not None:
+            # wire-dtype rows are measured opportunistically (quantized
+            # collectives enabled): STRICT like tier rows — a miss
+            # returns None and the caller falls back to the
+            # itemsize-scaled float32 query, never a wrong row
+            pts = self._points(coll, degree, tier, dtype=dtype)
+            if not pts:
+                return None
+            return self._interp(pts, nbytes)
         if tier is not None:
             # STRICT: a tier-scoped query answers only from rows
             # measured for that tier. Falling back to the flat rows
@@ -444,6 +472,10 @@ class MeshCalibration:
             if not (0.5 <= near / degree <= 2.0):
                 return None          # too far to stand in
             pts = self._points(coll, near)
+        return self._interp(pts, nbytes)
+
+    @staticmethod
+    def _interp(pts: List[Tuple[int, float]], nbytes: float) -> float:
         # at/below the smallest measured class the fixed dispatch/
         # rendezvous floor dominates: CLAMP, never extrapolate downward
         # (a 16 KiB collective does not cost 16/64 of the 64 KiB one)
@@ -493,7 +525,9 @@ class MeshCalibration:
                                     self.dtype, sc, deg)
 
     def collective_marginal(self, coll: str, degree: int,
-                            nbytes: float) -> Optional[float]:
+                            nbytes: float,
+                            dtype: Optional[str] = None
+                            ) -> Optional[float]:
         """Per-byte MARGINAL cost of a collective — the measured curve's
         top-range slope times the volume, with the fixed dispatch/
         rendezvous floor amortized away. This prices per-op gradient
@@ -505,10 +539,14 @@ class MeshCalibration:
         ranking on dense tower models (candle/mlp)."""
         if self.table is None or degree <= 1 or nbytes <= 0:
             return None
-        full = self.collective_time(coll, degree, nbytes)
+        full = self.collective_time(coll, degree, nbytes, dtype=dtype)
         if full is None:
             return None
-        pts = self._points(coll, degree)
+        pts = self._points(coll, degree, dtype=dtype)
+        if dtype is not None and len(pts) < 2:
+            # wire-dtype rows: no nearest-degree stand-in (strict, like
+            # tier rows) — fall back to the top point's average
+            return full
         if not pts:
             degs = self._degrees_measured(coll)
             if not degs:
@@ -532,19 +570,25 @@ class MeshCalibration:
 def calibrate_mesh(dmesh=None, cache_dir: Optional[str] = None,
                    collectives: Tuple[str, ...] = COLLECTIVES,
                    sizes: Tuple[int, ...] = COLLECTIVE_SIZES,
-                   table: Optional[CalibrationTable] = None
+                   table: Optional[CalibrationTable] = None,
+                   wire_dtypes: Tuple[str, ...] = ()
                    ) -> MeshCalibration:
     """Measure (or load) every calibration term for the live backend and
     the given mesh. Persisted measurements are reused across processes;
-    a warm table makes this call measurement-free."""
+    a warm table makes this call measurement-free. ``wire_dtypes``
+    additionally measures the quantized-collective payload rows
+    (int8/fp8) for the same (collective, degree, size) grid — passed by
+    the search when ``FFConfig.quantized_collectives`` is on; a backend
+    that cannot lower a narrow collective records nothing and lookups
+    fall back to itemsize-scaled float32 rows (docs/calibration.md)."""
     import jax
     with obs_events.span("search.calibrate_mesh"):
         return _calibrate_mesh(jax.default_backend(), dmesh, cache_dir,
-                               collectives, sizes, table)
+                               collectives, sizes, table, wire_dtypes)
 
 
 def _calibrate_mesh(backend, dmesh, cache_dir, collectives, sizes,
-                    table) -> MeshCalibration:
+                    table, wire_dtypes=()) -> MeshCalibration:
     tab = table if table is not None else CalibrationTable(cache_dir)
     calib = MeshCalibration(backend=backend, table=tab)
     calib.dispatch_s = tab.get_or_measure(
@@ -610,6 +654,25 @@ def _calibrate_mesh(backend, dmesh, cache_dir, collectives, sizes,
                             shape_class(nbytes), deg) is None:
                         tab.put(backend, f"coll_{coll}@{tier}",
                                 "float32", shape_class(nbytes), deg, v)
+                    # quantized wire rows (same grid, narrow payload):
+                    # keyed by the wire dtype so a float32 query can
+                    # never answer from them; failures record nothing
+                    # (get_or_measure swallows the raise) and the
+                    # itemsize-scaled float32 rows stand in
+                    for wdt in wire_dtypes:
+                        vw = tab.get_or_measure(
+                            backend, f"coll_{coll}", wdt,
+                            shape_class(nbytes), deg,
+                            lambda c=coll, s=nbytes, k=n_axes, w=wdt:
+                                _bench_collective(mesh, c, s, n_axes=k,
+                                                  dtype=w))
+                        if vw is not None and tier is not None \
+                                and tab.get(backend,
+                                            f"coll_{coll}@{tier}", wdt,
+                                            shape_class(nbytes),
+                                            deg) is None:
+                            tab.put(backend, f"coll_{coll}@{tier}",
+                                    wdt, shape_class(nbytes), deg, vw)
     return calib
 
 

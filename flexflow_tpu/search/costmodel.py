@@ -98,6 +98,19 @@ class OpCostModel:
         # adopted strategy's serialized tree shapes (bounded)
         self.algo_choices: Dict[Tuple, Dict[str, Any]] = {}
         self._tree_memo: Dict[Tuple, Any] = {}
+        # quantized gradient collectives (ops/quantized_collectives.py,
+        # arXiv 2506.17615): when a policy dict {"mode", "wire"} is
+        # attached, grad-sync sites are additionally scored with their
+        # slow legs narrowed to the wire dtype (int8/fp8, per-chunk
+        # scales + error feedback) — per-tensor on flat syncs,
+        # per-phase on the reduction trees — and the cheaper side wins
+        # per the mode (auto) or the mode's mandate (dcn_only/all).
+        # None (default) keeps every prediction bit-identical.
+        self.quantization: Optional[Dict[str, str]] = None
+        # wire dtype of the most recent weight_sync_cost answer (the
+        # audit breakdown records it per grad-sync site — the drift
+        # detector attributes quantized rows by it)
+        self.last_sync_wire: str = "float32"
         # calibration-row provenance tap (obs/drift.py): when a list is
         # installed here, every pricing call appends WHICH calibration
         # row (or analytic term) produced its answer. Installed only by
@@ -173,6 +186,170 @@ class OpCostModel:
         self._tree_memo.clear()
         self.algo_choices.clear()
 
+    def attach_quantization(self, mode: Optional[str],
+                            wire: str = "int8") -> None:
+        """Attach (or detach, ``mode=None``/"off") the quantized-
+        collectives scoring policy. Clears every cached cost priced
+        under the previous policy."""
+        if mode in (None, "off"):
+            self.quantization = None
+        else:
+            from ..ops.quantized_collectives import QSYNC_MODES
+            if mode not in QSYNC_MODES:
+                raise ValueError(f"unknown quantization mode {mode!r}")
+            self.quantization = {"mode": mode, "wire": wire}
+        self.cache.clear()
+        self._tree_memo.clear()
+
+    def _quant_overhead_s(self, volume_bytes: float) -> float:
+        """In-jit quantize+dequantize cost of one synced tensor: two
+        streaming passes over the payload at measured (or datasheet)
+        memory bandwidth."""
+        mem_bw = self.spec.hbm_bandwidth
+        if self.calib is not None and self.calib.mem_bw:
+            mem_bw = self.calib.mem_bw
+        return 2.0 * volume_bytes / max(mem_bw, 1.0)
+
+    def _flat_wire_sync(self, volume_bytes: float, degree: int,
+                        wire: str) -> float:
+        """Flat quantized grad-sync candidate: the calibrated wire-
+        dtype rows answer first (measured int8/fp8 collectives), else
+        the float32 tables itemsize-scaled (the same curve queried at
+        the narrow payload's byte volume), else the analytic ring at
+        wire bytes — plus the quantize/dequantize overhead."""
+        from ..parallel.placement import (bandwidth_multiplier,
+                                          wire_byte_scale)
+        wb = volume_bytes * wire_byte_scale(wire)
+        t = None
+        if self.calib is not None:
+            t = self.calib.collective_marginal("all_reduce", degree, wb,
+                                               dtype=wire)
+            if t is None:
+                t = self.calib.collective_marginal("all_reduce", degree,
+                                                   wb)
+        if t is None:
+            ici_bw = self.coll_bw or self.spec.ici_bandwidth
+            ici_lat = self.coll_lat if self.coll_lat is not None \
+                else self.spec.ici_latency_us * 1e-6
+            # two wire collectives (reduce leg + gather leg) pay twice
+            # the latency rounds of the single fused ring — the
+            # conservative side of the comparison
+            t = (bandwidth_multiplier("all_reduce", degree)
+                 * (degree - 1) / degree * wb / ici_bw
+                 + 2 * (degree - 1) * ici_lat)
+        return float(t) + self._quant_overhead_s(volume_bytes)
+
+    def quantized_sync_quote(self, volume_bytes: float, degree: int,
+                             skeleton: Sequence[Tuple[Tuple[str, ...],
+                                                      str]],
+                             mode: Optional[str] = None,
+                             wire: Optional[str] = None
+                             ) -> Optional[Tuple[float, float,
+                                                 List[Optional[str]]]]:
+        """Score one gradient tensor's sync at full precision vs with
+        its legs quantized, over the tier-phase ``skeleton``
+        (``[(axes, tier), ...]`` innermost first — what the runtime
+        executes). Returns ``(baseline_s, quantized_s, phase_wires)``
+        with ``phase_wires[i]`` the wire dtype of phase i (None =
+        full-precision); all-None when the mode rejects quantization
+        for this tensor. None when no policy applies."""
+        q = self.quantization or {}
+        mode = mode or q.get("mode")
+        wire = wire or q.get("wire") or "int8"
+        if not mode or mode == "off" or degree <= 1 or volume_bytes <= 0:
+            return None
+        saved = self.quantization
+        try:
+            self.quantization = None
+            base = self.weight_sync_cost(volume_bytes, degree)
+        finally:
+            self.quantization = saved
+        tiers = [t for _, t in skeleton] or ["ici"]
+        if len(skeleton) <= 1 or self.placement is None:
+            # flat sync: both sides answer from the same calibrated
+            # curve (the wire side at the narrow payload's byte volume
+            # — the itemsize-scaled fallback — or from measured
+            # wire-dtype rows when they exist), so the auto comparison
+            # is apples-to-apples
+            if mode == "dcn_only":
+                return None
+            qc = self._flat_wire_sync(volume_bytes, degree, wire)
+            if mode == "auto" and qc >= base:
+                return base, base, [None] * len(tiers)
+            return base, qc, [wire] * len(tiers)
+        from ..parallel.placement import wire_byte_scale
+
+        def phase_cost(volume, d, tier, w) -> float:
+            pl = self.placement
+            bw = None
+            if pl is not None:
+                try:
+                    bw = pl.tier_graph.tier(tier).bandwidth
+                except Exception:  # noqa: BLE001 — unknown tier
+                    bw = None
+            if bw is None:
+                bw = self.spec.dcn_bandwidth if tier == "dcn" \
+                    else (self.coll_bw or self.spec.ici_bandwidth)
+            return 2.0 * (d - 1) / d * volume * wire_byte_scale(w) / bw
+
+        def total_cost(phase_wires) -> float:
+            # staged tree: inner legs reduce-scatter, so each outer leg
+            # carries the tier-reduced volume (the runtime's shape).
+            # Per-phase degrees resolve from the skeleton's real axes
+            # through the placed axis sizes; a tierless (single-phase)
+            # skeleton is the whole degree.
+            sizes = dict(getattr(self.placement, "axis_sizes", None)
+                         or {})
+            resolved = []
+            for (axes, _tier) in skeleton:
+                d = 1
+                for a in axes:
+                    d *= int(sizes.get(a, 1)) or 1
+                resolved.append(d)
+            known = 1
+            for d in resolved:
+                known *= d
+            if known != degree:
+                if len(resolved) <= 1:
+                    resolved = [degree]
+                else:       # fold the unexplained remainder outermost
+                    resolved[-1] = max(
+                        1, degree * resolved[-1] // max(known, 1))
+            cost, v = 0.0, volume_bytes
+            for (_axes, tier), d, w in zip(skeleton, resolved,
+                                           phase_wires):
+                if d <= 1:
+                    continue
+                cost += phase_cost(v, d, tier, w)
+                v = v / d          # staged: outer legs see reduced bytes
+            if any(phase_wires):
+                cost += self._quant_overhead_s(volume_bytes)
+            return cost
+
+        def wires(pred) -> List[Optional[str]]:
+            return [wire if pred(t) else None for t in tiers]
+
+        if mode == "dcn_only":
+            cands = [wires(lambda t: t == "dcn")]
+        elif mode == "all":
+            cands = [wires(lambda t: True)]
+        else:
+            cands = [wires(lambda t: True)]
+            if "dcn" in tiers and len(set(tiers)) > 1:
+                cands.insert(0, wires(lambda t: t == "dcn"))
+        best: Optional[Tuple[float, List[Optional[str]]]] = None
+        for pw in cands:
+            if not any(pw):
+                continue
+            c = total_cost(pw)
+            if best is None or c < best[0]:
+                best = (c, pw)
+        if best is None:
+            return None
+        if mode == "auto" and best[0] >= base:
+            return base, base, [None] * len(tiers)
+        return base, best[0], best[1]
+
     def _placed_collective(self, volume_bytes: float, collective: str,
                            degree: int, axes: Optional[Tuple[str, ...]],
                            prefer: str, site: str) -> Optional[float]:
@@ -204,9 +381,11 @@ class OpCostModel:
         # memo key carries the EXACT volume: a shape-class bucket here
         # made cost non-monotonic in volume (same-band payloads up to
         # ~2x apart returned the first-seen absolute cost)
+        q = self.quantization
         memo_key = (site, collective, degree,
                     tuple((t.name, d) for t, d in path),
-                    int(volume_bytes), self.placement_policy)
+                    int(volume_bytes), self.placement_policy,
+                    (q["mode"], q["wire"]) if q else None)
         choice = self._tree_memo.get(memo_key)
         if choice is None:
             if self.placement_policy == "flat":
@@ -232,11 +411,18 @@ class OpCostModel:
                     cost_s=tree_bandwidth_cost(choice.phases,
                                                pl.tier_graph),
                     flat_cost_s=choice.flat_cost_s)
+                qchoice = self._quantize_tree(choice, pl.tier_graph,
+                                              volume_bytes)
+                if qchoice is not None:
+                    choice = qchoice
             if len(self._tree_memo) > 4096:
                 self._tree_memo.clear()
             self._tree_memo[memo_key] = choice
             self._record_choice(site, collective, degree, path, choice,
                                 volume_bytes)
+        if site == "grad_sync":
+            self.last_sync_wire = next(
+                (p.wire for p in choice.phases if p.wire), "float32")
         if self.provenance is not None:
             # tier-path pricing provenance (best effort): the
             # bottleneck (outermost) tier's row is the one a drift on
@@ -248,6 +434,51 @@ class OpCostModel:
             self._prov("sync" if site == "grad_sync" else "xfer",
                        f"coll_{collective}@{tier}", key, tier)
         return float(choice.cost_s)
+
+    def _quantize_tree(self, choice, tier_graph, volume_bytes):
+        """Per-PHASE precision choice on a grad-sync reduction tree
+        (ops/quantized_collectives.py): re-price the chosen tree with
+        some legs' wire dtype narrowed — the DCN legs only (dcn_only,
+        and the auto candidate that keeps ICI full-precision) or every
+        leg (all) — through the same bandwidth-marginal algebra
+        (``tree_bandwidth_cost`` scales each leg by its wire's byte
+        ratio), plus the quantize/dequantize overhead. Returns the
+        quantized TreeChoice when the policy adopts it, else None."""
+        q = self.quantization
+        if q is None or not choice.phases:
+            return None
+        from ..parallel.placement import Phase, TreeChoice, \
+            tree_bandwidth_cost
+        wire, mode = q["wire"], q["mode"]
+
+        def variant(pred):
+            return [Phase(p.collective, p.tier, p.degree,
+                          p.volume_bytes,
+                          wire=wire if pred(p.tier) else None)
+                    for p in choice.phases]
+
+        cands = []
+        if mode in ("dcn_only", "auto"):
+            ph = variant(lambda t: t == "dcn")
+            if any(p.wire for p in ph):
+                cands.append(ph)
+        if mode in ("all", "auto"):
+            cands.append(variant(lambda t: True))
+        best = None
+        for ph in cands:
+            if not any(p.wire for p in ph):
+                continue
+            cost = tree_bandwidth_cost(ph, tier_graph) \
+                + self._quant_overhead_s(volume_bytes)
+            if best is None or cost < best[0]:
+                best = (cost, ph)
+        if best is None:
+            return None
+        if mode == "auto" and best[0] >= choice.cost_s:
+            return None
+        return TreeChoice(algo=choice.algo, phases=best[1],
+                          cost_s=best[0],
+                          flat_cost_s=choice.flat_cost_s)
 
     def _record_choice(self, site, collective, degree, path, choice,
                        volume_bytes) -> None:
@@ -776,26 +1007,53 @@ class OpCostModel:
         per-layer gradient reductions into a few large collectives, so
         the fixed dispatch floor is paid once per step, not once per op
         (calibration.MeshCalibration.collective_marginal)."""
+        self.last_sync_wire = "float32"
         placed = self._placed_collective(weight_bytes, "all_reduce",
                                          dp_degree, axes, "outer",
                                          "grad_sync")
         if placed is not None:
             return placed
+        t = None
         if self.calib is not None and dp_degree > 1 and weight_bytes > 0:
             t = self.calib.collective_marginal("all_reduce", dp_degree,
                                                weight_bytes)
             if t is not None:
+                t = float(t)
+        if t is None:
+            n0 = len(self.provenance) if self.provenance is not None \
+                else 0
+            t = self.xfer_cost(weight_bytes, "all_reduce", dp_degree)
+            if self.provenance is not None:
+                # the fallthrough priced through xfer_cost, but this IS
+                # the gradient sync — drift diffs it under "sync"
+                for row in self.provenance[n0:]:
+                    row["term"] = "sync"
+        elif self.provenance is not None:
+            self._prov("sync", "coll_all_reduce",
+                       self.calib.row_key("all_reduce", dp_degree,
+                                          weight_bytes))
+        # quantized flat candidate (ops/quantized_collectives.py): the
+        # per-TENSOR precision choice — int8/fp8 wire payload at 1/4 of
+        # the bytes, error feedback carried as runtime state. "auto"
+        # takes it only when the scaled curve predicts a win; "all"
+        # mandates it. (dcn_only is a tree-leg policy — the flat path
+        # has no DCN leg to narrow.)
+        q = self.quantization
+        if q is not None and q["mode"] in ("auto", "all") \
+                and dp_degree > 1 and weight_bytes > 0:
+            qc = self._flat_wire_sync(weight_bytes, dp_degree,
+                                      q["wire"])
+            if q["mode"] == "all" or qc < t:
+                self.last_sync_wire = q["wire"]
                 if self.provenance is not None:
+                    from ..parallel.placement import wire_byte_scale
                     self._prov("sync", "coll_all_reduce",
-                               self.calib.row_key("all_reduce",
-                                                  dp_degree,
-                                                  weight_bytes))
-                return float(t)
-        n0 = len(self.provenance) if self.provenance is not None else 0
-        t = self.xfer_cost(weight_bytes, "all_reduce", dp_degree)
-        if self.provenance is not None:
-            # the fallthrough priced through xfer_cost, but this IS the
-            # gradient sync — the drift entry diffs it under "sync"
-            for row in self.provenance[n0:]:
-                row["term"] = "sync"
+                               self.calib.row_key(
+                                   "all_reduce", dp_degree,
+                                   weight_bytes
+                                   * wire_byte_scale(q["wire"]))
+                               if self.calib is not None else None,
+                               None)
+                    self.provenance[-1]["wire"] = q["wire"]
+                return qc
         return t

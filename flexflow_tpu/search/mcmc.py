@@ -144,6 +144,12 @@ class StrategySimulator:
                     "mem_bytes": l_mem,
                     "total_s": cm.forward_time + cm.backward_time
                     + l_xfer + l_sync}
+                if l_sync > 0:
+                    # wire dtype the sync was priced at (same contract
+                    # as unity's entries — "float32" unless quantized)
+                    e["sync_wire"] = getattr(self.cost,
+                                             "last_sync_wire",
+                                             "float32")
                 prov = self.cost.provenance
                 if prov:
                     e["calib"] = list(prov)

@@ -43,6 +43,18 @@ def optimize_strategy(ff):
     cost_model.segment_size = max(1, cfg.simulator_segment_size)
     cost_model.max_segments = max(1, cfg.simulator_max_num_segments)
     _attach_placement(cfg, cost_model, dmesh)
+    # quantized gradient collectives (ops/quantized_collectives.py,
+    # arXiv 2506.17615): with the policy attached the search scores
+    # every grad-sync site with its slow legs optionally narrowed to
+    # the wire dtype, so precision is a dimension of the ranking —
+    # per-tensor on flat syncs, per-phase on the reduction trees. Off
+    # (the default) keeps every prediction bit-identical.
+    from ..ops.quantized_collectives import (resolve_qsync_mode,
+                                             resolve_qsync_wire)
+    _qsync_mode = resolve_qsync_mode(cfg)
+    if _qsync_mode != "off":
+        cost_model.attach_quantization(_qsync_mode,
+                                       resolve_qsync_wire(cfg))
     # overlap-aware scoring (FFConfig.overlap / FF_OVERLAP): gradient
     # sync is priced at its EXPOSED cost — what the executor's bucketed
     # schedule (runtime/overlap.py) cannot hide behind backward compute
@@ -85,7 +97,15 @@ def optimize_strategy(ff):
             from .calibration import calibrate_mesh, calibration_enabled
             if calibration_enabled(cfg):
                 try:
-                    cost_model.attach_calibration(calibrate_mesh(dmesh))
+                    # quantized collectives on: additionally measure
+                    # the wire-dtype rows (int8/fp8) so the precision
+                    # choice is grounded in measured narrow-payload
+                    # collectives, not just itemsize scaling
+                    wires = ()
+                    if _qsync_mode != "off":
+                        wires = (resolve_qsync_wire(cfg),)
+                    cost_model.attach_calibration(
+                        calibrate_mesh(dmesh, wire_dtypes=wires))
                 except Exception:  # noqa: BLE001 — best-effort
                     pass
     t0 = time.perf_counter()

@@ -56,6 +56,11 @@ def save_strategy(path: str, strategy: ShardingStrategy,
         doc["collective_trees"] = list(strategy.collective_trees)
     if getattr(strategy, "zero", None) is not None:
         doc["zero"] = strategy.zero.to_json()
+    if getattr(strategy, "qsync", None) is not None:
+        # per-tensor/per-phase quantized grad-sync plan
+        # (ops/quantized_collectives.py): --import honors it verbatim
+        # and ffcheck --verify-strategies runs the qsync check on it
+        doc["qsync"] = strategy.qsync.to_json()
     if getattr(strategy, "overlap", None):
         # the bucketed grad-sync schedule (runtime/overlap.py): round-
         # trips so --import pins the audited schedule verbatim and
@@ -477,6 +482,9 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
     if doc.get("zero"):
         from ..runtime.zero import ZeroAssignment
         st.zero = ZeroAssignment.from_json(doc["zero"])
+    if doc.get("qsync"):
+        from ..ops.quantized_collectives import QsyncPlan
+        st.qsync = QsyncPlan.from_json(doc["qsync"])
     if doc.get("overlap"):
         st.overlap = dict(doc["overlap"])
     if doc.get("banks"):

@@ -217,6 +217,14 @@ class GraphCostEvaluator:
                     "sync_s": ns, "mem_bytes": nmem,
                     "total_s": fwd + bwd + nx + ns
                     + self.mem_lambda * nmem}
+                if ns > 0:
+                    # the wire dtype this site's gradient collective was
+                    # priced at ("float32" unless a quantized-
+                    # collectives policy narrowed it) — drift detection
+                    # attributes quantized rows by it
+                    e["sync_wire"] = getattr(self.cost,
+                                             "last_sync_wire",
+                                             "float32")
                 prov = self.cost.provenance
                 if prov:
                     e["calib"] = list(prov)

@@ -116,6 +116,15 @@ def _scripted(default_probe_results):
                     "mem_ratio": 0.3469, "dp_degree": 4,
                     "n_sharded_params": 2, "step_time_ratio": 1.01,
                     "ok": True}, None
+        if stage == "quantized_sync":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"baseline_vs_quantized": 1.21,
+                    "rounds": [1.15, 1.21, 1.3],
+                    "loss_gap": 2e-05, "bitexact_off": True,
+                    "n_quantized": 6, "runtime_on": True,
+                    "ok": True}, None
         raise AssertionError(f"unexpected stage {args}")
 
     return fake_run_stage, calls

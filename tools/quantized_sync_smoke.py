@@ -1,0 +1,154 @@
+"""CI quantized-collectives parity smoke (ci.sh fast tier, ISSUE 15).
+
+Three gates on the 8-virtual-device mesh, on a BERT encoder (the
+bert_base architecture at smoke scale — base head/FFN ratios, reduced
+depth/width so the fast tier stays fast; dropout off so the only
+difference between the legs is the sync precision):
+
+  1. **bit-exact off** — with ``quantized_collectives=off`` (the
+     default) the training path is byte-for-byte the legacy one: two
+     runs produce IDENTICAL loss histories, and so does a run of this
+     build vs the flag never having existed (the implicit GSPMD sync).
+  2. **bit-comparable auto** — ``quantized_collectives=auto`` must
+     adopt a plan that actually quantizes something, run the explicit
+     int8 sync with error feedback, and converge with the baseline:
+     per-step relative loss gap within tolerance and the SAME
+     monotonic trend.
+  3. **import honors the plan verbatim** — the exported strategy
+     carries the qsync section; re-importing it re-adopts the exact
+     per-tensor, per-phase wire choice.
+
+    python tools/quantized_sync_smoke.py
+"""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_"
+                                 "count=8").strip()
+
+STEPS = 6
+BATCH, SEQ = 16, 32
+REL_TOL = 0.08      # per-step relative loss gap, quantized vs baseline
+
+
+def bert_cfg():
+    from flexflow_tpu.models import BertConfig
+    # bert_base ratios (heads = hidden/64, ffn = 4x hidden) at smoke
+    # scale; dropout off so precision is the only degree of freedom
+    return BertConfig(vocab_size=2048, hidden_size=128, num_layers=2,
+                      num_heads=2, intermediate_size=512,
+                      max_position=SEQ, dropout=0.0, num_labels=4)
+
+
+def build(mode: str, import_file=None, export_file=None):
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel
+    from flexflow_tpu.models import build_bert
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    # --import takes the search path (only_data_parallel would bypass
+    # the file entirely); everything else trains the canonical DP plan
+    cfg.only_data_parallel = not import_file
+    cfg.quantized_collectives = mode
+    cfg.seed = 7
+    if import_file:
+        cfg.import_strategy_file = import_file
+    ff = FFModel(cfg)
+    out = build_bert(ff, BATCH, SEQ, bert_cfg())
+    ff.compile(AdamOptimizer(0.005), "sparse_categorical_crossentropy",
+               [], output_tensor=out)
+    if export_file:
+        from flexflow_tpu.search.serialization import save_strategy
+        save_strategy(export_file, ff.strategy)
+    return ff
+
+
+def batch():
+    import numpy as np
+    rng = np.random.default_rng(1)
+    return {
+        "input_ids": rng.integers(0, 2048, size=(BATCH, SEQ)
+                                  ).astype(np.int32),
+        "position_ids": np.tile(np.arange(SEQ, dtype=np.int32),
+                                (BATCH, 1)),
+        "label": rng.integers(0, 4, size=(BATCH, 1)).astype(np.int32),
+    }
+
+
+def run(ff, steps=STEPS):
+    import numpy as np
+    b = batch()
+    step = ff.executor.make_train_step()
+    return [float(np.asarray(ff._run_train_step(step, b)["loss"]))
+            for _ in range(steps)]
+
+
+def main():
+    import jax
+    n = len(jax.devices())
+    if n != 8:
+        raise SystemExit(f"expected the 8-virtual-device mesh, got {n}")
+
+    # -- gate 1: flag off is bit-exact --------------------------------
+    losses_off_a = run(build("off"))
+    losses_off_b = run(build("off"))
+    if losses_off_a != losses_off_b:
+        raise SystemExit(f"off-mode runs diverge (nondeterminism):\n"
+                         f"  {losses_off_a}\n  {losses_off_b}")
+
+    # -- gate 2: auto adopts, runs the explicit sync, converges -------
+    with tempfile.TemporaryDirectory() as d:
+        export = os.path.join(d, "qsync_strategy.json")
+        ff_q = build("auto", export_file=export)
+        plan = ff_q.strategy.qsync
+        if plan is None or not plan.quantized_params():
+            raise SystemExit("auto mode adopted no quantized syncs — "
+                             "the parity gate would be vacuous")
+        if ff_q.executor._qsync is None:
+            raise SystemExit("plan adopted but the runtime schedule "
+                             "did not resolve (implicit-sync fallback)")
+        losses_q = run(ff_q)
+        for i, (lq, lb) in enumerate(zip(losses_q, losses_off_a)):
+            gap = abs(lq - lb) / max(abs(lb), 1e-9)
+            if gap > REL_TOL:
+                raise SystemExit(
+                    f"quantized-vs-baseline loss gap {gap:.4f} at step "
+                    f"{i} exceeds {REL_TOL}:\n  quantized: {losses_q}\n"
+                    f"  baseline:  {losses_off_a}")
+        if not losses_q[-1] < losses_q[0]:
+            raise SystemExit(f"quantized run is not converging: "
+                             f"{losses_q}")
+
+        # -- gate 3: --import honors the plan verbatim ----------------
+        with open(export) as f:
+            doc = json.load(f)
+        if not doc.get("qsync"):
+            raise SystemExit("exported strategy carries no qsync "
+                             "section")
+        ff_i = build("off", import_file=export)
+        plan_i = ff_i.strategy.qsync
+        if plan_i is None or plan_i.to_json() != plan.to_json():
+            raise SystemExit("imported strategy does not carry the "
+                             "exported qsync plan verbatim")
+        if ff_i.executor._qsync is None:
+            raise SystemExit("imported plan did not resolve a runtime "
+                             "schedule")
+
+    s = plan.summary()
+    print(f"quantized sync smoke OK: {s['n_quantized']}/{s['n_params']}"
+          f" grad syncs on wire {s['wire']}, {STEPS} steps within "
+          f"{REL_TOL:.0%} of the full-precision baseline "
+          f"(final {losses_q[-1]:.6f} vs {losses_off_a[-1]:.6f}), "
+          f"off-mode bit-exact, import verbatim")
+
+
+if __name__ == "__main__":
+    main()

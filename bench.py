@@ -1227,6 +1227,204 @@ def stage_quantized_sync(steps: int):
                       and loss_gap <= 0.05 and ratio >= 1.0)})
 
 
+def stage_serving_plan(budget: int, steps: int):
+    """Serving-plan leg (ISSUE 16 acceptance): on the 8-virtual-device
+    2-slice mesh, decode-step latency under the inference-native
+    searched per-bucket serving plans vs the REUSED-TRAINING-PLAN
+    baseline (the pre-serving-search deployment: the training search's
+    adopted strategy served at every batch size). Three gates:
+
+      - **bit-exact** (HARD): every bucket's greedy decode under the
+        serving plan matches the baseline token-for-token — plans are
+        placement, never math;
+      - **decode-step latency** (HARD): paired interleaved rounds per
+        bucket, min-of-round per-token decode latency read from the
+        ``ff_decode_step_seconds`` histogram (decode phase only — the
+        objective the search ranks by, prefill excluded), median of
+        baseline/searched ratios across (bucket x round) >= 1.0;
+      - **KV envelope gate binds** (HARD): at an HBM budget pinned
+        between the sharded- and replicated-KV envelopes of the
+        largest bucket, the sharded variant verifies and the
+        replicated one fails typed (seam ``serving-memory``) — the
+        bucket is rejected at verify time, not OOM at request time.
+    """
+    _apply_platform_env()
+    import copy
+    import statistics
+    import tempfile
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models.nlp import GPTConfig, build_gpt2
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.serving_plan import (optimize_serving_strategy,
+                                                  save_serving_plan)
+    from flexflow_tpu.obs.metrics_registry import REGISTRY
+
+    BUCKETS = (1, 4, 8)
+    SEQ = 32
+    PLEN = 8
+    MAX_NEW = 16
+
+    def spec2():
+        spec = MachineSpec.detect()
+        spec.num_devices = 8
+        spec.num_slices = 2
+        spec.num_hosts = 2
+        spec.dcn_bandwidth_gbps = 1.0
+        spec.dcn_latency_us = 20.0
+        return spec
+
+    def build(mutate=None):
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        cfg.seed = 1
+        cfg.only_data_parallel = True
+        if mutate is not None:
+            mutate(cfg)
+        ff = FFModel(cfg)
+        out = build_gpt2(ff, 8, SEQ, GPTConfig.tiny())
+        ff.compile(SGDOptimizer(0.0), "identity", [],
+                   machine_spec=spec2(), output_tensor=out)
+        return ff
+
+    # baseline: the TRAINING search's plan, reused for serving — what a
+    # deployment without the serving mode degrades to
+    def searched_train(cfg):
+        cfg.only_data_parallel = False
+        cfg.search_budget = max(budget, 8)
+    ff_base = build(searched_train)
+
+    # serving: one searched plan per bucket, adopted via the production
+    # load path (build_serving_plan_session) with the measured decode
+    # floor guard ON — a bucket whose searched plan measures slower
+    # than the reused-training-plan degradation keeps the baseline,
+    # exactly what a deployment with the guard serves
+    from flexflow_tpu.serving.session import (InferenceSession,
+                                              build_serving_plan_session)
+    plan = optimize_serving_strategy(ff_base, buckets=BUCKETS,
+                                     budget=max(budget * 10, 80))
+    fd, plan_path = tempfile.mkstemp(suffix=".serving.json")
+    os.close(fd)
+
+    def build_session(sf, buckets=BUCKETS):
+        if not sf:
+            return InferenceSession(ff_base, list(buckets))
+        ff = build(lambda c, sf=sf: (
+            setattr(c, "only_data_parallel", False),
+            setattr(c, "import_strategy_file", sf)))
+        return InferenceSession(ff, list(buckets))
+
+    try:
+        save_serving_plan(plan_path, plan)
+        serving = build_serving_plan_session(plan_path, build_session,
+                                             floor_guard="on")
+    finally:
+        os.unlink(plan_path)
+    serving_ffs = {b: serving.session_for(b).ff for b in BUCKETS}
+
+    # -- gate 1: bit-exact greedy decode at every bucket ---------------
+    rng = np.random.default_rng(0)
+    prompts = {}
+    bitexact = True
+    for b in BUCKETS:
+        ids = np.zeros((b, SEQ), np.int32)
+        ids[:, :PLEN] = rng.integers(1, 500, (b, PLEN))
+        prompts[b] = ids
+        got = np.asarray(serving_ffs[b].generate(ids, PLEN, MAX_NEW,
+                                                 temperature=0.0))
+        want = np.asarray(ff_base.generate(ids, PLEN, MAX_NEW,
+                                           temperature=0.0))
+        bitexact = bitexact and bool(np.array_equal(got, want))
+
+    # -- gate 2: paired decode-step latency ----------------------------
+    # the warm-up generates above compiled every program; each timed
+    # call reads its own decode-phase latency from the histogram the
+    # KV-decode path observes (prefill excluded — the serving objective
+    # prices prefill once, decode per token)
+    hist = REGISTRY.histogram("ff_decode_step_seconds",
+                              "Per-token decode-step latency by batch "
+                              "bucket")
+
+    def decode_latency(ff, b):
+        s0 = hist.sum(bucket=str(b))
+        ff.generate(prompts[b], PLEN, MAX_NEW, temperature=0.0)
+        return hist.sum(bucket=str(b)) - s0
+
+    rounds = max(steps // 4, 4)
+    reps = 3
+    ratios = []
+    per_bucket = {}
+    for b in BUCKETS:
+        if serving_ffs[b] is ff_base:
+            # the floor guard adopted the baseline at this bucket: the
+            # deployed program IS the baseline program, so its decode-
+            # step ratio is identically 1 — timing one object against
+            # itself would only report scheduler noise
+            per_bucket[str(b)] = 1.0
+            ratios.extend([1.0] * rounds)
+            continue
+        bucket_ratios = []
+        for _ in range(rounds):
+            # interleaved, min-of-reps per side: host-load noise is
+            # one-sided on the 2-core box (stage_virtual's rationale)
+            t_s = min(decode_latency(serving_ffs[b], b)
+                      for _ in range(reps))
+            t_b = min(decode_latency(ff_base, b) for _ in range(reps))
+            bucket_ratios.append(t_b / max(t_s, 1e-12))
+        per_bucket[str(b)] = round(statistics.median(bucket_ratios), 4)
+        ratios.extend(bucket_ratios)
+    ratio = statistics.median(ratios)
+
+    # -- gate 3: the KV envelope gate binds ----------------------------
+    from flexflow_tpu.analysis.plan_verifier import (PlanReport,
+                                                     _check_serving,
+                                                     serving_envelope)
+    block = plan.to_block()
+    big = max(plan.buckets)
+    sub = block["buckets"][str(big)]
+
+    def kv_variant(deg):
+        v = copy.deepcopy(sub)
+        for kv in v["kv"].values():
+            kv["shard_degree"] = deg
+            kv["bytes"] = (2 * big * block["max_seq"]
+                           * kv["num_kv_heads"] * kv["head_dim"]
+                           * 4) // deg
+        return v
+
+    by_name = {l.name: l for l in ff_base.layers}
+    axes = dict(ff_base.dmesh.axis_sizes)
+    shard, repl = kv_variant(2), kv_variant(1)
+    hbm = (serving_envelope(shard, big, by_name, axes)["envelope_bytes"]
+           + serving_envelope(repl, big, by_name,
+                              axes)["envelope_bytes"]) / 2.0
+
+    def check(variant):
+        rep = PlanReport()
+        _check_serving(rep, {"version": 1, "max_seq": block["max_seq"],
+                             "decode_tokens": block["decode_tokens"],
+                             "buckets": {str(big): variant}},
+                       by_name, axes, ff_base.dmesh.spec, hbm)
+        return rep
+    gate_binds = bool(
+        check(shard).ok()
+        and any(f.seam == "serving-memory" for f in check(repl).errors))
+
+    predicted = {str(b): round(p.cost.decode_step * 1e6, 2)
+                 for b, p in sorted(plan.buckets.items())}
+    guard = {str(b): rec.get("adopted")
+             for b, rec in serving.floor_guard.items()
+             if isinstance(rec, dict)}
+    _emit({"decode_ratio": round(ratio, 4),
+           "per_bucket_ratio": per_bucket,
+           "predicted_decode_us": predicted,
+           "floor_guard": guard,
+           "bitexact": bitexact,
+           "kv_gate_binds": gate_binds,
+           "buckets": list(BUCKETS),
+           "ok": bool(bitexact and gate_binds and ratio >= 1.0)})
+
+
 def stage_serving_overload(steps: int):
     """Serving-overload leg (ISSUE 5 acceptance): goodput (requests
     completed WITHIN their deadline per second) at 2x offered load,
@@ -1695,6 +1893,33 @@ def main():
         else:
             errors.append(f"quantized_sync: {err}")
 
+    # -- stage 5.48: inference-native serving plans (2-slice mesh) ----
+    # ISSUE 16 acceptance: per-bucket serving plans searched under the
+    # decode-aware objective must decode bit-exactly vs the reused-
+    # training-plan baseline, the paired median-of-ratios decode-step
+    # latency must clear the 1.0 floor, and the KV-cache envelope gate
+    # must bind (replicated-KV fails typed where sharded-KV fits)
+    if remaining() > 120:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            xf = (xf + " --xla_force_host_platform_device_count=8").strip()
+        spenv = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": xf,
+                 "FF_CALIBRATION_V2": "1"}
+        sp, err = stage(["--stage", "serving_plan", "--steps", "16",
+                         "--budget", "12"], 300, spenv)
+        if sp is not None:
+            out["serving_plan_decode_ratio"] = sp["decode_ratio"]
+            out["serving_plan_bitexact"] = sp["bitexact"]
+            out["serving_plan_kv_gate"] = sp["kv_gate_binds"]
+            if not sp["ok"]:
+                errors.append(
+                    f"serving_plan: bitexact={sp['bitexact']} "
+                    f"kv_gate={sp['kv_gate_binds']} decode ratio "
+                    f"{sp['decode_ratio']} (gate >= 1.0, per bucket "
+                    f"{sp['per_bucket_ratio']})")
+        else:
+            errors.append(f"serving_plan: {err}")
+
     # -- stage 5.445: per-parameter ZeRO memory ratio -----------------
     # ISSUE 10 acceptance: the searched optimizer-state sharding must
     # measurably shrink per-device opt-state bytes — ratio <= 0.6 at
@@ -1853,6 +2078,8 @@ if __name__ == "__main__":
         stage_recovery(a.steps)
     elif a.stage == "serving_overload":
         stage_serving_overload(a.steps)
+    elif a.stage == "serving_plan":
+        stage_serving_plan(a.budget, a.steps)
     elif a.stage == "zero_memory":
         stage_zero_memory(a.steps)
     elif a.stage == "quantized_sync":

@@ -84,6 +84,13 @@ case "${1:-fast}" in
     # finish in-flight requests before the process exits
     FF_FAULT_PLAN="infer_fail@0;infer_fail@1;infer_fail@2" \
       python tools/serving_chaos_smoke.py
+    # serving-plan smoke: the inference-native search produces one
+    # verified sub-strategy per batch bucket (KV cache inside the
+    # memory envelope), the checked-in gpt2 serving artifact passes the
+    # static verifier, the KV envelope gate BINDS (replicated-KV fails
+    # typed where sharded-KV fits), and per-bucket instances decode
+    # BIT-IDENTICALLY to the training-plan baseline session
+    python tools/serving_plan_smoke.py
     # distributed resilience smoke: a 2-process CPU world trains under
     # the WorldSupervisor, rank 1 is fault-injected to hard-crash
     # mid-epoch, the world must re-form (relaunch or shrink) and resume

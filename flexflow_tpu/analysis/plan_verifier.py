@@ -80,7 +80,7 @@ from ..obs.metrics_registry import REGISTRY
 
 __all__ = ["Finding", "PlanReport", "PlanVerificationError",
            "StructMesh", "memory_envelope", "verify_plan",
-           "verify_model", "verify_strategy_file"]
+           "verify_model", "verify_serving_plan", "verify_strategy_file"]
 
 
 # ---------------------------------------------------------------------------
@@ -316,6 +316,10 @@ def verify_plan(strategy, layers: Sequence, *,
                  axis_sizes, have_layers=bool(by_name),
                  known_layers=set(by_name),
                  unaddressable=unaddressable)
+    serving_doc = getattr(strategy, "serving", None)
+    if serving_doc:
+        _check_serving(report, serving_doc, by_name, axis_sizes, spec,
+                       hbm_bytes)
 
     report.duration_s = time.perf_counter() - t0
     REGISTRY.counter("ff_plan_verify_runs_total",
@@ -1238,6 +1242,187 @@ def _check_placement(report, axis_tiers, collective_trees, axis_sizes,
 # wiring helpers
 # ---------------------------------------------------------------------------
 
+# -- check 8: per-(model, batch-class) serving plans --------------------------
+
+def _check_serving(report, serving_doc, by_name, axis_sizes, spec,
+                   hbm_bytes) -> None:
+    """Serving-block soundness: every bucket's KV-cache shard degree
+    must divide the layer's KV-head count (a decode step cannot split
+    a KV head across devices), the recorded per-layer KV bytes must
+    match the declared geometry, each bucket's op specs must be
+    mesh-sound, and the decode-resident envelope (weights + KV cache +
+    live activations) at the LARGEST bucket must fit the machine's
+    HBM. The memory gate is what makes a replicated-KV plan that only
+    fits sharded fail typed at compile instead of OOMing on the first
+    large-bucket request. ``serving_doc`` is always the JSON block
+    (``ServingPlan.to_block`` form) — both the in-memory attach and
+    ``load_strategy`` carry it that way."""
+    from ..dtypes import itemsize as _isz
+    try:
+        buckets = {int(k): (v or {}) for k, v in
+                   (serving_doc.get("buckets") or {}).items()}
+    except (TypeError, ValueError):
+        report.add("serving", "error", "<serving>",
+                   "serving block bucket keys must be integers",
+                   "serving-plan")
+        return
+    if not buckets:
+        report.add("serving", "error", "<serving>",
+                   "serving block carries no buckets", "serving-plan")
+        return
+    max_seq = int(serving_doc.get("max_seq") or 0)
+    if max_seq <= 0:
+        report.add("serving", "error", "<serving>",
+                   "serving block has no max_seq (KV geometry is "
+                   "unsized)", "serving-plan")
+        return
+    for bucket, sub in sorted(buckets.items()):
+        ctx = f"bucket={bucket}"
+        for name, os_ in (sub.get("ops") or {}).items():
+            layer = by_name.get(name)
+            for i, sp in enumerate(os_.get("outputs") or ()):
+                if sp is None:
+                    continue
+                shape = None
+                if layer is not None and i < len(layer.outputs):
+                    shape = layer.outputs[i].shape
+                _check_spec(report, axis_sizes, name,
+                            f"serving[{ctx}] output[{i}]",
+                            _json_spec(sp), shape)
+            wsh = {w.name: tuple(w.shape)
+                   for w in (getattr(layer, "weights", None) or ())}
+            for wname, sp in (os_.get("weights") or {}).items():
+                if sp is None:
+                    continue
+                _check_spec(report, axis_sizes, name,
+                            f"serving[{ctx}] weight {wname!r}",
+                            _json_spec(sp), wsh.get(wname),
+                            seam="checkpoint-restore")
+        for name, kv in (sub.get("kv") or {}).items():
+            kv = kv or {}
+            deg = int(kv.get("shard_degree") or 1)
+            kvh = int(kv.get("num_kv_heads") or 0)
+            hd = int(kv.get("head_dim") or 0)
+            if by_name and name not in by_name:
+                report.add("serving", "error", name,
+                           f"serving[{ctx}]: KV entry names a layer "
+                           f"absent from the program", "serving-kv")
+                continue
+            if deg < 1 or kvh <= 0 or kvh % deg != 0:
+                report.add(
+                    "serving", "error", name,
+                    f"serving[{ctx}]: KV shard degree {deg} does not "
+                    f"divide num_kv_heads {kvh} — a decode step cannot "
+                    f"split a KV head across devices", "serving-kv")
+                continue
+            want = (2 * bucket * max_seq * kvh * hd * 4) // deg
+            got = int(kv.get("bytes") or 0)
+            if got and hd and got != want:
+                report.add(
+                    "serving", "error", name,
+                    f"serving[{ctx}]: recorded KV bytes {got} disagree "
+                    f"with the geometry 2*{bucket}*{max_seq}*{kvh}*"
+                    f"{hd}*4/{deg} = {want}", "serving-kv")
+    # decode-resident envelope at the LARGEST bucket. Needs the layer
+    # list for weight/output shapes; spec-only strategy files verify
+    # structurally above and skip the gate.
+    if not hbm_bytes:
+        hbm_bytes = getattr(spec, "hbm_bytes", None)
+    if not by_name or not hbm_bytes:
+        return
+    bucket = max(buckets)
+    env = serving_envelope(buckets[bucket], bucket, by_name, axis_sizes)
+    total = env["envelope_bytes"]
+    act_op = env["peak_activation_op"]
+    if total > hbm_bytes:
+        report.add(
+            "serving", "error", act_op or "<model>",
+            f"serving envelope at bucket {bucket} "
+            f"{total / 2**20:.1f} MiB exceeds the machine model's "
+            f"{hbm_bytes / 2**20:.1f} MiB HBM (weights "
+            f"{env['weights_bytes'] / 2**20:.1f} MiB + KV cache "
+            f"{env['kv_bytes'] / 2**20:.1f} MiB + 2 x peak activation "
+            f"{env['peak_activation_bytes'] / 2**20:.1f} MiB [{act_op}])"
+            f" — shard the KV cache (head-parallel attention) or drop "
+            f"the bucket", "serving-memory")
+
+
+def serving_envelope(sub: Dict, bucket: int, by_name: Dict,
+                     axis_sizes: Dict[str, int]) -> Dict[str, float]:
+    """Decode-resident per-device envelope of ONE bucket's serving
+    sub-strategy (``ServingPlan.to_block()`` bucket form): sharded
+    weights + resident KV cache + a live fwd activation pair, with
+    activations rescaled from the compile batch to the bucket. No
+    grads/optimizer terms — serving is forward-only. Shared by
+    ``_check_serving``'s HBM gate and the serving search/smoke, so a
+    plan adopted by the search verifies against the same arithmetic."""
+    from ..dtypes import itemsize as _isz
+    ops_doc = sub.get("ops") or {}
+    params_local = 0.0
+    kv_local = float(sum(int((kv or {}).get("bytes") or 0)
+                         for kv in (sub.get("kv") or {}).values()))
+    act_peak, act_op = 0.0, ""
+    for name, layer in by_name.items():
+        os_ = ops_doc.get(name) or {}
+        wspecs = os_.get("weights") or {}
+        for w in layer.weights or ():
+            total = float(int(np.prod(w.shape)) or 1) * _isz(w.dtype)
+            sp = wspecs.get(w.name)
+            deg = _spec_degree(_json_spec(sp), axis_sizes) if sp else 1
+            params_local += total / max(deg, 1)
+        outs = os_.get("outputs") or ()
+        local = 0.0
+        for i, t in enumerate(layer.outputs):
+            total = float(int(np.prod(t.shape)) or 1) * _isz(t.dtype)
+            if t.shape and t.shape[0]:
+                # activations were shaped at the compile batch; the
+                # serving bucket is what is live at runtime
+                total *= bucket / float(t.shape[0])
+            sp = outs[i] if i < len(outs) else None
+            deg = _spec_degree(_json_spec(sp), axis_sizes) if sp else 1
+            local += total / max(deg, 1)
+        if local > act_peak:
+            act_peak, act_op = local, name
+    return {
+        "weights_bytes": params_local,
+        "kv_bytes": kv_local,
+        "peak_activation_bytes": act_peak,
+        "peak_activation_op": act_op,
+        "envelope_bytes": params_local + kv_local + 2 * act_peak,
+    }
+
+
+def verify_serving_plan(plan, layers: Sequence, dmesh, *,
+                        hbm_bytes: Optional[float] = None,
+                        context: str = "") -> PlanReport:
+    """Verify a searched :class:`~flexflow_tpu.search.serving_plan.
+    ServingPlan` (or its serialized ``serving`` block) against the
+    program and mesh it was searched for. Raises a typed
+    :class:`PlanVerificationError` on error findings — called by
+    ``optimize_serving_strategy`` before a plan is exported and by the
+    serving smoke gate."""
+    t0 = time.perf_counter()
+    report = PlanReport()
+    axis_sizes: Dict[str, int] = dict(getattr(dmesh, "axis_sizes", {}))
+    spec = getattr(dmesh, "spec", None)
+    by_name = {l.name: l for l in layers}
+    block = plan.to_block() if hasattr(plan, "to_block") else dict(plan)
+    _check_serving(report, block, by_name, axis_sizes, spec, hbm_bytes)
+    report.duration_s = time.perf_counter() - t0
+    REGISTRY.counter("ff_plan_verify_runs_total",
+                     "Static plan verification passes").inc()
+    for f in report.findings:
+        REGISTRY.counter("ff_plan_verify_findings_total",
+                         "Plan verification findings by check"
+                         ).inc(check=f.check)
+    obs_events.record_span("plan_verify.serving", t0, report.duration_s,
+                           findings=len(report.findings),
+                           errors=len(report.errors),
+                           context=context or "")
+    report.raise_if_failed(context or "the serving plan")
+    return report
+
+
 def verify_model(model) -> PlanReport:
     """Verify a compiled-to-the-strategy :class:`FFModel` (called from
     ``FFModel.compile`` post-search). Raises
@@ -1409,6 +1594,13 @@ def verify_strategy_file(path: str, doc: Optional[Dict] = None
                 op_types[ls["name"]] = None
         _check_overlap(report, ovdoc, grouped=grouped, pos=pos,
                        op_types=op_types, have_layers=bool(op_types))
+    # per-(model, batch-class) serving block (doc["serving"]): bucket
+    # structure, per-bucket spec soundness, and KV-shard/GQA
+    # divisibility — the envelope gate needs live layer shapes and is
+    # enforced at compile/search time instead
+    sdoc = doc.get("serving")
+    if sdoc:
+        _check_serving(report, sdoc, {}, axis_sizes, spec, None)
     report.duration_s = time.perf_counter() - t0
     return report
 

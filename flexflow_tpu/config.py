@@ -256,10 +256,38 @@ class FFConfig:
     # expensive there and tests exercise the guard explicitly).
     search_floor_guard: str = "auto"   # "auto" | "true" | "false"
     floor_guard_steps: int = 3
+    # -------- serving plans (search/serving_plan.py) --------
+    # batch classes the serving search targets, csv ("1,4,16,64");
+    # "" = the InferenceSession defaults. One plan is searched per
+    # bucket (mode="serving" of optimize_strategy).
+    serving_buckets: str = ""
+    # KV-cache sequence envelope the serving plans budget for;
+    # 0 = the graph's compile-time sequence length
+    serving_max_seq: int = 0
+    # decode weight of the serving objective (prefill +
+    # decode_tokens x decode-step latency); 0 = serving_max_seq
+    serving_decode_tokens: int = 0
+    # serving-plan artifact for ModelRepository load paths (a strategy
+    # JSON with a "serving" block; see docs/serving.md)
+    serving_strategy_file: str = ""
+    # measured decode floor on serving-plan adoption (the serving
+    # analog of search_floor_guard): per bucket, the imported
+    # sub-strategy is kept only if its measured decode-step latency
+    # beats the no-serving-plan baseline's — a mispredicting serving
+    # cost model can never ship a per-bucket plan that decodes slower
+    # than the plan it replaces. "auto" = on off-CPU backends only.
+    serving_floor_guard: str = "auto"  # "auto" | "true" | "false"
     seed: int = 0
 
     def __post_init__(self):
         self._devices = None
+
+    def serving_buckets_list(self) -> List[int]:
+        """Parsed ``serving_buckets`` ([] = caller defaults)."""
+        if not self.serving_buckets:
+            return []
+        return sorted({int(b) for b in
+                       str(self.serving_buckets).split(",") if b})
 
     # ---- machine queries (lazy; avoids importing jax at flag-parse time) ----
     @property
@@ -467,6 +495,18 @@ class FFConfig:
                 cfg.async_dispatch_steps = 0
             elif a == "--prefetch-batches":
                 cfg.prefetch_batches = int(take())
+            elif a == "--serving-buckets":
+                cfg.serving_buckets = take()
+            elif a == "--serving-max-seq":
+                cfg.serving_max_seq = int(take())
+            elif a == "--serving-decode-tokens":
+                cfg.serving_decode_tokens = int(take())
+            elif a == "--serving-strategy":
+                cfg.serving_strategy_file = take()
+            elif a == "--serving-floor-guard":
+                cfg.serving_floor_guard = take()
+            elif a == "--compilation-cache-dir":
+                cfg.compilation_cache_dir = take()
             elif a == "--seed":
                 cfg.seed = int(take())
             # unknown flags: skip (reference forwards to Legion)

@@ -815,6 +815,16 @@ class FFModel:
         self._step = 0
         self.__dict__.setdefault("_compile_phases", {})["compile_s"] = \
             round(time.perf_counter() - _compile_t0, 6)
+        # recompile observability for the warm-start path: every program
+        # (re)build increments the per-model counter — a fleet whose
+        # persistent compilation cache is actually warm shows this flat
+        # across process restarts while compile_s collapses to the
+        # cache-hit cost
+        from .obs.metrics_registry import REGISTRY
+        REGISTRY.counter(
+            "ff_model_compiles_total",
+            "Model program compiles (trace + XLA build events)").inc(
+            model=getattr(self, "_model_name", "") or "<unnamed>")
         obs_events.record_span("model.compile", _compile_t0,
                                time.perf_counter() - _compile_t0,
                                n_devices=self.dmesh.num_devices,
@@ -1285,6 +1295,19 @@ class FFModel:
                 if kv_cache is True:
                     raise
                 kv_failed_shapes.add((b, L))
+                # the fallback is exact but O(L)-per-token — a serving
+                # deployment quietly riding it is a perf regression, so
+                # it is observable (Prometheus + /healthz), not just a
+                # warn-once log line
+                self._kv_fallback_count = getattr(
+                    self, "_kv_fallback_count", 0) + 1
+                from .obs.metrics_registry import REGISTRY
+                REGISTRY.counter(
+                    "ff_kv_fallback_total",
+                    "KV-cache decode attempts that fell back to the "
+                    "full re-forward path").inc(
+                        model=getattr(self, "_model_name", "")
+                        or "<unnamed>")
                 import logging
                 logging.getLogger("flexflow_tpu").warning(
                     "KV-cache decode failed for this graph at shape "
@@ -1316,13 +1339,22 @@ class FFModel:
         """Incremental decode: one full-sequence prefill builds the
         per-layer K/V cache, then each generated token is one seq-len-1
         forward — per-token cost independent of how many tokens have
-        been generated (the re-forward path is O(L) per token)."""
+        been generated (the re-forward path is O(L) per token).
+
+        Prefill and decode are SEPARATE jitted programs so serving can
+        observe the two phases the serving objective is built from: the
+        prefill span is the prompt cost, the decode span divided by
+        ``max_new_tokens`` is the per-token decode-step latency the
+        serving search (search/serving_plan.py) ranks plans by — and
+        what ``ff_decode_step_seconds{bucket=...}`` reports. The split
+        also lets one prefill program serve every sampling config at a
+        shape (the old fused program re-traced per temperature/top-k)."""
         ex = self.executor
         b, L = ids0.shape
         has_pos = "position_ids" in {t.name for t in self.graph_inputs}
         ragged = np.ndim(prompt_len) > 0
 
-        def decode(params, state, ids0, key0, plen):
+        def prefill(params, state, ids0, plen):
             batch = {"input_ids": ids0}
             if has_pos:
                 batch["position_ids"] = jnp.tile(
@@ -1331,6 +1363,9 @@ class FFModel:
             # needs one shared prompt length); masks stay per-row exact
             _, cache = ex.kv_prefill(params, state, batch,
                                      prefill_len=None if ragged else plen)
+            return cache
+
+        def decode(params, state, ids0, cache, key0, plen):
             done0 = jnp.zeros((b,), jnp.bool_)
 
             def step(carry, i):
@@ -1357,11 +1392,31 @@ class FFModel:
                 jnp.arange(max_new_tokens))
             return ids
 
-        ck = ("kv", b, L, max_new_tokens, float(temperature),
+        pk = ("kv_prefill", b, L, ragged)
+        dk = ("kv_decode", b, L, max_new_tokens, float(temperature),
               eos_token_id, int(top_k), float(top_p), ragged)
-        fn = self._decode_cache_get(ck, decode)
-        return fn(self.params, self.state, ids0, jax.random.key(seed),
-                  jnp.asarray(prompt_len, jnp.int32))
+        prefill_fn = self._decode_cache_get(pk, prefill)
+        decode_fn = self._decode_cache_get(dk, decode)
+        plen = jnp.asarray(prompt_len, jnp.int32)
+        from .obs import events as obs_events
+        from .obs.metrics_registry import REGISTRY
+        t0 = time.perf_counter()
+        cache = jax.block_until_ready(
+            prefill_fn(self.params, self.state, ids0, plen))
+        t1 = time.perf_counter()
+        out = jax.block_until_ready(
+            decode_fn(self.params, self.state, ids0, cache,
+                      jax.random.key(seed), plen))
+        t2 = time.perf_counter()
+        obs_events.record_span("generate.prefill", t0, t1 - t0,
+                               batch=b, seq=L)
+        obs_events.record_span("generate.decode", t1, t2 - t1,
+                               batch=b, tokens=int(max_new_tokens))
+        REGISTRY.histogram(
+            "ff_decode_step_seconds",
+            "Per-token decode-step latency by batch bucket").observe(
+            (t2 - t1) / max(int(max_new_tokens), 1), bucket=str(b))
+        return out
 
     def generate_beam(self, prompt_ids, prompt_len: int,
                       max_new_tokens: int, num_beams: int = 4,
@@ -1479,6 +1534,14 @@ class FFModel:
         fn = cache.get(ck)
         if fn is None:
             fn = cache[ck] = jax.jit(builder)
+            # a fresh decode program is a recompile event too — same
+            # per-model counter as FFModel.compile so the warm-start
+            # signal covers the generate paths
+            from .obs.metrics_registry import REGISTRY
+            REGISTRY.counter(
+                "ff_model_compiles_total",
+                "Model program compiles (trace + XLA build events)").inc(
+                model=getattr(self, "_model_name", "") or "<unnamed>")
         else:
             cache.move_to_end(ck)
         while len(cache) > self._DECODE_CACHE_CAP:

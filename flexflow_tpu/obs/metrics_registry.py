@@ -152,6 +152,11 @@ class Histogram(_Metric):
             row = self._values.get(_labelkey(labels))
             return row[-2] if row else 0.0
 
+    def sum(self, **labels) -> float:
+        with self._lock:
+            row = self._values.get(_labelkey(labels))
+            return row[-1] if row else 0.0
+
     def _render(self) -> List[str]:
         out: List[str] = []
         with self._lock:

@@ -91,6 +91,14 @@ class ShardingStrategy:
         # strategy (--import honors it verbatim) and is statically
         # checked by analysis/plan_verifier's qsync pass.
         self.qsync = None
+        # per-(model, batch-class) serving plans (search/serving_plan.py
+        # ServingPlan.to_block() JSON): one sub-strategy per batch
+        # bucket + the KV-cache geometry/shard degrees. None for
+        # training strategies. Serializes as the artifact's "serving"
+        # block and is statically checked by analysis/plan_verifier's
+        # serving pass (KV sharding sound, envelope fits at the largest
+        # bucket).
+        self.serving = None
 
     # ------------------------------------------------------------------
     def set_op(self, layer_name: str, outputs: Sequence[Optional[P]],

@@ -1057,3 +1057,36 @@ class OpCostModel:
                     self.provenance[-1]["wire"] = q["wire"]
                 return qc
         return t
+
+    # ------------------------------------------------------------------
+    # serving objective (search/serving_plan.py)
+    # ------------------------------------------------------------------
+    def decode_collective_cost(self, volume_bytes: float,
+                               collective: str, degree: int,
+                               axes: Optional[Tuple[str, ...]] = None
+                               ) -> float:
+        """Latency-side price of ONE decode-step collective.
+
+        Decode-step payloads are tiny ((bucket × hidden) activations at
+        seq-len 1) and fire once per generated token — XLA cannot
+        coalesce them across tokens the way the gradient-sync combiner
+        batches per-layer reductions, so the per-dispatch floor and
+        per-hop latency terms dominate. Routes through ``xfer_cost``
+        (calibrated small-message table rows, placement/tree selection,
+        dispatch floor) — deliberately NOT the bandwidth-marginal
+        ``weight_sync_cost``/``collective_marginal`` path, which prices
+        exactly the coalescing decode does not get."""
+        return self.xfer_cost(volume_bytes, collective, degree,
+                              axes=axes)
+
+    def kv_read_time(self, kv_bytes: float) -> float:
+        """HBM time to stream a resident KV cache once — the per-step
+        memory floor of autoregressive decode (every step reads the
+        full local cache). Uses the calibrated memory bandwidth when a
+        calibration is attached."""
+        if kv_bytes <= 0:
+            return 0.0
+        mem_bw = self.spec.hbm_bandwidth
+        if self.calib is not None and self.calib.mem_bw:
+            mem_bw = self.calib.mem_bw
+        return kv_bytes / max(mem_bw, 1.0)

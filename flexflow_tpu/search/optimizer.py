@@ -22,15 +22,30 @@ from .mcmc import (StrategySimulator, assignment_to_strategy,
 from .serialization import load_strategy, save_strategy
 
 
-def optimize_strategy(ff):
+def optimize_strategy(ff, mode: str = "train"):
     """ff: FFModel (post graph construction, pre executor build).
 
     Returns ``(strategy, program_info_or_None)``: Unity search may rewrite
     the graph (inserting parallel ops), in which case ``program_info``
     carries the new executable layer list — the analog of the reference's
     ``convert_graph_to_operators`` output replacing the original operators.
+
+    ``mode="serving"`` dispatches to the inference-native search
+    (search/serving_plan.py): one plan per batch bucket ranked by
+    prefill + per-token decode-step LATENCY with the KV cache resident
+    in the envelope. It requires a compiled model (the search scores
+    against the live mesh) and returns the largest bucket's strategy —
+    the full per-bucket plan lands on ``ff._serving_plan`` and in the
+    ``--export`` artifact's ``serving`` block.
     """
     cfg = ff.config
+    if mode == "serving":
+        from .serving_plan import optimize_serving_strategy
+        plan = optimize_serving_strategy(ff)
+        return plan.largest.strategy, None
+    if mode != "train":
+        raise ValueError(f"unknown strategy-search mode {mode!r} "
+                         f"(expected 'train' or 'serving')")
     dmesh = ff.dmesh
     # stale-path guard: if THIS search's audit write is skipped (tracing
     # off) or fails, the floor guard below must not annotate a previous

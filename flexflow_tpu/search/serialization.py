@@ -36,7 +36,8 @@ def _spec_from_json(j) -> Optional[P]:
 def save_strategy(path: str, strategy: ShardingStrategy,
                   assignment: Optional[Dict] = None,
                   meta: Optional[Dict] = None,
-                  program: Optional[Dict] = None):
+                  program: Optional[Dict] = None,
+                  serving: Optional[Dict] = None):
     doc = {
         "program": program,
         "mesh_axes": dict(strategy.dmesh.axis_sizes),
@@ -78,6 +79,14 @@ def save_strategy(path: str, strategy: ShardingStrategy,
                  m: dataclasses.asdict(v)
                  for m, v in g.machine_views(strategy.dmesh).items()}}
             for g in pgs]
+    if serving is None:
+        serving = getattr(strategy, "serving", None)
+    if serving:
+        # per-(model, batch-class) serving plans (search/serving_plan.py):
+        # one sub-strategy per bucket + the KV-cache geometry; --import
+        # and ModelRepository.load_* adopt them, ffcheck
+        # --verify-strategies runs the serving-block checks
+        doc["serving"] = dict(serving)
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
 
@@ -498,4 +507,9 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
         from ..parallel.banks import PlaceGroup
         st.place_groups = [PlaceGroup(list(g["members"]), g["axis"])
                            for g in doc["place_groups"]]
+    if doc.get("serving"):
+        # per-bucket serving plans ride the strategy object so the
+        # plan verifier's serving checks (KV soundness + envelope at
+        # the largest bucket) bind at compile time
+        st.serving = dict(doc["serving"])
     return st

@@ -189,6 +189,20 @@ def get_route(path: str, repo, schedulers, state: Optional[ServingState]
             serving[name] = {"circuit": sched.breaker.state,
                              "queue_depth": sched._q.qsize(),
                              "draining": sched._draining}
+            # KV-decode fallback state (satellite of the serving-plan
+            # work): a model quietly riding the O(L)-per-token
+            # re-forward path is a live perf regression a probe should
+            # see — count + the exact (batch, seq) shapes that failed
+            try:
+                ff = repo.get(name).ff
+                ex = getattr(ff, "executor", None)
+                shapes = sorted(getattr(ex, "_kv_failed_shapes", ())
+                                or ())
+                serving[name]["kv_fallback"] = {
+                    "count": int(getattr(ff, "_kv_fallback_count", 0)),
+                    "failed_shapes": [list(s) for s in shapes]}
+            except Exception:  # noqa: BLE001 — non-FF session (tests)
+                pass
         body = {"status": "draining" if draining else "ok",
                 "ready": not draining,
                 "resilience": resilience_status.health_fields(),

@@ -582,6 +582,46 @@ class BatchScheduler:
         self.close()
         return clean
 
+    def hot_swap(self, sessions, deadline_s: float = 10.0) -> bool:
+        """Replace the serving instances in place under the graceful-
+        drain protocol: admission pauses (``infer`` sheds with 503 +
+        ``Retry-After``), the admitted backlog flushes on the OLD
+        instances within ``deadline_s``, the workers restart on the new
+        ones, and admission resumes. The adoption point for a
+        re-searched serving plan (``ModelRepository.hot_swap``): no
+        admitted request is dropped, late arrivals are shed exactly as
+        during a drain. Returns True when the backlog flushed clean."""
+        if not isinstance(sessions, (list, tuple)):
+            sessions = [sessions]
+        if not sessions:
+            raise ValueError("need at least one session instance")
+        with self._stat_lock:
+            self._draining = True
+        end = time.perf_counter() + max(0.0, deadline_s)
+        while time.perf_counter() < end:
+            with self._stat_lock:
+                if self._pending == 0:
+                    break
+            time.sleep(0.005)
+        with self._stat_lock:
+            clean = self._pending == 0
+        # stop the old workers (the queue is empty or past-deadline:
+        # anything still queued re-queues onto the new workers' event)
+        self._stop.set()
+        for w in self._workers:
+            w.join(timeout=5)
+        self._stop = threading.Event()
+        self.sessions = list(sessions)
+        self.session = self.sessions[0]
+        self._workers = [
+            threading.Thread(target=self._run, args=(s,), daemon=True)
+            for s in self.sessions]
+        for w in self._workers:
+            w.start()
+        with self._stat_lock:
+            self._draining = False
+        return clean
+
     def close(self):
         """Stop the workers and promptly fail anything still queued —
         an unload must not leave clients blocked until their timeout."""

@@ -116,6 +116,18 @@ def _scripted(default_probe_results):
                     "mem_ratio": 0.3469, "dp_degree": 4,
                     "n_sharded_params": 2, "step_time_ratio": 1.01,
                     "ok": True}, None
+        if stage == "serving_plan":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"decode_ratio": 1.12,
+                    "per_bucket_ratio": {"1": 1.0, "4": 1.0, "8": 1.12},
+                    "predicted_decode_us": {"1": 12.0, "4": 17.0,
+                                            "8": 20.0},
+                    "floor_guard": {"1": "baseline", "4": "baseline",
+                                    "8": "searched"},
+                    "bitexact": True, "kv_gate_binds": True,
+                    "buckets": [1, 4, 8], "ok": True}, None
         if stage == "quantized_sync":
             assert env.get("JAX_PLATFORMS") == "cpu"
             assert "xla_force_host_platform_device_count" \
@@ -216,3 +228,8 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         assert out["serving_goodput_shed_rps"] == 52.4
         assert out["serving_goodput_base_rps"] == 3.2
         assert any(a[1] == "serving_overload" for a, _ in calls)
+        # and the inference-native serving-plan leg (ISSUE 16)
+        assert out["serving_plan_decode_ratio"] == 1.12
+        assert out["serving_plan_bitexact"] is True
+        assert out["serving_plan_kv_gate"] is True
+        assert any(a[1] == "serving_plan" for a, _ in calls)

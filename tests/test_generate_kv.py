@@ -58,7 +58,9 @@ def test_kv_is_default_for_eligible_graph():
     ids[:, 0] = 1
     ff.generate(ids, 1, 4)
     keys = list(ff.executor._decode_cache)
-    assert any(k[0] == "kv" for k in keys), keys
+    # the KV path jits prefill and decode separately (kv_prefill /
+    # kv_decode) so serving observes the two phases independently
+    assert any(str(k[0]).startswith("kv") for k in keys), keys
 
 
 def test_kv_eos_latches():

@@ -60,7 +60,9 @@ def test_fused_llama_kv_decode():
     oracle = np.asarray(ff.generate(ids, 3, 8, kv_cache=False))
     np.testing.assert_array_equal(kv[:, :11], oracle[:, :11])
     keys = list(ff.executor._decode_cache)
-    assert any(k[0] == "kv" for k in keys), keys
+    # the KV path jits prefill and decode separately (kv_prefill /
+    # kv_decode) so serving observes the two phases independently
+    assert any(str(k[0]).startswith("kv") for k in keys), keys
 
 
 def test_fused_llama_trains():

@@ -1,0 +1,452 @@
+"""Inference-native strategy search (search/serving_plan.py): the
+decode-aware cost model, per-(model, batch-class) plan search, KV-cache
+envelope verification, serialization round-trip, repository adoption
+(ServingPlanSession + measured floor guard), hot swap, and compile-cache
+warm-start wiring. Beyond-reference: the reference searches training
+strategies only and serves whatever falls out."""
+import copy
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models.nlp import GPTConfig, build_gpt2
+from flexflow_tpu.analysis.plan_verifier import (PlanVerificationError,
+                                                 serving_envelope,
+                                                 verify_serving_plan)
+from flexflow_tpu.search.serving_plan import (ServingCostEvaluator,
+                                              _serving_cost_model,
+                                              bucket_strategy_doc,
+                                              kv_cache_bytes,
+                                              kv_cache_spec,
+                                              optimize_serving_strategy,
+                                              save_serving_plan)
+
+BATCH, SEQ = 4, 16
+
+
+def _compiled(mutate=None):
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.only_data_parallel = True
+    if mutate is not None:
+        mutate(cfg)
+    g = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                  num_heads=4, max_position=SEQ, dropout=0.0)
+    ff = FFModel(cfg)
+    out = build_gpt2(ff, BATCH, SEQ, g)
+    ff.compile(SGDOptimizer(0.0), "identity", [], output_tensor=out)
+    return ff
+
+
+@pytest.fixture(scope="module")
+def ff():
+    return _compiled()
+
+
+@pytest.fixture(scope="module")
+def cost_model(ff):
+    cm = _serving_cost_model(ff, ff.dmesh)
+    # pin it so every later search in this module reuses the one
+    # calibrated model instead of re-measuring collectives
+    ff._search_cost_model = cm
+    return cm
+
+
+@pytest.fixture(scope="module")
+def plan(ff, cost_model):
+    return optimize_serving_strategy(ff, buckets=(1, 4), budget=30)
+
+
+# ---------------------------------------------------------------------------
+# cost model / evaluator
+# ---------------------------------------------------------------------------
+
+def test_kv_cache_spec_reads_attention_geometry(ff):
+    mha = [l for l in ff.layers if kv_cache_spec(l) is not None]
+    assert mha, "gpt2 graph must carry causal attention layers"
+    for l in mha:
+        spec = kv_cache_spec(l)
+        assert spec["num_kv_heads"] == 4
+        assert spec["head_dim"] == 8
+        # K + V, fp32: 2 * b * s * kvh * hd * 4, divided by the shard
+        assert kv_cache_bytes(l, 4, SEQ, 1) == 2 * 4 * SEQ * 4 * 8 * 4
+        assert kv_cache_bytes(l, 4, SEQ, 2) \
+            == kv_cache_bytes(l, 4, SEQ, 1) // 2
+
+
+def test_evaluator_rejects_bucket_indivisible_batch_degree(ff, cost_model):
+    ev = ServingCostEvaluator(ff.layers, ff.dmesh, cost_model, 1, SEQ)
+    # bucket 1: any batch-dim (sample) degree > 1 cannot divide it
+    saw_sample = False
+    for l in ff.layers:
+        opts = ev.options[l.name]
+        for i, opt in enumerate(opts):
+            if opt.kind == "sample" and opt.out_dim == 0:
+                degs = [1] * len(opts)
+                degs[i] = 2
+                assert not ev.bucket_feasible(l, degs)
+                saw_sample = True
+    assert saw_sample
+
+
+def test_serving_cost_prices_decode_and_prefill(plan):
+    for b, p in plan.buckets.items():
+        assert np.isfinite(p.cost.prefill) and p.cost.prefill > 0
+        assert np.isfinite(p.cost.decode_step) and p.cost.decode_step > 0
+        assert p.cost.kv_bytes > 0
+        # the serving objective: prefill once + decode per token
+        assert p.cost.total >= p.cost.prefill
+
+
+def test_search_never_loses_to_predicted_baseline(plan):
+    """The walk starts FROM the reused-training-plan baseline, so the
+    adopted plan can only match or beat it under the model."""
+    for b, base in plan.baseline.items():
+        assert plan.buckets[b].cost.total <= base.total * (1 + 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# verification: KV soundness + memory envelope
+# ---------------------------------------------------------------------------
+
+def test_verify_serving_plan_passes_searched_plan(ff, plan):
+    report = verify_serving_plan(plan, ff.layers, ff.dmesh)
+    assert report.ok(), [f.format() for f in report.errors]
+
+
+def test_kv_shard_degree_must_divide_kv_heads(ff, plan):
+    block = copy.deepcopy(plan.to_block())
+    big = str(max(plan.buckets))
+    kv = block["buckets"][big]["kv"]
+    name = next(iter(kv))
+    kv[name]["shard_degree"] = 3   # num_kv_heads=4: 3 does not divide
+    with pytest.raises(PlanVerificationError) as e:
+        verify_serving_plan(block, ff.layers, ff.dmesh)
+    assert any(f.seam == "serving-kv" for f in e.value.findings)
+
+
+def test_kv_bytes_must_match_geometry(ff, plan):
+    block = copy.deepcopy(plan.to_block())
+    big = str(max(plan.buckets))
+    next(iter(block["buckets"][big]["kv"].values()))["bytes"] += 1
+    with pytest.raises(PlanVerificationError) as e:
+        verify_serving_plan(block, ff.layers, ff.dmesh)
+    assert any(f.seam == "serving-kv" for f in e.value.findings)
+
+
+def test_envelope_gate_binds_between_sharded_and_replicated(ff, plan):
+    """The acceptance shape: at an HBM budget pinned between the
+    sharded-KV and replicated-KV envelopes of the largest bucket, the
+    sharded variant verifies and the replicated one fails TYPED."""
+    block = copy.deepcopy(plan.to_block())
+    big = max(plan.buckets)
+    sub = block["buckets"][str(big)]
+
+    def variant(deg):
+        v = copy.deepcopy(sub)
+        for kv in v["kv"].values():
+            kv["shard_degree"] = deg
+            kv["bytes"] = (2 * big * block["max_seq"]
+                           * kv["num_kv_heads"] * kv["head_dim"]
+                           * 4) // deg
+        return v
+
+    shard, repl = variant(2), variant(1)
+    by_name = {l.name: l for l in ff.layers}
+    axes = dict(ff.dmesh.axis_sizes)
+    e_s = serving_envelope(shard, big, by_name, axes)
+    e_r = serving_envelope(repl, big, by_name, axes)
+    assert e_s["envelope_bytes"] < e_r["envelope_bytes"]
+    hbm = (e_s["envelope_bytes"] + e_r["envelope_bytes"]) / 2.0
+
+    def doc(v):
+        return {"version": 1, "max_seq": block["max_seq"],
+                "decode_tokens": block["decode_tokens"],
+                "buckets": {str(big): v}}
+
+    ok = verify_serving_plan(doc(shard), ff.layers, ff.dmesh,
+                             hbm_bytes=hbm)
+    assert ok.ok(), [f.format() for f in ok.errors]
+    with pytest.raises(PlanVerificationError) as e:
+        verify_serving_plan(doc(repl), ff.layers, ff.dmesh,
+                            hbm_bytes=hbm)
+    assert any(f.seam == "serving-memory" for f in e.value.findings)
+    assert "shard the KV cache" in " ".join(
+        f.message for f in e.value.findings)
+
+
+def test_optimize_strategy_serving_mode(ff, cost_model):
+    from flexflow_tpu.search.optimizer import optimize_strategy
+    old_buckets = ff.config.serving_buckets
+    old_budget = ff.config.search_budget
+    ff.config.serving_buckets = "2"
+    ff.config.search_budget = 8
+    try:
+        strategy, info = optimize_strategy(ff, mode="serving")
+    finally:
+        ff.config.serving_buckets = old_buckets
+        ff.config.search_budget = old_budget
+    assert strategy.serving is not None
+    assert ff._serving_plan is not None
+    assert list(ff._serving_plan.buckets) == [2]
+    with pytest.raises(ValueError, match="unknown strategy-search mode"):
+        optimize_strategy(ff, mode="nonsense")
+
+
+# ---------------------------------------------------------------------------
+# serialization round-trip
+# ---------------------------------------------------------------------------
+
+def test_serving_block_roundtrips_through_save_and_load(ff, plan):
+    from flexflow_tpu.search.serialization import load_strategy
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "plan.json")
+        save_serving_plan(path, plan)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["meta"]["mode"] == "serving"
+        assert sorted(int(k) for k in doc["serving"]["buckets"]) \
+            == sorted(plan.buckets)
+        st = load_strategy(path, ff.layers, ff.dmesh)
+        assert st.serving is not None
+        assert st.serving["max_seq"] == plan.max_seq
+        # the reloaded serving block verifies like the in-memory one
+        report = verify_serving_plan(st.serving, ff.layers, ff.dmesh)
+        assert report.ok(), [f.format() for f in report.errors]
+
+
+def test_bucket_strategy_doc_extracts_standalone_bucket(ff, plan):
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "plan.json")
+        save_serving_plan(path, plan)
+        with open(path) as f:
+            doc = json.load(f)
+        sub = bucket_strategy_doc(doc, 1)
+        assert sub["meta"]["serving_bucket"] == 1
+        assert list(sub["serving"]["buckets"]) == ["1"]
+        with pytest.raises(KeyError):
+            bucket_strategy_doc(doc, 999)
+        with pytest.raises(ValueError):
+            bucket_strategy_doc({"ops": {}}, 1)
+
+
+# ---------------------------------------------------------------------------
+# repository adoption + floor guard + hot swap
+# ---------------------------------------------------------------------------
+
+def _session_builder():
+    """build(sf, buckets=...) closure in the shape the serving-plan
+    builder drives (mirrors ModelRepository._load_with_builder)."""
+    from flexflow_tpu.serving.session import InferenceSession
+
+    def build(sf, buckets=(1, 4)):
+        ff = _compiled(lambda c: (
+            setattr(c, "only_data_parallel", not sf),
+            setattr(c, "import_strategy_file", sf or "")))
+        return InferenceSession(ff, list(buckets))
+    return build
+
+
+def test_serving_plan_session_routes_by_bucket(ff, plan):
+    from flexflow_tpu.serving.session import build_serving_plan_session
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "plan.json")
+        save_serving_plan(path, plan)
+        session = build_serving_plan_session(path, _session_builder(),
+                                             floor_guard="off")
+    assert session.buckets == sorted(plan.buckets)
+    assert session.session_for(1).buckets == [1]
+    assert session.session_for(3).buckets == [4]
+    assert session.session_for(99).buckets == [4]
+    # decode through the router matches the baseline model bit-exactly
+    rng = np.random.default_rng(0)
+    ids = np.zeros((2, SEQ), np.int32)
+    ids[:, :3] = rng.integers(1, 60, (2, 3))
+    got = np.asarray(session.generate(ids, 3, 5, temperature=0.0))
+    want = np.asarray(ff.generate(ids, 3, 5, temperature=0.0))
+    np.testing.assert_array_equal(got, want)
+    clone = session.clone()
+    assert clone.buckets == session.buckets
+
+
+def test_floor_guard_measures_and_records(ff, cost_model):
+    """floor_guard='on' compiles the no-plan baseline, measures both
+    sides per bucket, and records an adoption decision. (On the CPU sim
+    the decision itself is noise — the contract under test is
+    measurement + substitution, not which side wins.)"""
+    from flexflow_tpu.serving import session as sess_mod
+    small = optimize_serving_strategy(ff, buckets=(2,), budget=8)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "plan.json")
+        save_serving_plan(path, small)
+        session = sess_mod.build_serving_plan_session(
+            path, _session_builder(), floor_guard="on")
+    assert sorted(session.floor_guard) == [2], session.floor_guard
+    rec = session.floor_guard[2]
+    assert rec["adopted"] in ("searched", "baseline")
+    assert rec["searched_s"] > 0 and rec["baseline_s"] > 0
+    # whichever side won, bucket 2 still routes to a bucket-2 session
+    assert session.session_for(2).buckets == [2]
+
+
+def test_floor_guard_auto_skips_on_cpu(ff, plan):
+    import jax
+
+    from flexflow_tpu.serving.session import build_serving_plan_session
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("accelerator backend: auto mode runs the guard")
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "plan.json")
+        save_serving_plan(path, plan)
+        session = build_serving_plan_session(path, _session_builder(),
+                                             floor_guard="auto")
+    assert session.floor_guard == {}
+
+
+def test_repository_adopts_serving_plan_per_bucket(tmp_path, plan):
+    import flexflow_tpu.serving.session as sess_mod
+    repo = sess_mod.ModelRepository()
+    plan_path = str(tmp_path / "plan.json")
+    save_serving_plan(plan_path, plan)
+
+    built = []
+
+    def fake_builder(sf, buckets=(1, 4)):
+        built.append(sf)
+        return _session_builder()(sf, buckets)
+
+    session = sess_mod.build_serving_plan_session(
+        plan_path, fake_builder, floor_guard="off")
+    repo.register("gpt2", session)
+    assert repo.get("gpt2") is session
+    assert len(built) == len(plan.buckets)
+    assert all(sf for sf in built)   # every bucket imported a strategy
+
+    # a strategy export WITHOUT a serving block is a typed load error
+    bare = str(tmp_path / "bare.json")
+    with open(bare, "w") as f:
+        json.dump({"version": 1, "ops": {}}, f)
+    with pytest.raises(ValueError, match="no serving block"):
+        sess_mod.build_serving_plan_session(bare, fake_builder)
+
+
+def test_load_with_builder_rejects_both_strategy_kinds():
+    from flexflow_tpu.serving.session import ModelRepository
+    repo = ModelRepository()
+    with pytest.raises(ValueError, match="not both"):
+        repo._load_with_builder(
+            "m", lambda ff: None, batch_buckets=(1,), config=None,
+            strategy_file="a.json", instances=1,
+            serving_strategy_file="b.json")
+
+
+def test_hot_swap_replaces_instances():
+    from flexflow_tpu.serving.session import ModelRepository
+
+    class Fake:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def clone(self):
+            return Fake(self.tag)
+
+    repo = ModelRepository()
+    repo.register("m", Fake("old"))
+    swapped = repo.hot_swap("m", Fake("new"))
+    assert swapped.tag == "new"
+    with pytest.raises(KeyError):
+        repo.hot_swap("missing", Fake("x"))
+
+
+def test_scheduler_hot_swap_drains_then_restarts():
+    import time
+
+    from flexflow_tpu.serving.scheduler import BatchScheduler
+
+    class Sess:
+        input_names = ["x"]
+
+        def __init__(self, tag):
+            self.tag = tag
+            self.served = 0
+
+        def infer(self, inputs):
+            self.served += 1
+            time.sleep(0.005)
+            return np.zeros((inputs["x"].shape[0], 1), np.float32)
+
+    old, new = Sess("old"), Sess("new")
+    sched = BatchScheduler(old, max_batch=2, max_delay_ms=1.0,
+                           name="swap_test")
+    try:
+        x = np.zeros((1, 1), np.float32)
+        sched.infer({"x": x}, timeout=5.0)
+        assert old.served > 0
+        assert sched.hot_swap([new])
+        sched.infer({"x": x}, timeout=5.0)
+        assert new.served > 0
+        assert sched.session is new
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# compile-cache warm start
+# ---------------------------------------------------------------------------
+
+def test_repository_load_wires_compilation_cache(tmp_path, monkeypatch):
+    """Every repository load path opts into the persistent compile
+    cache; on bare CPU the helper's own SIGILL guard declines, so the
+    wiring is witnessed through a recording stub."""
+    import flexflow_tpu.utils.compilation_cache as cc
+    calls = []
+    monkeypatch.setattr(cc, "enable_compilation_cache",
+                        lambda path=None, **kw: calls.append(path))
+
+    from flexflow_tpu.serving.session import ModelRepository
+    repo = ModelRepository()
+
+    def graph_build(ff):
+        t = ff.create_tensor((4, 8), name="in0")
+        return ff.dense(t, 4)
+
+    cfg = FFConfig()
+    cfg.compilation_cache_dir = str(tmp_path / "cache")
+    session = repo._load_with_builder(
+        "dense", graph_build, batch_buckets=(4,), config=cfg,
+        strategy_file=None, instances=1)
+    assert repo.get("dense") is session
+    # called from the repository load AND again inside compile() —
+    # both opt-ins point at the configured directory
+    assert calls and set(calls) == {str(tmp_path / "cache")}
+
+
+def test_enable_compilation_cache_cpu_guard(tmp_path):
+    """On the bare-CPU test backend the helper must decline (reloading
+    foreign-host XLA:CPU AOT artifacts risks SIGILL)."""
+    import jax
+
+    from flexflow_tpu.utils.compilation_cache import \
+        enable_compilation_cache
+    if jax.default_backend() != "cpu":
+        pytest.skip("cacheable backend: guard does not apply")
+    assert enable_compilation_cache(str(tmp_path / "c")) is None
+
+
+def test_model_compile_counter_labels_decode_compiles():
+    from flexflow_tpu.obs.metrics_registry import REGISTRY
+    c = REGISTRY.counter("ff_model_compiles_total",
+                         "Model program compiles (trace + XLA build "
+                         "events)")
+    before = c.value(model="compile_counter_probe")
+    ff = _compiled()
+    ff._model_name = "compile_counter_probe"
+    # the decode-cache miss below is this model's first named compile
+    ids = np.zeros((BATCH, SEQ), np.int32)
+    ids[:, 0] = 1
+    ff.generate(ids, 1, 2, temperature=0.0)
+    assert c.value(model="compile_counter_probe") > before

@@ -84,8 +84,19 @@ def _build_mlp():
     return ff, out
 
 
+def _build_gpt2():
+    # the serving-plan artifact (strategies/gpt2_serving_8dev.json) was
+    # searched at (8, 32) on the tiny config — the same graph
+    # tools/serving_plan_smoke.py serves
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.nlp import GPTConfig, build_gpt2
+    ff = FFModel(FFConfig())
+    out = build_gpt2(ff, 8, 32, GPTConfig.tiny())
+    return ff, out
+
+
 BUILDERS = {"dlrm": _build_dlrm, "bert": _build_bert,
-            "mlp": _build_mlp}
+            "mlp": _build_mlp, "gpt2": _build_gpt2}
 
 
 def _full_verify(path: str, doc: dict, builder):

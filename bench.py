@@ -1531,6 +1531,119 @@ def stage_serving_overload(steps: int):
            "ok": ratio >= 1.0})
 
 
+def stage_serving_obs_overhead(steps: int):
+    """Serving-observability overhead leg (ISSUE 17 acceptance): the
+    request-lifecycle tracing + streaming quantile sketches must be
+    near-free on the serving hot path. A closed-loop drive (synthetic
+    fixed-latency session — policy cost, not XLA noise) measures
+    completed-requests-per-second under three telemetry configs:
+
+      bare      every ``SchedulerMetrics`` record_* stubbed to a no-op
+                and the event ring off — the floor;
+      disabled  the default build: sketches + counters live, ring off;
+      enabled   the ring on (FF_TRACE semantics): per-request lifecycle
+                traces and spans on every request.
+
+    The drive is SERIAL (one client, immediate dispatch): concurrent
+    closed loops make goodput hostage to batch-assembly timing — a
+    10 us recording delay can flip a 4-row batch into 1+3 and read as
+    10x its real cost. One request at a time isolates exactly the
+    per-request telemetry cost the gate is about. Configs run
+    INTERLEAVED across repetitions so host drift hits all three
+    equally; the median rep is scored. Gates (hard):
+    goodput(disabled) >= 0.97x bare and goodput(enabled) >= 0.95x
+    bare."""
+    import statistics
+    import threading
+    import numpy as np
+    from flexflow_tpu.obs import events as obs_events
+    from flexflow_tpu.serving.scheduler import BatchScheduler
+
+    T_STEP = 0.004       # small enough that per-request obs cost shows
+    MAX_BATCH = 4
+    DURATION_S = max(1.5, float(steps) / 12.0)
+    REPS = 5
+
+    class FixedLatencySession:
+        input_names = ["x"]
+
+        def infer(self, inputs):
+            time.sleep(T_STEP)
+            return np.zeros((int(inputs["x"].shape[0]), 1), np.float32)
+
+    class _NullMetrics:
+        """The bare floor: the scheduler's full recording surface,
+        every method a no-op (the ``_lock``/batch counters stay real —
+        ``_run`` touches them directly)."""
+        def __init__(self, name):
+            self.name = name
+            self._lock = threading.Lock()
+            self.batches = 0
+            self.batched_rows = 0
+
+        def record_submitted(self):
+            pass
+
+        def record_rejected(self):
+            pass
+
+        def record_deadline_rejected(self, bucket=None):
+            pass
+
+        def record_expired(self, bucket=None, deadline_missed=False):
+            pass
+
+        def record_breaker_open(self):
+            pass
+
+        def record_done(self, latency_s, ok, bucket=None,
+                        deadline_missed=False):
+            pass
+
+        def snapshot(self, queue_depth):
+            return {"completed": 0}
+
+    def run_leg(mode: str) -> float:
+        if mode == "enabled":
+            obs_events.enable()
+        else:
+            obs_events.disable()
+        try:
+            sched = BatchScheduler(FixedLatencySession(),
+                                   max_batch=MAX_BATCH,
+                                   max_delay_ms=0.0, max_queue=256,
+                                   name=f"obs_{mode}")
+            if mode == "bare":
+                sched.metrics = _NullMetrics("obs_bare")
+            done = 0
+            t_end = time.perf_counter() + DURATION_S
+            x = np.zeros((1, 1), np.float32)
+            while time.perf_counter() < t_end:
+                sched.infer({"x": x}, timeout=10.0)
+                done += 1
+            sched.close()
+            return done / DURATION_S
+        finally:
+            obs_events.disable()
+            obs_events.clear()
+
+    run_leg("bare")                       # warm-up (imports, jit-free)
+    rps = {"bare": [], "disabled": [], "enabled": []}
+    for _ in range(REPS):
+        for mode in ("bare", "disabled", "enabled"):   # interleaved
+            rps[mode].append(run_leg(mode))
+    med = {m: statistics.median(v) for m, v in rps.items()}
+    r_dis = med["disabled"] / max(med["bare"], 1e-9)
+    r_en = med["enabled"] / max(med["bare"], 1e-9)
+    _emit({"bare_rps": round(med["bare"], 1),
+           "disabled_rps": round(med["disabled"], 1),
+           "enabled_rps": round(med["enabled"], 1),
+           "disabled_over_bare": round(r_dis, 4),
+           "enabled_over_bare": round(r_en, 4),
+           "reps": REPS,
+           "ok": r_dis >= 0.97 and r_en >= 0.95})
+
+
 # ======================================================================
 # parent orchestration
 # ======================================================================
@@ -1815,6 +1928,27 @@ def main():
         else:
             errors.append(f"serving_overload: {err}")
 
+    # -- stage 5.435: serving observability overhead ------------------
+    # ISSUE 17 acceptance: lifecycle tracing + quantile sketches must
+    # cost <= 5% goodput enabled and <= 3% disabled vs a bare scheduler
+    # (synthetic session: telemetry cost, not XLA noise)
+    if remaining() > 60:
+        ooenv = {"JAX_PLATFORMS": "cpu"}
+        oo, err = stage(["--stage", "serving_obs_overhead", "--steps",
+                         "20"], 180, ooenv)
+        if oo is not None:
+            out["serving_obs_enabled_over_bare"] = oo["enabled_over_bare"]
+            out["serving_obs_disabled_over_bare"] = \
+                oo["disabled_over_bare"]
+            if not oo["ok"]:
+                errors.append(
+                    f"serving_obs_overhead: disabled/bare "
+                    f"{oo['disabled_over_bare']} (gate 0.97), "
+                    f"enabled/bare {oo['enabled_over_bare']} "
+                    f"(gate 0.95)")
+        else:
+            errors.append(f"serving_obs_overhead: {err}")
+
     # -- stage 5.44: searched resharding vs naive (virtual mesh) ------
     # ISSUE 6 acceptance + ISSUE 13 honest-chain fix: planned layout
     # transitions must never exceed the naive gather-everything path's
@@ -2078,6 +2212,8 @@ if __name__ == "__main__":
         stage_recovery(a.steps)
     elif a.stage == "serving_overload":
         stage_serving_overload(a.steps)
+    elif a.stage == "serving_obs_overhead":
+        stage_serving_obs_overhead(a.steps)
     elif a.stage == "serving_plan":
         stage_serving_plan(a.budget, a.steps)
     elif a.stage == "zero_memory":

@@ -26,9 +26,11 @@ case "${1:-fast}" in
     # static plan verifier — an unsound plan, an invariant regression,
     # a lock race, or a rank-gated collective fails the push before a
     # single test runs. --budget-s asserts the analyzers' combined
-    # wall time stays under 10s so the gate cannot silently bloat.
+    # wall time cannot silently bloat (raised 10s -> 15s with the
+    # serving-observability modules: the package-wide pass measures
+    # ~10-11s now; a regression past 15s still fails the push).
     python tools/ffcheck.py --lint flexflow_tpu/ --concurrency --spmd \
-      --budget-s 10 --verify-strategies
+      --budget-s 15 --verify-strategies
     python -m pytest tests/ -x -q
     # tier-1 smoke under FF_TRACE=1: the default run above exercises the
     # disabled (near-zero-cost) telemetry paths; this pass exercises the
@@ -91,6 +93,14 @@ case "${1:-fast}" in
     # typed where sharded-KV fits), and per-bucket instances decode
     # BIT-IDENTICALLY to the training-plan baseline session
     python tools/serving_plan_smoke.py
+    # serving-SLO observability smoke (FF_TRACE=1): one generate request
+    # must yield one LINKED lifecycle trace (admission -> queue -> batch
+    # -> prefill -> per-segment decode -> response, flow-linked in the
+    # fftrace merge), /healthz must report live sketch quantiles and a
+    # deadline-expired request as an SLO violation, and an injected
+    # mis-calibrated serving prediction must produce a drift report
+    # attributing exactly its calibration rows — and mark them stale
+    python tools/serving_obs_smoke.py
     # distributed resilience smoke: a 2-process CPU world trains under
     # the WorldSupervisor, rank 1 is fault-injected to hard-crash
     # mid-epoch, the world must re-form (relaunch or shrink) and resume

@@ -1399,7 +1399,8 @@ class FFModel:
         decode_fn = self._decode_cache_get(dk, decode)
         plen = jnp.asarray(prompt_len, jnp.int32)
         from .obs import events as obs_events
-        from .obs.metrics_registry import REGISTRY
+        from .obs import request_trace
+        from .obs.metrics_registry import DECODE_STEP_BUCKETS, REGISTRY
         t0 = time.perf_counter()
         cache = jax.block_until_ready(
             prefill_fn(self.params, self.state, ids0, plen))
@@ -1408,14 +1409,43 @@ class FFModel:
             decode_fn(self.params, self.state, ids0, cache,
                       jax.random.key(seed), plen))
         t2 = time.perf_counter()
+        step_s = (t2 - t1) / max(int(max_new_tokens), 1)
+        # tag the phase spans with the ambient request trace (set by the
+        # serving front) so a request's prefill/decode link into its
+        # lifecycle; None outside a traced request — dropped by attrs
+        tid = request_trace.current_id()
+        span_attrs = {"trace": tid} if tid else {}
         obs_events.record_span("generate.prefill", t0, t1 - t0,
-                               batch=b, seq=L)
+                               batch=b, seq=L, **span_attrs)
         obs_events.record_span("generate.decode", t1, t2 - t1,
-                               batch=b, tokens=int(max_new_tokens))
+                               batch=b, tokens=int(max_new_tokens),
+                               **span_attrs)
         REGISTRY.histogram(
             "ff_decode_step_seconds",
-            "Per-token decode-step latency by batch bucket").observe(
-            (t2 - t1) / max(int(max_new_tokens), 1), bucket=str(b))
+            "Per-token decode-step latency by batch bucket",
+            buckets=DECODE_STEP_BUCKETS).observe(step_s, bucket=str(b))
+        REGISTRY.histogram(
+            "ff_prefill_seconds",
+            "Prompt prefill latency by batch bucket",
+            buckets=DECODE_STEP_BUCKETS).observe(t1 - t0, bucket=str(b))
+        # always-on measured sink for serving drift detection: the MIN
+        # observed prefill/decode-step per batch size (min = closest to
+        # the cost model's contention-free prediction; bounded — one
+        # small dict entry per batch size ever decoded). Unlocked
+        # update: worst case a concurrent generate at the same batch
+        # size loses one sample, and serving sessions serialize decode
+        # per instance anyway  # ffcheck: ok(guarded-field)
+        rec = getattr(self, "_decode_measured", None)
+        if rec is None:
+            rec = self._decode_measured = {}
+        old = rec.get(b)
+        rec[b] = {
+            "prefill_s": (t1 - t0) if old is None
+            else min(old["prefill_s"], t1 - t0),
+            "decode_step_s": step_s if old is None
+            else min(old["decode_step_s"], step_s),
+            "n": 1 if old is None else old["n"] + 1,
+        }
         return out
 
     def generate_beam(self, prompt_ids, prompt_len: int,

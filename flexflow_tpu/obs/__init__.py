@@ -21,7 +21,16 @@ serving) plus the resilience runtime:
     ``measured`` side of the audit record (``FF_ATTRIB=1``);
   - :mod:`.drift` — predicted-vs-measured drift detection, attributed
     to the calibration rows that produced the predictions (stale rows
-    are re-measured on the next calibration load);
+    are re-measured on the next calibration load); the serving variant
+    (:func:`drift.serving_drift_report`) closes the same loop for a
+    live serving session's per-bucket decode profile;
+  - :mod:`.request_trace` — per-request serving lifecycle traces
+    (admission → queue → batch → prefill → decode → response), id
+    propagated via the ``x-ff-trace-id`` header and linked in the
+    Chrome export as flow events;
+  - :mod:`.sketch` — mergeable streaming quantile sketches
+    (DDSketch-style, relative-error-bounded) backing the serving
+    latency quantiles on ``/healthz`` and ``/v2/metrics``;
   - :mod:`.flight` — bounded flight-recorder dumps at failure sites
     (RankFailure, NaN rollback, unhandled crash).
 
@@ -31,10 +40,14 @@ from . import events
 from .audit import load_strategy_audit, workload_key
 from .events import counter, instant, span
 from .metrics_registry import REGISTRY, MetricsRegistry, get_registry
-from .trace_export import (dump_rank_trace, export_chrome_trace,
-                           to_chrome_trace)
+from .request_trace import TRACE_HEADER, RequestTrace
+from .sketch import QuantileSketch
+from .trace_export import (dump_rank_trace, dump_serving_trace,
+                           export_chrome_trace, to_chrome_trace)
 
 __all__ = ["events", "span", "counter", "instant", "REGISTRY",
            "MetricsRegistry", "get_registry", "to_chrome_trace",
-           "export_chrome_trace", "dump_rank_trace", "workload_key",
-           "load_strategy_audit"]
+           "export_chrome_trace", "dump_rank_trace",
+           "dump_serving_trace", "workload_key",
+           "load_strategy_audit", "QuantileSketch", "RequestTrace",
+           "TRACE_HEADER"]

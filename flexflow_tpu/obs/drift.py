@@ -27,6 +27,17 @@ entries cheaper than one host dispatch on both sides carry no signal).
 
 Reports land in ``<repo>/.ffcache/drift_report_<workload>.json`` next
 to the audit record they were derived from.
+
+The serving variant — :func:`detect_serving_drift` /
+:func:`serving_drift_report` — runs the same band logic over a live
+``ServingPlanSession``: measured per-bucket prefill / decode-step
+latency (the model's always-on decode sink) against the ``serving``
+audit block's predicted entries, keyed 1:1 by batch bucket. Each
+out-of-band bucket is attributed to the calibration rows its
+search-time pricing consulted (the bucket's ``calib`` provenance list)
+and those rows are marked stale the same way. Its noise floor is
+``FF_SERVING_DRIFT_MIN_S`` (default 1e-6 — whole-bucket latencies, not
+single ops).
 """
 from __future__ import annotations
 
@@ -44,6 +55,10 @@ _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
 SCHEMA_VERSION = 1
 DEFAULT_BAND = 4.0
 DEFAULT_MIN_SECONDS = 1e-4
+#: serving entries are whole prefill/decode-step latencies, not single
+#: ops — even a tiny bucket's decode step is micro-seconds, so the
+#: serving floor sits far below the per-op one
+DEFAULT_SERVING_MIN_SECONDS = 1e-6
 
 #: audit-entry components diffed independently; the provenance ``term``
 #: of each calibration row selects which component it explains
@@ -156,16 +171,13 @@ def detect_drift(doc: Dict[str, Any], band: Optional[float] = None,
     }
 
 
-def detect_and_write(doc: Dict[str, Any],
-                     cache_dir: Optional[str] = None,
-                     band: Optional[float] = None,
-                     min_s: Optional[float] = None,
-                     mark_stale: bool = True) -> Optional[str]:
-    """Run the detector, bump ``ff_costmodel_drift_total{table}``, mark
-    the attributed calibration rows stale, and persist the drift report
-    JSON. Returns the report path (None when the write failed)."""
-    t0 = time.perf_counter()
-    report = detect_drift(doc, band=band, min_s=min_s)
+def _meter_mark_write(report: Dict[str, Any],
+                      cache_dir: Optional[str],
+                      mark_stale: bool) -> Optional[str]:
+    """Shared back half of both drift entry points: bump the per-table
+    drift counters, mark the attributed calibration rows stale, and
+    persist the report JSON. Returns the report path (None when the
+    write failed)."""
     for e in report["out_of_band"]:
         for table in e["tables"]:
             REGISTRY.counter(
@@ -198,7 +210,142 @@ def detect_and_write(doc: Dict[str, Any],
         os.replace(tmp, path)
     except Exception:  # noqa: BLE001 — reporting must never raise
         return None
+    return path
+
+
+def detect_and_write(doc: Dict[str, Any],
+                     cache_dir: Optional[str] = None,
+                     band: Optional[float] = None,
+                     min_s: Optional[float] = None,
+                     mark_stale: bool = True) -> Optional[str]:
+    """Run the detector, bump ``ff_costmodel_drift_total{table}``, mark
+    the attributed calibration rows stale, and persist the drift report
+    JSON. Returns the report path (None when the write failed)."""
+    t0 = time.perf_counter()
+    report = detect_drift(doc, band=band, min_s=min_s)
+    path = _meter_mark_write(report, cache_dir, mark_stale)
+    if path is None:
+        return None
     obs_events.record_span("obs.drift", t0, time.perf_counter() - t0,
+                           out_of_band=report["n_out_of_band"],
+                           stale=report["stale_marked"])
+    return path
+
+
+#: serving-audit components diffed independently; both are whole-bucket
+#: latencies priced by the same calibration rows, so every out-of-band
+#: entry attributes the bucket's full ``calib`` row set
+_SERVING_COMPONENTS = ("prefill_s", "decode_step_s")
+
+
+def detect_serving_drift(doc: Dict[str, Any],
+                         measured: Dict[str, Dict[str, Any]],
+                         band: Optional[float] = None,
+                         min_s: Optional[float] = None
+                         ) -> Dict[str, Any]:
+    """Diff a ``serving`` audit block's predicted per-bucket
+    prefill/decode-step latencies against the live session's measured
+    profile (:meth:`ServingPlanSession.measured_profile`), keyed 1:1 by
+    batch bucket. Each out-of-band ratio is attributed to the exact
+    calibration rows the bucket's search-time pricing consulted (the
+    bucket's ``calib`` provenance list, recorded by the serving
+    evaluator's tap). Pure — no files, no counters; see
+    :func:`serving_drift_report` for the persisted + metered entry
+    point. Buckets never served (absent from ``measured``) are skipped:
+    no observation, no signal."""
+    band = band if band is not None \
+        else _env_float("FF_DRIFT_BAND", DEFAULT_BAND)
+    band = max(1.0 + 1e-9, float(band))
+    min_s = min_s if min_s is not None \
+        else _env_float("FF_SERVING_DRIFT_MIN_S",
+                        DEFAULT_SERVING_MIN_SECONDS)
+    buckets = (doc.get("serving") or {}).get("buckets") or {}
+    out: List[Dict[str, Any]] = []
+    n_compared = 0
+    for bkey in sorted(buckets, key=lambda k: int(k)):
+        pb = buckets[bkey]
+        mb = measured.get(str(bkey))
+        if not mb:
+            continue
+        prov = pb.get("calib") or []
+        keys = sorted({r["key"] for r in prov if r.get("key")})
+        tables = sorted({r.get("table") or "analytic"
+                         for r in prov}) or ["analytic"]
+        for comp in _SERVING_COMPONENTS:
+            p = float(pb.get(comp) or 0.0)
+            m = float(mb.get(comp) or 0.0)
+            if p < min_s and m < min_s:
+                continue
+            n_compared += 1
+            ratio = m / max(p, 1e-12)
+            if 1.0 / band <= ratio <= band:
+                continue
+            out.append({
+                "name": f"bucket[{bkey}]",
+                "bucket": int(bkey),
+                "component": comp,
+                "predicted_s": p,
+                "measured_s": m,
+                "ratio": ratio,
+                "n_samples": int(mb.get("n", 0) or 0),
+                "tables": tables,
+                "calibration_keys": keys,
+            })
+    stale = sorted({k for e in out for k in e["calibration_keys"]})
+    return {
+        "schema": SCHEMA_VERSION,
+        "kind": "serving",
+        "workload_key": doc.get("workload_key"),
+        "band": band,
+        "min_s": min_s,
+        "n_compared": n_compared,
+        "n_out_of_band": len(out),
+        "out_of_band": out,
+        "stale_keys": stale,
+    }
+
+
+def serving_drift_report(session,
+                         audit_path: Optional[str] = None,
+                         cache_dir: Optional[str] = None,
+                         band: Optional[float] = None,
+                         min_s: Optional[float] = None,
+                         mark_stale: bool = True) -> Optional[str]:
+    """Close the serving re-plan loop for one live
+    ``ServingPlanSession``: read its strategy-audit record (the
+    ``serving`` block written at plan-search time), annotate it with the
+    measured per-bucket profile (``serving_measured``, keyed 1:1 to the
+    predicted entries), run :func:`detect_serving_drift`, bump the drift
+    counters, mark the attributed calibration rows stale, and persist
+    the report next to the audit. Returns the report path — None when
+    there is no audit record, nothing was measured yet, or the write
+    failed."""
+    t0 = time.perf_counter()
+    if audit_path is None:
+        audit_path = getattr(getattr(session, "ff", None),
+                             "_strategy_audit_path", None)
+    if not audit_path or not os.path.exists(audit_path):
+        return None
+    try:
+        with open(audit_path) as f:
+            doc = json.load(f)
+    except Exception:  # noqa: BLE001 — reporting must never raise
+        return None
+    measured = session.measured_profile()
+    if not measured:
+        return None
+    try:
+        from .audit import annotate_strategy_audit
+        annotate_strategy_audit(audit_path,
+                                {"serving_measured": {"buckets": measured}})
+    except Exception:  # noqa: BLE001 — annotation is best-effort
+        pass
+    report = detect_serving_drift(doc, measured, band=band, min_s=min_s)
+    path = _meter_mark_write(report, cache_dir, mark_stale)
+    if path is None:
+        return None
+    obs_events.record_span("obs.serving_drift", t0,
+                           time.perf_counter() - t0,
                            out_of_band=report["n_out_of_band"],
                            stale=report["stale_marked"])
     return path

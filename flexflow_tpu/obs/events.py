@@ -194,6 +194,12 @@ class span:
         self._t0 = time.perf_counter() if _enabled else None  # ffcheck: ok(guarded-field)
         return self
 
+    def set(self, **attrs) -> "span":
+        """Attach attributes discovered mid-span (e.g. the batch size a
+        request was assembled into, known only after the body ran)."""
+        self.attrs.update(attrs)
+        return self
+
     def __exit__(self, exc_type, exc, tb) -> bool:
         t0 = self._t0
         # benign race: a span straddling enable/disable may be dropped,

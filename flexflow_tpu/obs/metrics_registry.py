@@ -29,6 +29,15 @@ LabelKey = Tuple[Tuple[str, str], ...]
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: µs–ms-tuned buckets (seconds) for per-token decode-step and prefill
+#: latencies: the request-latency defaults start at 1 ms, so µs-scale
+#: decode steps all collapse into the first bucket.  Every registration
+#: site of ``ff_decode_step_seconds`` / ``ff_prefill_seconds`` must pass
+#: this same set (the registry rejects mismatched explicit buckets).
+DECODE_STEP_BUCKETS = (1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5,
+                       1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+                       0.01, 0.025, 0.05, 0.1, 0.25)
+
 
 def _labelkey(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -44,7 +53,16 @@ def _fmt_labels(key: LabelKey, extra: Sequence[Tuple[str, str]] = ()
 
 
 def _escape(v: str) -> str:
+    """Escape a label VALUE per the exposition format: backslash first
+    (escaping the escapes we are about to add), then quote, then
+    newline."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """Escape HELP text: only backslash and newline (quotes are legal
+    verbatim in HELP lines, unlike in label values)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_value(v: float) -> str:
@@ -227,7 +245,7 @@ class MetricsRegistry:
         lines: List[str] = []
         for m in metrics:
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             lines.extend(m._render())
         return "\n".join(lines) + "\n"

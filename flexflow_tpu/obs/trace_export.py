@@ -92,10 +92,42 @@ def to_chrome_trace(evts: Optional[Sequence[Dict[str, Any]]] = None,
     for cname in sorted(counters):
         out.append({"name": cname, "ph": "C", "ts": round(end_us, 3),
                     "pid": pid, "args": {"value": counters[cname]}})
+    out.extend(_flow_events(evts, pid, base))
     return {"traceEvents": out,
             "displayTimeUnit": "ms",
             "otherData": {"counters": dict(counters),
                           "dropped_events": _events.dropped()}}
+
+
+def _flow_events(evts: Sequence[Dict[str, Any]], pid: int,
+                 base: float) -> List[Dict[str, Any]]:
+    """Chrome flow events ('s'/'t'/'f') linking spans that share a
+    ``trace`` attribute — a serving request's lifecycle spans land on
+    different scheduler threads (HTTP handler, queue worker, decode
+    loop), and the flow arrows stitch them into one visible path in
+    Perfetto.  Only groups with >= 2 spans get arrows; flow ids reuse
+    the trace id string (Chrome accepts string ids)."""
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for e in evts:
+        attrs = e.get("attrs")
+        if e["kind"] == "span" and attrs and attrs.get("trace"):
+            groups.setdefault(str(attrs["trace"]), []).append(e)
+    out: List[Dict[str, Any]] = []
+    for tid_key in sorted(groups):
+        chain = sorted(groups[tid_key], key=lambda e: e["ts"])
+        if len(chain) < 2:
+            continue
+        for i, e in enumerate(chain):
+            ph = "s" if i == 0 else ("f" if i == len(chain) - 1 else "t")
+            rec: Dict[str, Any] = {
+                "name": "request", "cat": "request", "ph": ph,
+                "id": tid_key, "pid": pid, "tid": e["tid"],
+                "ts": round((e["ts"] - base) * 1e6, 3),
+            }
+            if ph == "f":
+                rec["bp"] = "e"     # bind to the enclosing slice
+            out.append(rec)
+    return out
 
 
 def export_chrome_trace(path: str,
@@ -173,6 +205,52 @@ def dump_rank_trace(path: Optional[str] = None,
             json.dump(doc, f)
         os.replace(tmp, path)
         _events.counter("trace.rank_dumps")
+        return path
+    except Exception:  # noqa: BLE001
+        return None
+
+
+# ----------------------------------------------------------------------
+# serving-process raw dumps (fftrace merge input, role="serving")
+# ----------------------------------------------------------------------
+
+
+def serving_trace_path(pid: Optional[int] = None,
+                       cache_dir: Optional[str] = None) -> str:
+    return os.path.join(cache_dir or _DEFAULT_DIR,
+                        f"trace_serving_{pid or os.getpid()}.json")
+
+
+def dump_serving_trace(path: Optional[str] = None,
+                       cache_dir: Optional[str] = None) -> Optional[str]:
+    """Dump a serving process's raw ring for the ``tools/fftrace.py``
+    merge — same schema as the rank dumps but tagged ``role="serving"``
+    (no world rank/epoch: serving processes sit outside the training
+    world), so one merged Chrome trace can show a request's lifecycle
+    spans next to the training lanes.  Returns the path (None on
+    failure; dumping telemetry must never kill the server)."""
+    try:
+        snap = _events.snapshot()
+        doc: Dict[str, Any] = {
+            "schema": RANK_DUMP_SCHEMA,
+            "role": "serving",
+            "rank": 0,
+            "world_epoch": 0,
+            "world_size": 1,
+            "pid": os.getpid(),
+            "events": snap["events"],
+            "counters": snap["counters"],
+            "dropped": snap["dropped"],
+        }
+        if path is None:
+            path = serving_trace_path(cache_dir=cache_dir)
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        _events.counter("trace.serving_dumps")
         return path
     except Exception:  # noqa: BLE001
         return None

@@ -433,6 +433,11 @@ class BucketPlan:
     strategy: ShardingStrategy
     cost: ServingCost
     kv: Dict[str, Dict[str, int]]
+    # calibration provenance of the adopted assignment's predicted cost
+    # (deduped {term, table, key} rows from the cost model's provenance
+    # tap) — what serving drift detection attributes out-of-band
+    # prefill/decode ratios to
+    calib: List[Dict] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -476,7 +481,8 @@ class ServingPlan:
                     "decode_comm_s": plan.cost.decode_comm,
                     "kv_bytes": plan.cost.kv_bytes,
                     "peak_memory_bytes": plan.cost.peak_memory,
-                    "total_s": plan.cost.total}}
+                    "total_s": plan.cost.total},
+                "calib": plan.calib}
         return block
 
 
@@ -508,6 +514,35 @@ def bucket_strategy_doc(doc: Dict, bucket: int) -> Dict:
                         "max_seq": serving.get("max_seq"),
                         "decode_tokens": serving.get("decode_tokens"),
                         "buckets": {bkey: sub}}}
+
+
+def _assignment_provenance(ev: ServingCostEvaluator, assign) -> List[Dict]:
+    """Calibration provenance of one bucket's adopted assignment:
+    re-score it with the cost model's provenance tap installed (the
+    attribution/drift machinery's tap, here installed by the serving
+    evaluator too) and dedup the recorded rows to ``{term, table,
+    key}``.  READ-ONLY by construction — the tap changes what
+    ``op_cost`` RECORDS, never what it returns, so pricing (and the
+    fidelity number keyed on it) is untouched."""
+    cm = ev.cost
+    prev = cm.provenance
+    cm.provenance = []
+    try:
+        ev.evaluate(assign)
+        rows = cm.provenance
+    finally:
+        cm.provenance = prev
+    seen = set()
+    out: List[Dict] = []
+    for r in rows:
+        k = (r.get("term"), r.get("table"), r.get("key"))
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append({"term": r.get("term"),
+                    "table": r.get("table") or "analytic",
+                    "key": r.get("key")})
+    return out
 
 
 def _serving_cost_model(ff, dmesh) -> OpCostModel:
@@ -579,7 +614,9 @@ def optimize_serving_strategy(ff, buckets: Optional[Sequence[int]] = None,
         if errs:
             raise RuntimeError(f"serving search produced an unsound "
                                f"strategy at bucket {b}: {errs}")
-        plans[b] = BucketPlan(b, best, strategy, best_cost, ev.kv_plan(best))
+        plans[b] = BucketPlan(b, best, strategy, best_cost,
+                              ev.kv_plan(best),
+                              calib=_assignment_provenance(ev, best))
     plan = ServingPlan(plans, int(max_seq),
                        int(decode_tokens or max_seq), baseline)
     # the per-bucket strategies carry their serving block so any later
@@ -640,7 +677,8 @@ def _write_serving_audit(ff, plan: ServingPlan, search_s: float) -> None:
                     if base else None,
                 "kv": p.kv,
                 "assignment": {n: list(d)
-                               for n, d in p.assignment.items()}}
+                               for n, d in p.assignment.items()},
+                "calib": p.calib}
         record = {
             "search_algo": "serving",
             "ranker": "serving-latency",

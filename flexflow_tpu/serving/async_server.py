@@ -31,6 +31,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
+from ..obs import events as obs_events
+from ..obs import request_trace
 from .http_server import (ServingState, drain_frontend, get_route,
                           post_route, render_body)
 
@@ -185,10 +187,21 @@ def _make_client_handler(repo, schedulers, pool, state):
                         code, obj, extra = get_route(path, repo,
                                                      schedulers, state)
                     elif method == "POST":
-                        # parse + (blocking) scheduler wait off-loop
-                        code, obj, extra = await loop.run_in_executor(
-                            pool, post_route, path, body, repo,
-                            schedulers, headers, state)
+                        # parse + (blocking) scheduler wait off-loop;
+                        # the span is the LOOP-side view of the request
+                        # (dispatch -> executor result), linked into
+                        # the request's trace via the echoed id
+                        with obs_events.span("serving.post",
+                                             path=path) as sp:
+                            code, obj, extra = \
+                                await loop.run_in_executor(
+                                    pool, post_route, path, body, repo,
+                                    schedulers, headers, state)
+                            tid = (extra or {}).get(
+                                request_trace.TRACE_HEADER)
+                            if tid:
+                                sp.set(trace=tid)
+                            sp.set(status=code)
                     else:
                         # unknown method/route: a framed 404 on a live
                         # connection (the body was consumed above),

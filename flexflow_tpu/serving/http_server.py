@@ -10,7 +10,8 @@ Endpoints (JSON bodies, shapes row-major):
   - ``GET  /v2/models``                  -> {"models": [names]}
   - ``GET  /v2/metrics``                 -> per-model scheduler counters
     (requests/completed/rejected/expired/deadline-rejected, queue
-    depth, circuit state, mean batch rows, latency p50/p99 ms,
+    depth, circuit state, mean batch rows, sketch latency quantiles
+    p50/p90/p99/p99.9 ms overall and per batch bucket, SLO violations,
     instances)
   - ``GET  /metrics``                    -> Prometheus text exposition
     (request-latency histograms, queue-depth + circuit-state gauges,
@@ -32,6 +33,12 @@ Reference analog: the Triton backend's HTTP surface
 (``/root/reference/triton/README.md``); stdlib-only so it runs anywhere
 the framework does. Deadline/admission/breaker/drain semantics:
 docs/serving.md.
+
+Request tracing: when ``obs.events`` is enabled every inference POST
+carries a trace id — the client's ``x-ff-trace-id`` header or a
+generated one, echoed back on the response — and its lifecycle
+(admission -> queue -> batch -> prefill -> decode -> response) lands as
+linked spans in the trace ring (docs/observability.md).
 """
 from __future__ import annotations
 
@@ -45,6 +52,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..obs import events as obs_events
+from ..obs import request_trace
 from ..obs.metrics_registry import REGISTRY
 from .scheduler import (CIRCUIT_STATE_NUM, InvalidInputError,
                         RequestRejected)
@@ -162,6 +170,14 @@ def render_prometheus(schedulers) -> str:
         ({"model": name},
          CIRCUIT_STATE_NUM.get(sched.breaker.state, 0.0))
         for name, sched in live)
+    qrows = []
+    for name, sched in live:
+        qrows.extend(sched.metrics.quantile_rows())
+    REGISTRY.gauge(
+        "ff_request_latency_quantile",
+        "Streaming-sketch request latency quantiles (seconds) by "
+        "model, batch bucket ('all' = every bucket), and quantile"
+    ).set_all(qrows)
     return REGISTRY.render()
 
 
@@ -182,13 +198,15 @@ def get_route(path: str, repo, schedulers, state: Optional[ServingState]
         from ..resilience import status as resilience_status
         draining = bool(state is not None and state.draining)
         serving = {}
-        # cheap point-in-time fields only — probes fire every few
-        # seconds, and the full stats() snapshot sorts the latency
-        # reservoir under the hot-path metrics lock
+        # cheap fields only — probes fire every few seconds; the
+        # latency block is a sketch walk (O(bins), no sort, bounded
+        # bins), unlike the full stats() snapshot
         for name, sched in list(schedulers.items()):
             serving[name] = {"circuit": sched.breaker.state,
                              "queue_depth": sched._q.qsize(),
-                             "draining": sched._draining}
+                             "draining": sched._draining,
+                             "latency_ms":
+                                 sched.metrics.latency_quantiles()}
             # KV-decode fallback state (satellite of the serving-plan
             # work): a model quietly riding the O(L)-per-token
             # re-forward path is a live perf regression a probe should
@@ -234,12 +252,26 @@ def get_route(path: str, repo, schedulers, state: Optional[ServingState]
     return 404, {"error": f"no route {path}"}, {}
 
 
+#: HTTP status -> trace outcome, the COARSE fallback mapping for the
+#: direct (non-scheduler) paths; the scheduler's precise outcome is
+#: latched first and wins (RequestTrace.finish is idempotent)
+_OUTCOME_BY_STATUS = {200: "ok", 400: "invalid", 404: "invalid",
+                      503: "rejected", 504: "expired"}
+
+
 def post_route(path: str, body: bytes, repo, schedulers,
                headers: Optional[Dict[str, str]] = None,
                state: Optional[ServingState] = None):
     """Route one POST (BLOCKING — the batching scheduler's ``infer``
     waits for the result; the asyncio front runs this in a thread
-    pool). Returns ``(status, json_obj, extra_headers)``."""
+    pool). Returns ``(status, json_obj, extra_headers)``.
+
+    Inference routes get a lifecycle trace (``obs.request_trace``) when
+    tracing is enabled: the client's ``x-ff-trace-id`` is honored (and
+    echoed on the response), the terminal outcome lands on the trace's
+    response span, and the trace is the thread's ambient one for the
+    duration so deep layers (generate's prefill/decode spans) link into
+    it."""
     obs_events.counter("serving.http_requests")
     parts = path.strip("/").split("/")
     # v2/repository/models/<name>/unload (Triton repository API)
@@ -258,12 +290,31 @@ def post_route(path: str, body: bytes, repo, schedulers,
             or parts[3] not in ("infer", "generate"):
         return 404, {"error": f"no route {path}"}, {}
     name, verb = parts[2], parts[3]
+    hdrs = {str(k).lower(): v for k, v in (headers or {}).items()}
+    trace = request_trace.from_headers(hdrs, model=name)
+    status, obj, extra = _model_route(verb, name, body, repo,
+                                      schedulers, hdrs, state, trace)
+    if trace is not None:
+        # fallback finish for paths that never reached the scheduler
+        # (generate, direct infer, parse errors) — a no-op when the
+        # scheduler already latched the precise outcome
+        trace.finish(_OUTCOME_BY_STATUS.get(status, "failed"),
+                     status=status)
+        extra = dict(extra)
+        extra[request_trace.TRACE_HEADER] = trace.trace_id
+    return status, obj, extra
+
+
+def _model_route(verb: str, name: str, body: bytes, repo, schedulers,
+                 hdrs: Dict[str, str], state: Optional[ServingState],
+                 trace):
+    """The infer/generate route body behind :func:`post_route`'s trace
+    bracketing."""
     if state is not None and state.draining:
         # graceful drain: readiness already flipped; in-flight work
         # finishes but nothing new is admitted
         return 503, {"error": "server draining; retry against another "
                               "replica"}, {"Retry-After": "5"}
-    hdrs = {str(k).lower(): v for k, v in (headers or {}).items()}
     timeout_ms = None
     if "x-ff-timeout-ms" in hdrs:
         try:
@@ -284,6 +335,11 @@ def post_route(path: str, body: bytes, repo, schedulers,
     if eff_ms is None and state is not None:
         eff_ms = state.default_deadline_ms
     t0 = time.perf_counter()
+    # ambient-trace bracket around the whole verb body — manual
+    # enter/exit so the long-standing try/except chain below keeps its
+    # indentation; the finally below is the matching exit
+    _ambient = request_trace.activate(trace)
+    _ambient.__enter__()
     try:
         doc = json.loads(body)
         inputs = {}
@@ -292,6 +348,13 @@ def post_route(path: str, body: bytes, repo, schedulers,
                 rec.get("datatype", "float32").lower()
                 .replace("fp", "float")))
             inputs[rec["name"]] = arr.reshape(rec["shape"])
+        if trace is not None:
+            # admission span: JSON parse + tensor assembly + (for
+            # generate) parameter validation happen between t0 and the
+            # dispatch into the scheduler/session
+            trace.stage("admission", t0, verb=verb,
+                        rows=(int(next(iter(inputs.values())).shape[0])
+                              if inputs else 0))
         if verb == "generate":
             sess = repo.get(name)      # unknown model -> 404
             p = doc.get("parameters", {})
@@ -337,7 +400,7 @@ def post_route(path: str, body: bytes, repo, schedulers,
                 else sched.default_deadline_ms
             wait_s = 30.0 if dl_ms is None else max(30.0, dl_ms / 1e3)
             out = sched.infer(inputs, timeout=wait_s,
-                              deadline_ms=timeout_ms)
+                              deadline_ms=timeout_ms, trace=trace)
         else:
             out = repo.get(name).infer(inputs)
             late = _past_deadline(t0, eff_ms)
@@ -361,6 +424,8 @@ def post_route(path: str, body: bytes, repo, schedulers,
         return 504, {"error": f"{type(e).__name__}: {e}"}, {}
     except Exception as e:  # noqa: BLE001 — report, don't die
         return 400, {"error": f"{type(e).__name__}: {e}"}, {}
+    finally:
+        _ambient.__exit__(None, None, None)
 
 
 def _make_handler(repo, schedulers, state):

@@ -38,14 +38,18 @@ Triton-scope hardening (reference ``triton/src/instance.cc``,
   - **N concurrent instances**: one worker thread per model instance
     (Triton's ``instance_group { count: N }``), all draining the shared
     queue;
-  - **metrics**: per-model counters + latency reservoir feeding the
-    ``/v2/metrics`` endpoint (p50/p99, queue depth, batch sizes), plus
-    expired / deadline-rejected / breaker-open counters and the circuit
-    state in the Prometheus registry.
+  - **metrics**: per-model counters + streaming quantile sketches
+    (``obs.sketch``) feeding the ``/v2/metrics`` endpoint
+    (p50/p90/p99/p99.9 overall and per batch bucket, queue depth, batch
+    sizes), plus expired / deadline-rejected / breaker-open / SLO-
+    violation counters and the circuit state in the Prometheus registry;
+  - **request lifecycle tracing**: when ``obs.events`` is enabled each
+    request carries a :class:`~..obs.request_trace.RequestTrace` through
+    admission -> queue -> batch -> response, every stage a linked span
+    tagged with the trace id, batch bucket, and terminal outcome.
 """
 from __future__ import annotations
 
-import collections
 import queue
 import threading
 import time
@@ -53,7 +57,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import request_trace
 from ..obs.metrics_registry import DEFAULT_BUCKETS, REGISTRY
+from ..obs.sketch import QuantileSketch
 
 #: request-latency histogram buckets (seconds): the registry default
 #: extended upward for slow generate calls
@@ -186,12 +192,24 @@ class CircuitBreaker:
 
 
 class SchedulerMetrics:
-    """Thread-safe counters + latency reservoir for one scheduler.
+    """Thread-safe counters + streaming latency quantiles for one
+    scheduler.
+
+    Latency lands in mergeable :class:`~..obs.sketch.QuantileSketch`
+    instances — one overall, one per batch bucket — instead of the old
+    bounded reservoir: memory stays fixed no matter how many requests
+    flow through, quantile error is a bounded *relative* 1%, and
+    per-bucket sketches merge exactly into fleet aggregates.
 
     Doubles as the bridge into the process-wide Prometheus registry
     (``obs/metrics_registry.py``): every completion lands in the
     ``ff_request_latency_seconds`` histogram and the per-model request
     counters, labeled by model name — what ``GET /metrics`` serves.
+    Deadline violations additionally feed the SLO burn-rate counter
+    ``ff_slo_violations_total{model,bucket}`` (completed-late, expired
+    with a deadline, and deadline-rejected requests all count; failures
+    without a deadline breach do not — they burn the error budget via
+    ``ff_requests_failed_total`` instead).
 
     Counter semantics (disjoint: every admitted-or-rejected request
     lands in exactly one of completed/failed/expired/rejected/
@@ -209,7 +227,16 @@ class SchedulerMetrics:
       - ``failed``: executed (or retried) and errored;
       - ``completed``: executed successfully."""
 
+    #: quantiles exposed on /healthz, /v2/metrics, and the
+    #: ``ff_request_latency_quantile`` gauge
+    QUANTILES = ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"),
+                 (0.999, "p99.9"))
+
     def __init__(self, window: int = 2048, name: str = ""):
+        # ``window`` is legacy (the old reservoir size) — kept in the
+        # signature for callers; the sketches are memory-bounded by
+        # construction
+        del window
         self._lock = threading.Lock()
         self.name = name or "default"
         self.requests = 0
@@ -219,9 +246,11 @@ class SchedulerMetrics:
         self.expired = 0
         self.deadline_rejected = 0
         self.breaker_opens = 0
+        self.slo_violations = 0
         self.batches = 0
         self.batched_rows = 0
-        self._lat = collections.deque(maxlen=window)
+        self._sketch = QuantileSketch()
+        self._sketch_by_bucket: Dict[str, QuantileSketch] = {}
         # registry handles resolved ONCE — the hot path below must not
         # take the registry lock for a name lookup per request
         self._m_requests = REGISTRY.counter(
@@ -252,6 +281,12 @@ class SchedulerMetrics:
             "ff_request_latency_seconds",
             "End-to-end request latency (queue + batch assembly + "
             "device step)", buckets=LATENCY_BUCKETS)
+        self._m_slo = REGISTRY.counter(
+            "ff_slo_violations_total",
+            "Requests that violated their deadline SLO, by model and "
+            "batch bucket: completed past the deadline, expired in the "
+            "queue with a deadline set, or deadline-rejected at "
+            "admission")
 
     def record_submitted(self):
         with self._lock:
@@ -263,35 +298,96 @@ class SchedulerMetrics:
             self.rejected += 1
         self._m_rejected.inc(model=self.name)
 
-    def record_deadline_rejected(self):
+    def record_deadline_rejected(self, bucket: Optional[str] = None):
+        # always an SLO violation: the request carried a deadline the
+        # server declined to attempt
         with self._lock:
             self.deadline_rejected += 1
+            self.slo_violations += 1
         self._m_deadline_rejected.inc(model=self.name)
+        self._m_slo.inc(model=self.name, bucket=bucket or "all")
 
-    def record_expired(self):
+    def record_expired(self, bucket: Optional[str] = None,
+                       deadline_missed: bool = False):
         with self._lock:
             self.expired += 1
+            if deadline_missed:
+                self.slo_violations += 1
         self._m_expired.inc(model=self.name)
+        if deadline_missed:
+            self._m_slo.inc(model=self.name, bucket=bucket or "all")
 
     def record_breaker_open(self):
         with self._lock:
             self.breaker_opens += 1
         self._m_breaker_opens.inc(model=self.name)
 
-    def record_done(self, latency_s: float, ok: bool):
+    def record_done(self, latency_s: float, ok: bool,
+                    bucket: Optional[str] = None,
+                    deadline_missed: bool = False):
         with self._lock:
             self.completed += ok
             self.failed += (not ok)
-            self._lat.append(latency_s)
+            self._sketch.add(latency_s)
+            if bucket is not None:
+                sk = self._sketch_by_bucket.get(bucket)
+                if sk is None:
+                    sk = self._sketch_by_bucket[bucket] = QuantileSketch()
+                sk.add(latency_s)
+            if deadline_missed:
+                self.slo_violations += 1
         self._m_latency.observe(latency_s, model=self.name)
+        if deadline_missed:
+            self._m_slo.inc(model=self.name, bucket=bucket or "all")
         if not ok:
             self._m_failed.inc(model=self.name)
 
+    @classmethod
+    def _quantiles_ms(cls, sk: QuantileSketch) -> Dict:
+        """One sketch's quantile row (ms, rounded) for JSON surfaces."""
+        if not sk.count:
+            return {"count": 0}
+        out: Dict = {"count": sk.count}
+        for q, label in cls.QUANTILES:
+            out[label] = round(sk.quantile(q) * 1e3, 3)
+        return out
+
+    def latency_quantiles(self) -> Dict:
+        """p50/p90/p99/p99.9 (ms) overall and per batch bucket — the
+        ``/healthz`` latency block and the ``/v2/metrics`` detail."""
+        with self._lock:
+            out = {"all": self._quantiles_ms(self._sketch)}
+            for b in sorted(self._sketch_by_bucket):
+                out[b] = self._quantiles_ms(self._sketch_by_bucket[b])
+        return out
+
+    def quantile_rows(self) -> List[Tuple[Dict, float]]:
+        """``(labels, seconds)`` rows for the
+        ``ff_request_latency_quantile`` gauge — sampled at scrape time
+        by ``render_prometheus`` (set_all semantics: rows for unloaded
+        models disappear with their scheduler)."""
+        rows: List[Tuple[Dict, float]] = []
+        with self._lock:
+            sketches = [("all", self._sketch)] \
+                + sorted(self._sketch_by_bucket.items())
+            for b, sk in sketches:
+                if not sk.count:
+                    continue
+                for q, _ in self.QUANTILES:
+                    rows.append(({"model": self.name, "bucket": b,
+                                  "quantile": str(q)}, sk.quantile(q)))
+        return rows
+
     def snapshot(self, queue_depth: int) -> Dict:
         with self._lock:
-            lat = sorted(self._lat)
-            pct = (lambda p: lat[min(len(lat) - 1,
-                                     int(p * len(lat)))] if lat else 0.0)
+            sk = self._sketch
+            # empty sketch reports 0.0 (NaN would poison JSON surfaces
+            # and the pre-traffic /healthz probe)
+            q = {label: (sk.quantile(p) if sk.count else 0.0)
+                 for p, label in self.QUANTILES}
+            by_bucket = {
+                b: self._quantiles_ms(s)
+                for b, s in sorted(self._sketch_by_bucket.items())}
             return {
                 "requests": self.requests,
                 "completed": self.completed,
@@ -300,26 +396,33 @@ class SchedulerMetrics:
                 "expired": self.expired,
                 "deadline_rejected": self.deadline_rejected,
                 "breaker_opens": self.breaker_opens,
+                "slo_violations": self.slo_violations,
                 "batches": self.batches,
                 "mean_batch_rows": (self.batched_rows
                                     / max(self.batches, 1)),
                 "queue_depth": queue_depth,
-                "latency_p50_ms": round(pct(0.50) * 1e3, 3),
-                "latency_p99_ms": round(pct(0.99) * 1e3, 3),
+                "latency_p50_ms": round(q["p50"] * 1e3, 3),
+                "latency_p90_ms": round(q["p90"] * 1e3, 3),
+                "latency_p99_ms": round(q["p99"] * 1e3, 3),
+                "latency_p999_ms": round(q["p99.9"] * 1e3, 3),
+                "latency_by_bucket_ms": by_bucket,
             }
 
 
 class _Request:
     __slots__ = ("inputs", "rows", "deadline", "abandoned", "probe",
-                 "event", "result", "error", "t0")
+                 "event", "result", "error", "t0", "trace", "bucket")
 
     def __init__(self, inputs, rows: int = 0,
-                 deadline: Optional[float] = None, probe: bool = False):
+                 deadline: Optional[float] = None, probe: bool = False,
+                 trace=None, bucket: Optional[str] = None):
         self.inputs = inputs
         self.rows = rows or int(next(iter(inputs.values())).shape[0])
         self.deadline = deadline      # absolute perf_counter time
         self.abandoned = False        # client gave up waiting
         self.probe = probe            # holds the half-open probe slot
+        self.trace = trace            # RequestTrace or None (disabled)
+        self.bucket = bucket          # batch-bucket label for metrics
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
@@ -452,9 +555,25 @@ class BatchScheduler:
         batches = backlog / float(max(1, self.max_batch))
         return ewma * batches / max(1, self.num_instances)
 
+    def _bucket_label(self, rows: int) -> str:
+        """Batch-bucket label for metrics/traces: the smallest serving
+        bucket that fits ``rows`` (the padding target the session will
+        actually run), or the raw row count for bucketless sessions
+        (bounded: rows <= max_batch)."""
+        session = self.session
+        buckets = getattr(session, "buckets", None) \
+            or getattr(session, "batch_buckets", None)
+        if buckets:
+            for b in sorted(buckets):
+                if rows <= b:
+                    return str(b)
+            return str(sorted(buckets)[-1])
+        return str(rows)
+
     def infer(self, inputs: Dict[str, np.ndarray],
               timeout: float = 30.0,
-              deadline_ms: Optional[float] = None) -> np.ndarray:
+              deadline_ms: Optional[float] = None,
+              trace=None) -> np.ndarray:
         """Blocking single-request API (each row batch is one request).
 
         ``deadline_ms`` (or the scheduler's ``default_deadline_ms``)
@@ -465,18 +584,37 @@ class BatchScheduler:
         wait marks the request abandoned so it cannot be batched later.
         Raises :class:`QueueFullError` / :class:`CircuitOpenError` /
         :class:`DrainingError` for the shedding cases (HTTP 503) and
-        :class:`InvalidInputError` for malformed inputs (HTTP 400)."""
+        :class:`InvalidInputError` for malformed inputs (HTTP 400).
+
+        ``trace`` is the request's lifecycle
+        :class:`~..obs.request_trace.RequestTrace` (the HTTP fronts
+        pass one carrying the client's ``x-ff-trace-id``); when tracing
+        is enabled and none is given the scheduler starts its own, so
+        direct API callers get linked spans too. Every terminal path
+        records the outcome on the trace's response span."""
+        if trace is None:
+            trace = request_trace.start(model=self.metrics.name)
         with self._stat_lock:
             draining = self._draining
         if draining:
             self.metrics.record_rejected()
+            if trace is not None:
+                trace.finish("rejected", reason="draining")
             raise DrainingError(
                 f"model {self.metrics.name!r} is draining for shutdown",
                 retry_after_s=5.0)
-        arrs, rows = self._validate(inputs)
+        try:
+            arrs, rows = self._validate(inputs)
+        except InvalidInputError:
+            if trace is not None:
+                trace.finish("invalid")
+            raise
+        bucket = self._bucket_label(rows)
         admitted, retry_after, probe = self.breaker.allow()
         if not admitted:
             self.metrics.record_rejected()
+            if trace is not None:
+                trace.finish("breaker", bucket=bucket)
             raise CircuitOpenError(
                 f"circuit open for model {self.metrics.name!r} after "
                 f"repeated session failures; retry in {retry_after:.1f}s",
@@ -493,12 +631,16 @@ class BatchScheduler:
                     # nothing about model health, so the slot must not
                     # stay held or half-open would wedge forever
                     self.breaker.release_probe()
-                self.metrics.record_deadline_rejected()
+                self.metrics.record_deadline_rejected(bucket=bucket)
+                if trace is not None:
+                    trace.finish("deadline-rejected", bucket=bucket,
+                                 estimated_wait_ms=round(est * 1e3, 3))
                 raise DeadlineRejectedError(
                     f"estimated queue wait {est * 1e3:.0f} ms exceeds "
                     f"the request deadline {dl_ms:.0f} ms",
                     retry_after_s=max(est - dl_ms / 1e3, 0.1))
-        r = _Request(arrs, rows, deadline, probe=probe)
+        r = _Request(arrs, rows, deadline, probe=probe, trace=trace,
+                     bucket=bucket)
         # count the rows BEFORE the put: a worker popping the request
         # immediately would otherwise decrement first and drive the
         # admission backlog transiently negative under load
@@ -514,6 +656,9 @@ class BatchScheduler:
             if probe:
                 self.breaker.release_probe()
             self.metrics.record_rejected()
+            if trace is not None:
+                trace.finish("rejected", reason="queue-full",
+                             bucket=bucket)
             raise QueueFullError(
                 f"request queue full ({self._q.maxsize}); retry later")
         self.metrics.record_submitted()
@@ -536,8 +681,14 @@ class BatchScheduler:
             r.abandoned = True
             if deadline is not None \
                     and time.perf_counter() >= deadline:
+                if trace is not None:
+                    trace.finish("expired", r.t0, bucket=bucket,
+                                 reason="deadline")
                 raise DeadlineExceededError(
                     f"request deadline ({dl_ms:.0f} ms) exceeded")
+            if trace is not None:
+                trace.finish("expired", r.t0, bucket=bucket,
+                             reason="client-timeout")
             raise TimeoutError("inference request timed out")
         if r.error is not None:
             raise r.error
@@ -652,7 +803,10 @@ class BatchScheduler:
             r.error = RequestRejected(
                 "scheduler closed (model unloaded or shut down); "
                 "retry against another replica", retry_after_s=5.0)
-            self.metrics.record_expired()
+            self.metrics.record_expired(bucket=r.bucket)
+            if r.trace is not None:
+                r.trace.finish("expired", r.t0, bucket=r.bucket,
+                               reason="closed")
             r.event.set()
 
     # ------------------------------------------------------------------
@@ -672,7 +826,16 @@ class BatchScheduler:
             self.breaker.release_probe()
         r.error = DeadlineExceededError(
             "request expired in queue before reaching a device step")
-        self.metrics.record_expired()
+        # an expired request with a deadline missed its SLO; a merely
+        # abandoned one (client timeout shorter than any deadline) did
+        # not breach a deadline the server agreed to
+        missed = (r.deadline is not None
+                  and time.perf_counter() >= r.deadline)
+        self.metrics.record_expired(bucket=r.bucket,
+                                    deadline_missed=missed)
+        if r.trace is not None:
+            r.trace.finish("expired", r.t0, bucket=r.bucket,
+                           reason="queue-expired")
         r.event.set()
 
     def _take(self, timeout: float) -> Optional[_Request]:
@@ -689,6 +852,11 @@ class BatchScheduler:
                 return None
             with self._stat_lock:
                 self._queued_rows -= r.rows
+            if r.trace is not None:
+                # queue-wait span for live AND expired requests: the
+                # expired trace must still show where the time went
+                r.trace.stage("queue", r.t0, bucket=r.bucket,
+                              rows=r.rows)
             if r.abandoned or (r.deadline is not None
                                and time.perf_counter() >= r.deadline):
                 self._expire(r)
@@ -724,7 +892,18 @@ class BatchScheduler:
             self._pending -= 1
             self._active -= 1
             self._active_rows -= r.rows
-        self.metrics.record_done(now - r.t0, ok=True)
+        missed = r.deadline is not None and now > r.deadline
+        self.metrics.record_done(now - r.t0, ok=True, bucket=r.bucket,
+                                 deadline_missed=missed)
+        if r.trace is not None:
+            # finish BEFORE event.set: the waiter (or the HTTP layer
+            # above it) sees the latch already taken and cannot record
+            # a second, less precise outcome
+            if missed:
+                r.trace.finish("ok", r.t0, bucket=r.bucket,
+                               deadline_missed=True)
+            else:
+                r.trace.finish("ok", r.t0, bucket=r.bucket)
         r.event.set()
 
     def _finish_error(self, r: _Request, e: Exception):
@@ -733,7 +912,11 @@ class BatchScheduler:
             self._active -= 1
             self._active_rows -= r.rows
         r.error = e
-        self.metrics.record_done(time.perf_counter() - r.t0, ok=False)
+        self.metrics.record_done(time.perf_counter() - r.t0, ok=False,
+                                 bucket=r.bucket)
+        if r.trace is not None:
+            r.trace.finish("failed", r.t0, bucket=r.bucket,
+                           error=type(e).__name__)
         r.event.set()
 
     def _observe_batch_latency(self, dt: float):
@@ -774,9 +957,10 @@ class BatchScheduler:
             batch = self._drain()
             if not batch:
                 continue
+            brows = sum(r.rows for r in batch)
             with self.metrics._lock:
                 self.metrics.batches += 1
-                self.metrics.batched_rows += sum(r.rows for r in batch)
+                self.metrics.batched_rows += brows
             t_exec = time.perf_counter()
             try:
                 names = session.input_names
@@ -799,4 +983,10 @@ class BatchScheduler:
             for r in batch:
                 r.result = out[off:off + r.rows]
                 off += r.rows
+                if r.trace is not None:
+                    # batch-assembly + device-step span, one per member
+                    # so each request's trace shows the batch it rode
+                    r.trace.stage("batch", t_exec, now - t_exec,
+                                  bucket=r.bucket, batch_rows=brows,
+                                  batch_requests=len(batch))
                 self._finish_ok(r, now)

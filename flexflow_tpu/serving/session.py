@@ -8,10 +8,12 @@ input-shape) and zero-copy host->device batch assembly.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import request_trace
 from ..resilience import faults
 
 
@@ -150,6 +152,12 @@ class InferenceSession:
                                top_k=top_k, top_p=top_p,
                                num_beams=num_beams)
                  for i in range(0, n, cap)], axis=0)
+        # ambient request trace (set by the HTTP front): generate runs
+        # on the caller's thread, so its lifecycle stages — batch
+        # padding here, the instance-lock wait below, the prefill/
+        # decode spans inside FFModel.generate — link into the request
+        trace = request_trace.current()
+        t_pad = time.perf_counter()
         bucket = _next_bucket(n, self.buckets)
         if bucket != n:
             pad = np.zeros((bucket - n,) + ids.shape[1:], ids.dtype)
@@ -158,6 +166,8 @@ class InferenceSession:
                 # padded rows decode from a dummy 1-token prompt
                 prompt_len = np.concatenate(
                     [prompt_len, np.ones(bucket - n, np.int32)])
+        if trace is not None:
+            trace.stage("batch", t_pad, bucket=str(bucket), rows=n)
         seg = int(getattr(self, "decode_segment", 0) or 0)
         if (num_beams == 1 and temperature == 0.0 and not top_k
                 and top_p >= 1.0 and 0 < seg < max_new_tokens):
@@ -170,7 +180,12 @@ class InferenceSession:
                                            max_new_tokens, seg,
                                            eos_token_id, ragged)
             return np.asarray(out)[:n]
+        t_lock = time.perf_counter()
         with self._lock:
+            if trace is not None:
+                # instance-lock wait = this request's queue time on the
+                # single-hold decode path
+                trace.stage("queue", t_lock, bucket=str(bucket))
             if num_beams > 1:
                 # beam search is deterministic: temperature/top-k/top-p
                 # do not apply
@@ -204,16 +219,29 @@ class InferenceSession:
                 else int(prompt_len))
         done = np.zeros(b, bool)
         col = np.arange(L)[None, :]
+        trace = request_trace.current()
+        seg_idx = 0
         offset, remaining = 0, int(max_new_tokens)
         while remaining > 0:
             step = min(seg, remaining)
             cur = plen + offset
+            t_wait = time.perf_counter()
             with self._lock:
+                if trace is not None and seg_idx == 0:
+                    # first lock acquisition = the request's queue time
+                    # on this instance (later waits show up as gaps
+                    # between decode_segment spans)
+                    trace.stage("queue", t_wait, bucket=str(b))
+                t_step = time.perf_counter()
                 # np.array (copy): the device buffer view is read-only
                 # and the eos forcing below writes in place
                 out = np.array(self.ff.generate(
                     out, cur, step, temperature=0.0,
                     eos_token_id=eos_token_id))
+            if trace is not None:
+                trace.stage("decode_segment", t_step, segment=seg_idx,
+                            tokens=step, bucket=str(b))
+            seg_idx += 1
             if eos_token_id is not None:
                 starts = np.asarray(cur, np.int64) if ragged \
                     else np.full(b, cur, np.int64)
@@ -294,6 +322,22 @@ class ServingPlanSession:
             {b: s.clone() for b, s in self._by_bucket.items()})
         c.floor_guard = self.floor_guard
         return c
+
+    def measured_profile(self) -> Dict[str, Dict]:
+        """Measured per-bucket decode reality, keyed 1:1 to the serving
+        audit block's ``predicted`` entries: bucket label ->
+        ``{prefill_s, decode_step_s, n}`` — the min-tracked sink
+        ``FFModel._generate_kv`` maintains per batch size on each
+        bucket's model.  Buckets that have served no generate traffic
+        yet are absent (``obs.drift.serving_drift_report`` skips them
+        rather than report drift on zero measurements).  Clones share
+        the underlying ``ff``, so any instance's traffic lands here."""
+        out: Dict[str, Dict] = {}
+        for b, s in self._by_bucket.items():
+            rec = getattr(s.ff, "_decode_measured", {}).get(int(b))
+            if rec:
+                out[str(b)] = dict(rec)
+        return out
 
 
 def _min_decode_latency(ff, bucket: int, hist, reps: int = 3) -> float:
@@ -376,10 +420,11 @@ def build_serving_plan_session(serving_strategy_file: str, build,
     records = {}
     if guard:
         from ..obs import events as obs_events
-        from ..obs.metrics_registry import REGISTRY
+        from ..obs.metrics_registry import DECODE_STEP_BUCKETS, REGISTRY
         hist = REGISTRY.histogram(
             "ff_decode_step_seconds",
-            "Per-token decode-step latency by batch bucket")
+            "Per-token decode-step latency by batch bucket",
+            buckets=DECODE_STEP_BUCKETS)
         t0 = time.perf_counter()
         try:
             base = build(None, buckets=list(bks))

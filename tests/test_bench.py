@@ -116,6 +116,12 @@ def _scripted(default_probe_results):
                     "mem_ratio": 0.3469, "dp_degree": 4,
                     "n_sharded_params": 2, "step_time_ratio": 1.01,
                     "ok": True}, None
+        if stage == "serving_obs_overhead":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            return {"bare_rps": 188.4, "disabled_rps": 190.2,
+                    "enabled_rps": 189.6, "disabled_over_bare": 1.0096,
+                    "enabled_over_bare": 1.0064, "reps": 5,
+                    "ok": True}, None
         if stage == "serving_plan":
             assert env.get("JAX_PLATFORMS") == "cpu"
             assert "xla_force_host_platform_device_count" \
@@ -233,3 +239,7 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         assert out["serving_plan_bitexact"] is True
         assert out["serving_plan_kv_gate"] is True
         assert any(a[1] == "serving_plan" for a, _ in calls)
+        # and the serving-observability overhead leg (ISSUE 17)
+        assert out["serving_obs_enabled_over_bare"] == 1.0064
+        assert out["serving_obs_disabled_over_bare"] == 1.0096
+        assert any(a[1] == "serving_obs_overhead" for a, _ in calls)

@@ -156,6 +156,21 @@ ff_lat_count{model="m"} 3
     assert reg.render() == golden
 
 
+def test_prometheus_help_escaping_golden():
+    # HELP text with a newline and a backslash must render as ONE line
+    # (escaped per the exposition format) or the scrape parser breaks
+    reg = MetricsRegistry()
+    reg.counter("ff_esc", 'path C:\\x "quoted"\nline two').inc()
+    golden = """\
+# HELP ff_esc path C:\\\\x "quoted"\\nline two
+# TYPE ff_esc counter
+ff_esc 1
+"""
+    assert reg.render() == golden
+    assert len([l for l in reg.render().splitlines()
+                if l.startswith("# HELP")]) == 1
+
+
 def test_registry_kind_conflict():
     reg = MetricsRegistry()
     reg.counter("dup", "c")

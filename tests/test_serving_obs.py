@@ -1,0 +1,438 @@
+"""Serving SLO observability (ISSUE 17): streaming quantile sketches
+(merge/bounds/serialization), per-request lifecycle traces through the
+batching scheduler, disjoint outcome-counter balance with tracing
+enabled, scrape-time gauges across drain/unload, and the serving drift
+detector's row-level attribution."""
+import json
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs import events
+from flexflow_tpu.obs import request_trace
+from flexflow_tpu.obs.drift import detect_serving_drift
+from flexflow_tpu.obs.metrics_registry import (DECODE_STEP_BUCKETS,
+                                               MetricsRegistry)
+from flexflow_tpu.obs.sketch import QuantileSketch
+from flexflow_tpu.serving.scheduler import BatchScheduler, SchedulerMetrics
+
+
+@pytest.fixture
+def traced():
+    """Tracing on with a fresh buffer; restores the PRIOR enabled state
+    (the ci.sh FF_TRACE=1 pass runs other files in this process)."""
+    was_enabled = events.enabled()
+    events.enable(capacity=events.DEFAULT_CAPACITY)
+    events.clear()
+    try:
+        yield events
+    finally:
+        if not was_enabled:
+            events.disable()
+        events.clear()
+
+
+class FixedLatencySession:
+    """Synthetic scheduler instance: fixed sleep, no model compile."""
+    input_names = ["x"]
+
+    def __init__(self, t_step=0.0, fail=False):
+        self.t_step = t_step
+        self.fail = fail
+
+    def infer(self, inputs):
+        if self.t_step:
+            time.sleep(self.t_step)
+        if self.fail:
+            raise RuntimeError("injected")
+        return np.zeros((int(inputs["x"].shape[0]), 1), np.float32)
+
+
+# ----------------------------------------------------------------------
+# quantile sketch
+# ----------------------------------------------------------------------
+
+def test_sketch_relative_error_bound():
+    rng = np.random.default_rng(7)
+    vals = rng.lognormal(mean=-5.0, sigma=1.5, size=20000)
+    sk = QuantileSketch(alpha=0.01)
+    for v in vals:
+        sk.add(float(v))
+    exact = np.sort(vals)
+    for q in (0.01, 0.5, 0.9, 0.99, 0.999):
+        est = sk.quantile(q)
+        ref = float(exact[int(q * (len(exact) - 1))])
+        # DDSketch guarantee: relative error <= alpha on each side
+        assert abs(est - ref) <= 0.011 * ref + 1e-12, (q, est, ref)
+    assert sk.count == len(vals)
+    assert sk.quantile(0.0) == pytest.approx(float(exact[0]), rel=0.011)
+    assert sk.quantile(1.0) == pytest.approx(float(exact[-1]), rel=0.011)
+
+
+def test_sketch_merge_associativity_and_exactness():
+    rng = np.random.default_rng(3)
+    chunks = [rng.uniform(1e-4, 1e-1, 500) for _ in range(3)]
+    whole = QuantileSketch()
+    parts = []
+    for c in chunks:
+        p = QuantileSketch()
+        for v in c:
+            whole.add(float(v))
+            p.add(float(v))
+        parts.append(p)
+    ab_c = parts[0].copy().merge(parts[1]).merge(parts[2])
+    bc = parts[1].copy().merge(parts[2])
+    a_bc = parts[0].copy().merge(bc)
+    for q in (0.1, 0.5, 0.9, 0.99):
+        # merge is bucket-wise addition: associative AND identical to
+        # having streamed every value into one sketch
+        assert ab_c.quantile(q) == a_bc.quantile(q)
+        assert ab_c.quantile(q) == whole.quantile(q)
+    assert ab_c.count == a_bc.count == whole.count == 1500
+
+
+def test_sketch_merge_alpha_mismatch_raises():
+    with pytest.raises(ValueError, match="alpha"):
+        QuantileSketch(alpha=0.01).merge(QuantileSketch(alpha=0.02))
+
+
+def test_sketch_memory_bound_collapse():
+    sk = QuantileSketch(alpha=0.01, max_bins=32)
+    # 12 decades of dynamic range cannot fit 32 gamma-bins uncollapsed
+    for e in range(-6, 6):
+        for m in range(1, 10):
+            sk.add(m * 10.0 ** e)
+    assert len(sk._bins) <= 32
+    # the collapse folds LOW bins: the upper quantiles keep their bound
+    assert sk.quantile(1.0) == pytest.approx(sk.max, rel=0.011)
+    assert sk.quantile(0.999) <= sk.max
+    assert sk.quantile(0.0) >= sk.min   # clamped, never below observed
+
+
+def test_sketch_serialization_roundtrip():
+    sk = QuantileSketch()
+    for v in (1e-4, 3e-3, 2e-2, 2e-2, 0.5):
+        sk.add(v)
+    rt = QuantileSketch.from_dict(json.loads(json.dumps(sk.to_dict())))
+    assert rt.count == sk.count
+    assert rt.min == sk.min and rt.max == sk.max
+    for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+        assert rt.quantile(q) == sk.quantile(q)
+    empty = QuantileSketch.from_dict(QuantileSketch().to_dict())
+    assert len(empty) == 0 and math.isnan(empty.quantile(0.5))
+
+
+def test_sketch_rejects_bad_quantile_and_ignores_nan():
+    sk = QuantileSketch()
+    sk.add(float("nan"))
+    assert sk.count == 0
+    sk.add(0.01)
+    with pytest.raises(ValueError):
+        sk.quantile(1.5)
+    assert sk.mean == pytest.approx(0.01)
+
+
+# ----------------------------------------------------------------------
+# scheduler metrics: sketches, SLO accounting, decode buckets
+# ----------------------------------------------------------------------
+
+def test_metrics_snapshot_quantiles_and_slo():
+    m = SchedulerMetrics(name="m")
+    for i in range(100):
+        m.record_done(0.010 + i * 1e-4, ok=True, bucket="4")
+    m.record_done(0.500, ok=True, bucket="4", deadline_missed=True)
+    m.record_expired(bucket="4", deadline_missed=True)
+    m.record_expired(bucket="4")                  # no deadline: not SLO
+    m.record_deadline_rejected(bucket="4")
+    snap = m.snapshot(queue_depth=0)
+    assert snap["slo_violations"] == 3
+    assert snap["completed"] == 101 and snap["expired"] == 2
+    assert 0 < snap["latency_p50_ms"] <= snap["latency_p90_ms"] \
+        <= snap["latency_p99_ms"] <= snap["latency_p999_ms"]
+    assert snap["latency_by_bucket_ms"]["4"]["count"] == 101
+    rows = m.quantile_rows()
+    labels = {(r[0]["bucket"], r[0]["quantile"]) for r in rows}
+    assert ("all", "0.5") in labels and ("4", "0.999") in labels
+    assert all(v > 0 for _, v in rows)
+
+
+def test_decode_step_buckets_resolve_microseconds():
+    # the old DEFAULT_BUCKETS floor (1 ms) flattened every CPU-sim
+    # decode step into one bin; the decode set must resolve us-scale
+    assert DECODE_STEP_BUCKETS[0] <= 1e-6
+    assert any(b < 1e-3 for b in DECODE_STEP_BUCKETS)
+    assert list(DECODE_STEP_BUCKETS) == sorted(DECODE_STEP_BUCKETS)
+    reg = MetricsRegistry()
+    h1 = reg.histogram("ff_decode_step_seconds", "d",
+                       buckets=DECODE_STEP_BUCKETS)
+    # every registration site must agree on the explicit set
+    assert reg.histogram("ff_decode_step_seconds", "d",
+                         buckets=DECODE_STEP_BUCKETS) is h1
+    with pytest.raises(ValueError):
+        reg.histogram("ff_decode_step_seconds", "d", buckets=(1e-3, 1.0))
+
+
+# ----------------------------------------------------------------------
+# prometheus exposition: escaping + scrape-time gauges across unload
+# ----------------------------------------------------------------------
+
+def test_help_text_escaping_roundtrip():
+    reg = MetricsRegistry()
+    help_text = 'latency "p99"\nsecond line with \\backslash'
+    reg.counter("ff_esc_test", help_text).inc(model="m\nx")
+    text = reg.render()
+    lines = text.splitlines()
+    help_lines = [l for l in lines if l.startswith("# HELP ff_esc_test")]
+    assert len(help_lines) == 1, "escaped newline must not split HELP"
+    escaped = help_lines[0][len("# HELP ff_esc_test "):]
+    # exposition-format unescape must restore the original text verbatim
+    unescaped = ""
+    i = 0
+    while i < len(escaped):
+        if escaped.startswith("\\\\", i):
+            unescaped += "\\"
+            i += 2
+        elif escaped.startswith("\\n", i):
+            unescaped += "\n"
+            i += 2
+        else:
+            unescaped += escaped[i]
+            i += 1
+    assert unescaped == help_text
+    assert "# TYPE ff_esc_test counter" in lines
+    # label VALUES stay escaped too (the pre-existing contract)
+    assert '{model="m\\nx"}' in text
+
+
+def test_queue_depth_gauge_follows_drain_and_unload():
+    from flexflow_tpu.serving.http_server import render_prometheus
+    scheds = {"a": BatchScheduler(FixedLatencySession(), max_batch=4,
+                                  max_delay_ms=0.0, name="a"),
+              "b": BatchScheduler(FixedLatencySession(), max_batch=4,
+                                  max_delay_ms=0.0, name="b")}
+    try:
+        x = np.zeros((1, 1), np.float32)
+        for _ in range(3):
+            scheds["a"].infer({"x": x}, timeout=5.0)
+        text = render_prometheus(scheds)
+        assert 'ff_queue_depth{model="a"}' in text
+        assert 'ff_queue_depth{model="b"}' in text
+        assert 'ff_request_latency_quantile{' in text
+        assert 'quantile="0.999"' in text
+        # unload b: set_all semantics — its rows disappear, a's stay
+        b = scheds.pop("b")
+        b.close()
+        text = render_prometheus(scheds)
+        assert 'ff_queue_depth{model="b"}' not in text
+        assert 'ff_queue_depth{model="a"}' in text
+        # drain a: the gauge row survives (still loaded) at depth 0
+        scheds["a"].drain(deadline_s=2.0)
+        text = render_prometheus(scheds)
+        assert 'ff_queue_depth{model="a"} 0' in text
+    finally:
+        for s in scheds.values():
+            s.close()
+
+
+# ----------------------------------------------------------------------
+# lifecycle tracing through the scheduler
+# ----------------------------------------------------------------------
+
+def test_request_trace_lifecycle_spans(traced):
+    sched = BatchScheduler(FixedLatencySession(t_step=0.005),
+                           max_batch=4, max_delay_ms=0.0, name="m")
+    try:
+        trace = request_trace.start(model="m", trace_id="deadbeef01")
+        assert trace is not None
+        sched.infer({"x": np.zeros((2, 1), np.float32)}, timeout=5.0,
+                    trace=trace)
+        # idempotent one-shot finish: a later coarse finish is a no-op
+        trace.finish("failed")
+    finally:
+        sched.close()
+    spans = [e for e in events.events()
+             if (e.get("attrs") or {}).get("trace") == "deadbeef01"]
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    assert set(by_name) >= {"request.queue", "request.batch",
+                            "request.response"}
+    resp = by_name["request.response"]
+    assert len(resp) == 1, "finish must be one-shot"
+    assert resp[0]["attrs"]["outcome"] == "ok"
+    assert resp[0]["attrs"]["model"] == "m"
+    batch = by_name["request.batch"][0]["attrs"]
+    assert batch["batch_rows"] >= 2 and batch["bucket"]
+
+
+def test_request_trace_noop_when_disabled():
+    was_enabled = events.enabled()
+    events.disable()
+    try:
+        assert request_trace.start(model="m") is None
+        assert request_trace.from_headers({"x-ff-trace-id": "abc"},
+                                          model="m") is None
+        # the scheduler path runs untraced without branching errors
+        sched = BatchScheduler(FixedLatencySession(), max_batch=2,
+                               max_delay_ms=0.0, name="m")
+        try:
+            sched.infer({"x": np.zeros((1, 1), np.float32)}, timeout=5.0)
+        finally:
+            sched.close()
+    finally:
+        if was_enabled:
+            events.enable(capacity=events.DEFAULT_CAPACITY)
+
+
+def test_trace_header_propagation_and_bounds(traced):
+    t = request_trace.from_headers({"x-ff-trace-id": "client-id-7"},
+                                   model="m")
+    assert t.trace_id == "client-id-7"
+    long = request_trace.from_headers({"x-ff-trace-id": "z" * 200},
+                                      model="m")
+    assert len(long.trace_id) == 64          # hostile header truncated
+    fresh = request_trace.from_headers({}, model="m")
+    assert fresh.trace_id and fresh.trace_id != t.trace_id
+    with request_trace.activate(t):
+        assert request_trace.current() is t
+        assert request_trace.current_id() == "client-id-7"
+    assert request_trace.current() is None
+
+
+# ----------------------------------------------------------------------
+# outcome counters stay disjoint and balanced with tracing on
+# ----------------------------------------------------------------------
+
+def test_outcome_counters_balance_across_all_terminals(traced):
+    # one phased scenario driving every disjoint terminal path with
+    # wide timing margins: the EWMA seed makes admission control
+    # deterministic, the 150 ms step makes queue timing deterministic
+    sched = BatchScheduler(FixedLatencySession(t_step=0.15),
+                           max_batch=4, max_queue=2, max_delay_ms=0.0,
+                           name="m", est_batch_latency_s=0.15)
+    x = np.zeros((1, 1), np.float32)
+    outcomes = []
+    lock = threading.Lock()
+
+    def fire(**kw):
+        def run():
+            try:
+                sched.infer({"x": x}, **kw)
+                o = "ok"
+            except Exception as e:  # noqa: BLE001 — classified below
+                o = type(e).__name__
+            with lock:
+                outcomes.append(o)
+        t = threading.Thread(target=run)
+        t.start()
+        return t
+
+    threads = [fire(timeout=10.0)]            # A: occupies the worker
+    time.sleep(0.05)                          # A popped, 100 ms left
+    # B: deadline 60 ms beats the 37.5 ms admission estimate but the
+    # worker is busy 100 ms more -> expires IN QUEUE, SLO violation
+    threads.append(fire(timeout=10.0, deadline_ms=60.0))
+    # C: no deadline, 50 ms client timeout -> abandoned, expired
+    # WITHOUT an SLO violation (no deadline the server agreed to)
+    threads.append(fire(timeout=0.05))
+    time.sleep(0.02)                          # B, C sit in the queue
+    with pytest.raises(Exception) as ei:      # D: bounded queue sheds
+        sched.infer({"x": x}, timeout=10.0)
+    assert type(ei.value).__name__ == "QueueFullError"
+    with lock:
+        outcomes.append("QueueFullError")
+    # E: 50 ms deadline < the ~112 ms estimated wait (3 rows backlog
+    # x 150 ms / max_batch 4) -> shed AT ADMISSION, SLO violation
+    with pytest.raises(Exception) as ei:
+        sched.infer({"x": x}, timeout=10.0, deadline_ms=50.0)
+    assert type(ei.value).__name__ == "DeadlineRejectedError"
+    with lock:
+        outcomes.append("DeadlineRejectedError")
+    for t in threads:
+        t.join()
+    time.sleep(0.3)       # worker sweeps the expired B/C off the queue
+    threads = [fire(timeout=10.0)]            # F: completes on an idle
+    for t in threads:                         # scheduler
+        t.join()
+    m = sched.metrics
+    sched.close()
+    fired = 6
+    assert sorted(outcomes) == ["DeadlineExceededError",
+                                "DeadlineRejectedError", "QueueFullError",
+                                "TimeoutError", "ok", "ok"]
+    # every request landed in EXACTLY one disjoint terminal counter
+    assert (m.completed, m.failed, m.expired, m.rejected,
+            m.deadline_rejected) == (2, 0, 2, 1, 1)
+    assert (m.completed + m.failed + m.expired + m.rejected
+            + m.deadline_rejected) == fired
+    # admitted == completed + failed + expired (the admission counters
+    # never double-count a request the queue shed)
+    assert m.requests == m.completed + m.failed + m.expired == 4
+    # SLO: B's queue-expiry + E's deadline-rejection; C's abandonment
+    # breached no deadline and must NOT count
+    assert m.slo_violations == 2
+    # with tracing on, every request got EXACTLY one terminal span and
+    # the span outcomes tally with the disjoint counters
+    responses = [e for e in events.events()
+                 if e["name"] == "request.response"]
+    assert len(responses) == fired
+    by_outcome = {}
+    for e in responses:
+        o = e["attrs"]["outcome"]
+        by_outcome[o] = by_outcome.get(o, 0) + 1
+    assert by_outcome == {"ok": 2, "expired": 2, "rejected": 1,
+                          "deadline-rejected": 1}
+
+
+# ----------------------------------------------------------------------
+# serving drift detection (pure detector)
+# ----------------------------------------------------------------------
+
+def _serving_audit_doc():
+    calib = [{"term": "compute", "table": "host_membw",
+              "key": "cpu|host_membw|-|0|0"},
+             {"term": "compute", "table": "analytic", "key": None}]
+    return {"workload_key": "wk-serving",
+            "serving": {"max_seq": 32, "buckets": {
+                "1": {"prefill_s": 1e-3, "decode_step_s": 1e-4,
+                      "calib": calib},
+                "4": {"prefill_s": 2e-3, "decode_step_s": 2e-4,
+                      "calib": calib}}}}
+
+
+def test_serving_drift_in_band_is_clean():
+    doc = _serving_audit_doc()
+    measured = {"1": {"prefill_s": 1.5e-3, "decode_step_s": 1.2e-4,
+                      "n": 3}}
+    rep = detect_serving_drift(doc, measured, band=4.0)
+    assert rep["kind"] == "serving"
+    assert rep["n_compared"] == 2          # bucket 4 unserved: skipped
+    assert rep["out_of_band"] == [] and rep["stale_keys"] == []
+
+
+def test_serving_drift_attributes_the_bucket_rows():
+    doc = _serving_audit_doc()
+    doc["serving"]["buckets"]["4"]["decode_step_s"] = 2e-8  # mis-calib
+    measured = {"1": {"prefill_s": 1e-3, "decode_step_s": 1e-4, "n": 2},
+                "4": {"prefill_s": 2e-3, "decode_step_s": 2e-4, "n": 2}}
+    rep = detect_serving_drift(doc, measured, band=4.0)
+    assert rep["n_out_of_band"] == 1
+    e = rep["out_of_band"][0]
+    assert e["bucket"] == 4 and e["component"] == "decode_step_s"
+    assert e["ratio"] > 4.0
+    assert e["calibration_keys"] == ["cpu|host_membw|-|0|0"]
+    assert sorted(e["tables"]) == ["analytic", "host_membw"]
+    assert rep["stale_keys"] == ["cpu|host_membw|-|0|0"]
+
+
+def test_serving_drift_noise_floor():
+    doc = _serving_audit_doc()
+    doc["serving"]["buckets"]["1"]["decode_step_s"] = 1e-9
+    measured = {"1": {"prefill_s": 1e-3, "decode_step_s": 5e-8, "n": 1}}
+    # both sides under the serving floor: no signal, no verdict
+    rep = detect_serving_drift(doc, measured, band=4.0, min_s=1e-6)
+    assert all(e["component"] != "decode_step_s"
+               for e in rep["out_of_band"])

@@ -6,7 +6,7 @@ The command-line front end of ``flexflow_tpu.analysis`` (see
 gate:
 
     python tools/ffcheck.py --lint --concurrency --spmd \\
-        --verify-strategies --budget-s 10
+        --verify-strategies --budget-s 15
 
   --lint [PATH ...]        run the invariant linter over files/trees
                            (no paths: the whole package)

@@ -1,0 +1,140 @@
+"""ffstat: one-screen live view of a serving fleet.
+
+Polls a running FlexFlow-TPU serving front-end (either HTTP front —
+they share the routes) and renders one line per model:
+
+    MODEL     CIRC    Q  INST    REQ/S   P50MS   P99MS  P99.9  SLO  EXP
+
+  - ``CIRC`` — circuit-breaker state (closed / half-open / open);
+  - ``Q`` / ``INST`` — bounded-queue depth and instances draining it;
+  - ``REQ/S`` — admission rate, differenced between frames (the first
+    frame shows ``-``: one sample has no rate);
+  - ``P50MS/P99MS/P99.9`` — streaming-sketch latency quantiles
+    (``obs/sketch.py`` — the same numbers ``/healthz`` and the
+    ``ff_request_latency_quantile`` gauges report);
+  - ``SLO`` / ``EXP`` — SLO-violation and expired-request totals.
+
+A second block lists per-bucket p99s for any model whose sketch has
+per-bucket traffic, so a single hot bucket is visible without Grafana.
+
+Everything comes from two GETs per frame (``/healthz`` +
+``/v2/metrics``), both cheap by contract — safe to leave running
+against a production port.
+
+Usage:
+    python tools/ffstat.py --port 8000             # live, 2 s frames
+    python tools/ffstat.py --port 8000 --once      # one frame (CI)
+    python tools/ffstat.py --url http://host:8000 --interval 5
+
+Exit status: 0 on a clean run, 2 when the server was unreachable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+_TIMEOUT_S = 5.0     # per-request bound: a stat tool must never hang
+
+
+def _get_json(base: str, path: str) -> Dict[str, Any]:
+    with urllib.request.urlopen(base + path, timeout=_TIMEOUT_S) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def fetch(base: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """One frame's raw facts: (/healthz doc, /v2/metrics models map)."""
+    health = _get_json(base, "/healthz")
+    metrics = _get_json(base, "/v2/metrics").get("models", {})
+    return health, metrics
+
+
+def _fmt_rate(cur: Dict, prev: Optional[Dict], dt: float) -> str:
+    if prev is None or dt <= 0:
+        return "-"
+    d = cur.get("requests", 0) - prev.get("requests", 0)
+    return f"{d / dt:.1f}"
+
+
+def render_frame(health: Dict[str, Any], metrics: Dict[str, Any],
+                 prev: Optional[Dict[str, Any]] = None,
+                 dt: float = 0.0) -> str:
+    """Render one frame as text. Pure — the smoke test calls this with
+    canned docs; ``main`` adds the polling/diffing around it."""
+    lines = []
+    draining = bool(health.get("draining"))
+    trace = health.get("trace") or {}
+    head = (f"ffstat · {len(metrics)} model(s)"
+            f"{' · DRAINING' if draining else ''}"
+            f" · trace={'on' if trace.get('enabled') else 'off'}")
+    lines.append(head)
+    lines.append(f"{'MODEL':<14}{'CIRC':<10}{'Q':>4}{'INST':>5}"
+                 f"{'REQ/S':>8}{'P50MS':>8}{'P99MS':>8}{'P99.9':>8}"
+                 f"{'SLO':>6}{'EXP':>6}")
+    for name in sorted(metrics):
+        m = metrics[name]
+        lines.append(
+            f"{name[:13]:<14}"
+            f"{str(m.get('circuit', '?'))[:9]:<10}"
+            f"{m.get('queue_depth', 0):>4}"
+            f"{m.get('instances', 0):>5}"
+            f"{_fmt_rate(m, (prev or {}).get(name), dt):>8}"
+            f"{m.get('latency_p50_ms', 0.0):>8.2f}"
+            f"{m.get('latency_p99_ms', 0.0):>8.2f}"
+            f"{m.get('latency_p999_ms', 0.0):>8.2f}"
+            f"{m.get('slo_violations', 0):>6}"
+            f"{m.get('expired', 0):>6}")
+    bucket_rows = []
+    for name in sorted(metrics):
+        for b, q in sorted((metrics[name].get("latency_by_bucket_ms")
+                            or {}).items(), key=lambda kv: kv[0]):
+            if q.get("count"):
+                bucket_rows.append(
+                    f"  {name[:13]:<14}bucket {b:>6}  "
+                    f"n={q['count']:<8}p99={q.get('p99', 0.0):.2f}ms")
+    if bucket_rows:
+        lines.append("per-bucket p99:")
+        lines.extend(bucket_rows)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ffstat", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--url", default=None,
+                    help="server base url (default http://127.0.0.1:<port>)")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between frames (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (CI / scripting)")
+    a = ap.parse_args(argv)
+    base = a.url or f"http://{a.host}:{a.port}"
+    base = base.rstrip("/")
+    prev: Optional[Dict[str, Any]] = None
+    t_prev = 0.0
+    while True:
+        try:
+            health, metrics = fetch(base)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"ffstat: {base} unreachable: {e}", file=sys.stderr)
+            return 2
+        now = time.perf_counter()
+        print(render_frame(health, metrics, prev, now - t_prev))
+        if a.once:
+            return 0
+        prev, t_prev = metrics, now
+        sys.stdout.flush()
+        time.sleep(max(0.2, a.interval))
+        # frame separator, not a screen clear: scrollback keeps history
+        print()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

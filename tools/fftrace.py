@@ -20,6 +20,14 @@ instant on every rank. This tool places all the dumps on ONE timeline:
 Flight-recorder dumps (``flight_rank<r>_epoch<e>.json``) are accepted
 as inputs too — their bounded event tails merge the same way.
 
+Serving-process dumps (``trace_serving_<pid>.json``, written by
+``trace_export.dump_serving_trace``) merge as their own
+``serving pid P`` lanes, placed after the worker ranks: one Chrome
+trace shows a request's full lifecycle spans — admission, queue wait,
+batch assembly, prefill, per-segment decode, response — linked across
+the serving front-end and scheduler threads by per-request flow events
+(spans sharing one ``trace`` id).
+
 Usage:
     python tools/fftrace.py                      # merge .ffcache dumps
     python tools/fftrace.py a.json b.json -o merged.json
@@ -68,7 +76,11 @@ def _anchor_perf(doc: Dict[str, Any]) -> Optional[float]:
 def _rank_num(d: Dict[str, Any]) -> int:
     """Numeric sort key for a dump's rank. Worker ranks are ints;
     launcher-side flight records carry ``rank="launcher"`` — sort those
-    after every worker instead of crashing the merge."""
+    after every worker instead of crashing the merge. Serving dumps sit
+    outside the training world entirely: clamp them past the launcher
+    so their lanes trail every rank."""
+    if d.get("role") == "serving":
+        return (1 << 20) + 1
     r = d.get("rank", 0)
     try:
         return int(r)
@@ -113,15 +125,20 @@ def merge_rank_traces(paths: List[str]) -> Dict[str, Any]:
         aligned = anchor is not None
         base = anchor if aligned else min(
             (e["ts"] for e in d["events"]), default=0.0)
-        name = f"rank {rank} · epoch {epoch}"
+        serving = d.get("role") == "serving"
+        if serving:
+            name = f"serving pid {d.get('pid', '?')}"
+        else:
+            name = f"rank {rank} · epoch {epoch}"
         if not aligned:
             name += " (unaligned)"
         reason = d.get("reason")
         if reason:                    # a flight record, not a full dump
             name += f" [flight: {reason}]"
         # sort: epoch block, then rank, flights after full dumps, the
-        # launcher (rank_num clamped) at its epoch's tail
-        sort_index = (epoch * 4096 + min(_rank_num(d), 1024)
+        # launcher (rank_num clamped) at its epoch's tail, serving
+        # lanes (rank_num clamped one past the launcher) after that
+        sort_index = (epoch * 4096 + min(_rank_num(d), 1025)
                       + (2048 if reason else 0))
         sub = to_chrome_trace(d["events"], d.get("counters") or {},
                               pid=pid, process_name=name,
@@ -129,6 +146,7 @@ def merge_rank_traces(paths: List[str]) -> Dict[str, Any]:
                               base=base + origin)
         events.extend(sub["traceEvents"])
         lanes.append({"pid": pid, "rank": rank, "epoch": epoch,
+                      "role": d.get("role", "rank"),
                       "aligned": aligned,
                       "n_events": len(d["events"]),
                       "dropped": d.get("dropped",
@@ -145,7 +163,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("inputs", nargs="*",
                     help="rank dump files (default: every "
-                         "trace_rank*_epoch*.json in the cache dir)")
+                         "trace_rank*_epoch*.json and "
+                         "trace_serving_*.json in the cache dir)")
     ap.add_argument("-o", "--output", default=None,
                     help="merged Chrome trace path "
                          "(default: <cache>/trace_merged.json)")
@@ -159,6 +178,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not paths:
         paths = sorted(glob.glob(os.path.join(
             a.cache_dir, "trace_rank*_epoch*.json")))
+        paths += sorted(glob.glob(os.path.join(
+            a.cache_dir, "trace_serving_*.json")))
         if a.include_flights:
             paths += sorted(glob.glob(os.path.join(
                 a.cache_dir, "flight_rank*_epoch*.json")))
@@ -179,7 +200,9 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"{len(doc['traceEvents'])} event(s) -> {out}")
     for ln in lanes:
         tag = "" if ln["aligned"] else " (unaligned)"
-        print(f"  rank {ln['rank']} epoch {ln['epoch']}: "
+        who = ("serving" if ln.get("role") == "serving"
+               else f"rank {ln['rank']} epoch {ln['epoch']}")
+        print(f"  {who}: "
               f"{ln['n_events']} events, {ln['dropped']} dropped{tag}")
     return 0
 

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Serving-SLO observability smoke (ci.sh fast tier, FF_TRACE=1).
+
+Drives the serving observability stack end-to-end on the 8-device CPU
+mesh and asserts the three contracts the PR makes:
+
+  1. **Lifecycle tracing** — one generate request with a client-sent
+     ``x-ff-trace-id`` produces ONE linked trace: admission, queue
+     wait, batch assembly, prefill, per-segment decode, and response
+     spans all carry that id, the id is echoed on the response, and
+     the Chrome export links the spans with flow events
+     (``tools/fftrace.py`` merges the serving dump into its own lane);
+  2. **Streaming quantile sketches** — after live traffic, ``/healthz``
+     reports non-zero sketch quantiles per (model, bucket), the
+     ``ff_request_latency_quantile`` gauges land in ``/metrics``, and a
+     deadline-expired request (tiny ``x-ff-timeout-ms``) shows up as an
+     SLO violation;
+  3. **Serving drift detection** — the measured per-bucket decode
+     profile lands keyed 1:1 to the serving audit block's predicted
+     entries, and an injected mis-calibrated predicted row produces a
+     drift report attributing exactly that bucket to the calibration
+     rows its pricing consulted — and marks those rows stale.
+
+Exit code 0 = all three contracts hold.
+"""
+import json
+import os
+import socket
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the whole point of this smoke: the obs ring must be live before any
+# flexflow import
+os.environ["FF_TRACE"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+
+BUCKETS = (1, 4)
+TRACE_ID = "obssmoke0badc0de"
+#: span names one generate request's linked trace must cover —
+#: admission (HTTP parse), queue (instance-lock wait), batch (bucket
+#: padding), prefill + decode (model spans), per-segment decode
+#: (session spans), response (terminal outcome)
+LIFECYCLE = ("request.admission", "request.queue", "request.batch",
+             "request.decode_segment", "request.response",
+             "generate.prefill", "generate.decode")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _post(base, path, doc, headers=None):
+    req = urllib.request.Request(base + path,
+                                 data=json.dumps(doc).encode())
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        r = urllib.request.urlopen(req, timeout=60)
+        return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _get(base, path):
+    return json.loads(urllib.request.urlopen(base + path,
+                                             timeout=10).read())
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    if len(jax.devices()) < 8:
+        print("serving obs smoke: need 8 virtual devices", file=sys.stderr)
+        return 1
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models.nlp import GPTConfig, build_gpt2
+    from flexflow_tpu.obs import events as obs_events
+    from flexflow_tpu.search.calibration import (CalibrationTable,
+                                                 MeshCalibration)
+    assert obs_events.enabled(), "FF_TRACE=1 did not enable the ring"
+
+    cfg = FFConfig()
+    cfg.only_data_parallel = False
+    cfg.search_budget = 60
+    ff = FFModel(cfg)
+    out = build_gpt2(ff, 8, 32, GPTConfig.tiny())
+    ff.compile(SGDOptimizer(0.0), "identity", [], output_tensor=out)
+
+    # -- seeded calibration: provenance rows must carry REAL table keys
+    # so the drift verdict has something to mark stale ------------------
+    cal_dir = tempfile.mkdtemp(prefix="ffobs_cal_")
+    tbl = CalibrationTable(cal_dir)
+    tbl.put("cpu", "host_membw", "-", 0, 0, 1e10)
+    tbl.put("cpu", "host_dispatch", "-", 0, 0, 2e-5)
+    ff._search_cost_model.attach_calibration(
+        MeshCalibration(backend="cpu", dispatch_s=2e-5, mem_bw=1e10,
+                        table=tbl))
+
+    # -- serving-plan search writes the audit block with per-bucket
+    # predicted entries + their calibration provenance ------------------
+    from flexflow_tpu.search.serving_plan import optimize_serving_strategy
+    plan = optimize_serving_strategy(ff, buckets=BUCKETS, budget=60)
+    audit_path = getattr(ff, "_strategy_audit_path", None)
+    assert audit_path and os.path.exists(audit_path), \
+        "serving search wrote no audit record under FF_TRACE=1"
+    with open(audit_path) as f:
+        audit = json.load(f)
+    for b in BUCKETS:
+        calib = audit["serving"]["buckets"][str(b)]["calib"]
+        assert calib, f"bucket {b} carries no calibration provenance"
+        assert any(r["table"] in ("host_membw", "host_dispatch")
+                   and r["key"] for r in calib), calib
+    print(f"serving obs smoke: audit at {os.path.basename(audit_path)} "
+          f"carries calib provenance for buckets {sorted(plan.buckets)}")
+
+    # -- serve the plan behind the threading front ----------------------
+    from flexflow_tpu.serving import (InferenceSession, ModelRepository,
+                                      serve_http)
+    from flexflow_tpu.serving.session import ServingPlanSession
+    serving = ServingPlanSession(
+        {b: InferenceSession(ff, [b], decode_segment=4) for b in BUCKETS})
+    repo = ModelRepository()
+    repo.register("gpt2", serving)
+    handle = serve_http(repo, port=_free_port(), block=False, max_batch=4)
+    base = f"http://127.0.0.1:{handle.server.server_address[1]}"
+
+    try:
+        # -- 1. lifecycle trace: one generate request, one linked trace
+        rng = np.random.default_rng(0)
+        for rows in (1, 4):
+            ids = np.zeros((rows, 32), np.int32)
+            ids[:, :6] = rng.integers(1, 200, (rows, 6))
+            st, obj, hdrs = _post(
+                base, "/v2/models/gpt2/generate",
+                {"inputs": [{"name": "input_ids", "shape": [rows, 32],
+                             "datatype": "int32",
+                             "data": ids.ravel().tolist()}],
+                 "parameters": {"prompt_len": 6, "max_new_tokens": 8,
+                                "temperature": 0.0}},
+                headers={"x-ff-trace-id": TRACE_ID} if rows == 1 else None)
+            assert st == 200, (st, obj)
+            if rows == 1:
+                assert hdrs.get("x-ff-trace-id") == TRACE_ID, hdrs
+        snap = obs_events.snapshot()
+        spans = [e for e in snap["events"] if e.get("kind") == "span"
+                 and (e.get("attrs") or {}).get("trace") == TRACE_ID]
+        names = {e["name"] for e in spans}
+        missing = [n for n in LIFECYCLE if n not in names]
+        assert not missing, f"trace {TRACE_ID} missing spans {missing} " \
+                            f"(has {sorted(names)})"
+        resp = [e for e in spans if e["name"] == "request.response"]
+        assert resp and resp[0]["attrs"].get("outcome") == "ok", resp
+        segs = {e["attrs"].get("segment")
+                for e in spans if e["name"] == "request.decode_segment"}
+        assert segs == {0, 1}, f"expected 2 decode segments, got {segs}"
+        print(f"serving obs smoke: linked trace covers "
+              f"{len(names)} span kinds across {len(spans)} spans")
+
+        # the Chrome export links the trace's spans with flow events,
+        # and fftrace merges the serving dump into its own lane
+        from flexflow_tpu.obs.trace_export import dump_serving_trace
+        dump = dump_serving_trace()
+        assert dump, "serving trace dump failed"
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from fftrace import merge_rank_traces
+        merged = merge_rank_traces([dump])
+        flows = [e for e in merged["traceEvents"]
+                 if e.get("id") == TRACE_ID and e.get("ph") in "stf"]
+        assert any(e["ph"] == "s" for e in flows) \
+            and any(e["ph"] == "f" for e in flows), \
+            f"no flow chain for {TRACE_ID}"
+        assert any(ln["role"] == "serving"
+                   for ln in merged["otherData"]["lanes"])
+        print(f"serving obs smoke: fftrace merged serving lane with "
+              f"{len(flows)} flow events for the request")
+
+        # -- 2. sketches + SLO: scheduler traffic, one deadline-expired
+        ivec = {"inputs": [{"name": "input_ids", "shape": [1, 32],
+                            "datatype": "int32", "data": [1] * 32},
+                           {"name": "position_ids", "shape": [1, 32],
+                            "datatype": "int32",
+                            "data": list(range(32))}]}
+        for _ in range(3):
+            st, obj, _ = _post(base, "/v2/models/gpt2/infer", ivec)
+            assert st == 200, (st, obj)
+        st, obj, hdrs = _post(base, "/v2/models/gpt2/infer", ivec,
+                              headers={"x-ff-timeout-ms": "0.05"})
+        assert st in (503, 504), (st, obj)
+        assert hdrs.get("x-ff-trace-id"), "no trace id on shed response"
+        h = _get(base, "/healthz")
+        lat = h["serving"]["gpt2"]["latency_ms"]
+        assert lat["all"]["count"] >= 3 and lat["all"]["p50"] > 0, lat
+        assert lat.get("1", {}).get("count", 0) >= 3, lat
+        stats = _get(base, "/v2/metrics")["models"]["gpt2"]
+        assert stats["slo_violations"] >= 1, stats
+        assert stats["expired"] + stats["deadline_rejected"] >= 1, stats
+        mtext = urllib.request.urlopen(base + "/metrics",
+                                       timeout=10).read().decode()
+        assert 'ff_request_latency_quantile{' in mtext, \
+            "quantile gauges missing from /metrics"
+        assert 'ff_slo_violations_total{' in mtext, \
+            "SLO burn counter missing from /metrics"
+        print(f"serving obs smoke: sketch quantiles live "
+              f"(p50={lat['all']['p50']}ms, "
+              f"slo_violations={stats['slo_violations']})")
+
+        # ffstat renders one frame against the live server (stdlib-only
+        # tool: no jax import, subprocess is cheap)
+        import subprocess
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ffstat.py"),
+             "--url", base, "--once"],
+            capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0 and "gpt2" in r.stdout, \
+            (r.returncode, r.stdout, r.stderr)
+
+        # -- 3. drift: measured lands 1:1; an injected mis-calibrated
+        # predicted row is attributed and its table rows marked stale
+        measured = serving.measured_profile()
+        assert set(measured) == {str(b) for b in BUCKETS}, measured
+        # inject: pretend the search predicted a 10000x faster decode
+        # step for the largest bucket than reality delivers
+        victim = str(max(BUCKETS))
+        audit["serving"]["buckets"][victim]["decode_step_s"] /= 1e4
+        with open(audit_path, "w") as f:
+            json.dump(audit, f)
+        from flexflow_tpu.obs.drift import (load_drift_report,
+                                            serving_drift_report)
+        rpath = serving_drift_report(serving, audit_path=audit_path,
+                                     cache_dir=cal_dir)
+        assert rpath, "serving drift report not written"
+        rep = load_drift_report(rpath)
+        assert rep["kind"] == "serving", rep
+        hits = [e for e in rep["out_of_band"]
+                if e["bucket"] == int(victim)
+                and e["component"] == "decode_step_s"]
+        assert hits, f"injected row not attributed: {rep['out_of_band']}"
+        keys = set(hits[0]["calibration_keys"])
+        want = {CalibrationTable.key("cpu", "host_membw"),
+                CalibrationTable.key("cpu", "host_dispatch")}
+        assert keys & want, (keys, want)
+        assert rep["stale_marked"] >= 1, rep
+        # fresh instance: the sidecar on disk, not tbl's warm cache
+        assert set(CalibrationTable(cal_dir).stale_keys()) & want
+        # and the audit now carries the measured side, keyed 1:1
+        with open(audit_path) as f:
+            audit2 = json.load(f)
+        assert set(audit2["serving_measured"]["buckets"]) \
+            <= set(audit2["serving"]["buckets"]), audit2.keys()
+        print(f"serving obs smoke: drift report attributed bucket "
+              f"{victim} to {sorted(keys & want)} "
+              f"({rep['stale_marked']} row(s) staled)")
+    finally:
+        handle.stop()
+
+    print("serving obs smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,15 @@ case "${1:-fast}" in
     # from the last committed two-phase checkpoint with a finite,
     # rank-agreeing final loss — cross-process recovery on every push
     python tools/dist_resilience_smoke.py
+    # fleet chaos smoke: two gpt2-tiny replica processes behind the
+    # FleetRouter, one hard-killed mid-load via FF_FAULT_PLAN=
+    # infer_crash@2 — every admitted request must still return 200
+    # (failover), the autoscaler must bring a warm replacement up
+    # through the shared compile cache (no new cache entries,
+    # ff_model_compiles_total a cache-hit witness), fleet /healthz
+    # must re-converge at 2 replicas, and the merged multi-endpoint
+    # ffstat fleet view must render against the live fleet
+    python tools/fleet_smoke.py
     ;;
   slow)
     python -m pytest tests/ -q -m slow

@@ -14,6 +14,8 @@ Fault plan grammar (``FF_FAULT_PLAN`` env var or :func:`install`)::
               | lose_device | infer_fail     # aliases: nan_grad, corrupt,
               | rank_crash | rank_hang       # truncate, lose, infer
               | corrupt_shard | crash_after_stage
+              | infer_crash                  # hard replica death on the
+                                             # N-th inference call
 
 Examples::
 
@@ -84,6 +86,7 @@ _KINDS = {
     "truncate_ckpt": "truncate_ckpt", "truncate": "truncate_ckpt",
     "lose_device": "lose_device", "lose": "lose_device",
     "infer_fail": "infer_fail", "infer": "infer_fail",
+    "infer_crash": "infer_crash",
     "rank_crash": "rank_crash",
     "rank_hang": "rank_hang",
     "corrupt_shard": "corrupt_shard",
@@ -301,6 +304,12 @@ def raise_infer_fault() -> None:
     step = next(_infer_calls)
     if get_plan().fire("infer_fail", step) is not None:
         raise FaultError(f"injected inference failure at call {step}")
+    if get_plan().fire("infer_crash", step) is not None:
+        # hard death of a serving REPLICA mid-request (``infer_crash@N``):
+        # no drain, no socket close — the fleet router must notice via
+        # its health poll / transport errors and reroute. Same exit
+        # code as a rank crash: to everything else it is a SIGKILL.
+        os._exit(RANK_CRASH_EXIT)
 
 
 def poison_value(step: int) -> Optional[float]:

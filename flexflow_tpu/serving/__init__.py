@@ -15,6 +15,13 @@ Overload robustness (docs/serving.md): per-request deadlines
 (``x-ff-timeout-ms``), admission control that sheds doomed work at the
 queue door, a per-model circuit breaker, batch-poison isolation, and
 graceful drain on both HTTP fronts.
+
+Fleet serving (``serving/fleet``, docs/serving.md · Fleet): continuous
+batching for autoregressive decode (``ContinuousBatcher``), a
+multi-replica router driven by the per-replica admission-control EWMA
+(``FleetRouter``/``serve_fleet``), and a signal-driven autoscaler
+(``Autoscaler``) — imported lazily from ``flexflow_tpu.serving.fleet``
+to keep the single-replica import path lean.
 """
 from .session import InferenceSession, ModelRepository
 from .scheduler import (BatchScheduler, CircuitBreaker, CircuitOpenError,

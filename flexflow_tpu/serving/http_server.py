@@ -205,6 +205,13 @@ def get_route(path: str, repo, schedulers, state: Optional[ServingState]
             serving[name] = {"circuit": sched.breaker.state,
                              "queue_depth": sched._q.qsize(),
                              "draining": sched._draining,
+                             # routing/scaling signals for a fleet
+                             # front: the admission-control EWMA and
+                             # the SLO counter it differentiates
+                             "estimated_wait_s":
+                                 sched.estimated_wait_s(),
+                             "slo_violations":
+                                 sched.metrics.slo_total(),
                              "latency_ms":
                                  sched.metrics.latency_quantiles()}
             # KV-decode fallback state (satellite of the serving-plan
@@ -386,6 +393,14 @@ def _model_route(verb: str, name: str, body: bytes, repo, schedulers,
                 top_k=top_k, top_p=top_p, num_beams=num_beams)
             late = _past_deadline(t0, eff_ms)
             if late is not None:
+                # late completion on the uncancellable generate path:
+                # count the SLO violation on THIS replica's counter —
+                # a fleet router forwarding remaining deadlines relies
+                # on the replica owning this count (it only accounts
+                # requests no replica attempt ever carried)
+                sched = schedulers.get(name)
+                if sched is not None:
+                    sched.metrics.record_slo()
                 return late
             return 200, {"outputs": [{
                 "name": "output_ids", "shape": list(out.shape),
@@ -522,7 +537,8 @@ def serve_http(repo, host: str = "127.0.0.1", port: int = 8000,
                max_queue: int = 256,
                default_deadline_ms: Optional[float] = None,
                breaker_threshold: int = 5,
-               breaker_cooldown_s: float = 5.0):
+               breaker_cooldown_s: float = 5.0,
+               admission_estimate: str = "wait"):
     """Serve a :class:`ModelRepository`. ``block=False`` returns an
     :class:`HttpServerHandle` (unpacks as the ``(server, thread,
     schedulers)`` triple for in-process testing; adds ``drain()``/
@@ -530,7 +546,11 @@ def serve_http(repo, host: str = "127.0.0.1", port: int = 8000,
     (``max_queue``; overflow = HTTP 503) with one worker per registered
     instance; ``default_deadline_ms`` applies to requests without an
     ``x-ff-timeout-ms`` header, and ``breaker_threshold``/
-    ``breaker_cooldown_s`` configure the per-model circuit breaker."""
+    ``breaker_cooldown_s`` configure the per-model circuit breaker.
+    ``admission_estimate`` is forwarded to each
+    :class:`~flexflow_tpu.serving.scheduler.BatchScheduler` — fleet
+    replicas pass ``"completion"`` so deadline shedding predicts the
+    full request latency, not just the queue wait."""
     from .scheduler import BatchScheduler
     schedulers = {}
     state = ServingState(default_deadline_ms=default_deadline_ms)
@@ -541,7 +561,8 @@ def serve_http(repo, host: str = "127.0.0.1", port: int = 8000,
                 max_delay_ms=max_delay_ms, max_queue=max_queue,
                 name=name, default_deadline_ms=default_deadline_ms,
                 breaker_threshold=breaker_threshold,
-                breaker_cooldown_s=breaker_cooldown_s)
+                breaker_cooldown_s=breaker_cooldown_s,
+                admission_estimate=admission_estimate)
     srv = ThreadingHTTPServer((host, port),
                               _make_handler(repo, schedulers, state))
     if block:

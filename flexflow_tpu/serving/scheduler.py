@@ -317,6 +317,17 @@ class SchedulerMetrics:
         if deadline_missed:
             self._m_slo.inc(model=self.name, bucket=bucket or "all")
 
+    def record_slo(self, bucket: Optional[str] = None):
+        """SLO accounting for the direct (non-scheduler) verb paths:
+        a deadline-carrying ``generate`` that completed past its
+        deadline is a violation even though the scheduler never saw
+        it. Counting it HERE is what lets a fleet router dedupe — the
+        replica that carried the remaining deadline owns the count,
+        the fleet layer only counts requests no replica attempted."""
+        with self._lock:
+            self.slo_violations += 1
+        self._m_slo.inc(model=self.name, bucket=bucket or "all")
+
     def record_breaker_open(self):
         with self._lock:
             self.breaker_opens += 1
@@ -377,6 +388,24 @@ class SchedulerMetrics:
                     rows.append(({"model": self.name, "bucket": b,
                                   "quantile": str(q)}, sk.quantile(q)))
         return rows
+
+    def slo_total(self) -> int:
+        """Cheap read of the SLO-violation count — the ``/healthz``
+        field the fleet autoscaler differentiates per poll."""
+        with self._lock:
+            return self.slo_violations
+
+    def sketch_docs(self) -> Dict[str, Dict]:
+        """Serialized latency sketches (``QuantileSketch.to_dict``),
+        overall + per batch bucket — the ``/v2/metrics`` field a fleet
+        front scrapes and ``merge``s so fleet quantiles are computed
+        over the union stream, not averaged per replica (averaging
+        percentiles is the classic observability bug)."""
+        with self._lock:
+            out = {"all": self._sketch.to_dict()}
+            for b, sk in sorted(self._sketch_by_bucket.items()):
+                out[b] = sk.to_dict()
+        return out
 
     def snapshot(self, queue_depth: int) -> Dict:
         with self._lock:
@@ -441,14 +470,29 @@ class BatchScheduler:
     deadline; ``breaker_threshold``/``breaker_cooldown_s`` configure
     the per-model circuit breaker; ``est_batch_latency_s`` seeds the
     admission-control EWMA before the first measured batch (cold-start
-    estimates and tests)."""
+    estimates and tests).
+
+    ``admission_estimate`` picks what the deadline gate compares:
+    ``"wait"`` (default) sheds when the estimated QUEUE wait exceeds
+    the deadline; ``"completion"`` adds one batch's service EWMA on
+    top — a request whose queue wait just fits but whose own service
+    time predictably lands past the deadline is shed at the door
+    instead of burning a device step on a guaranteed SLO violation.
+    Replicas under a deadline-routing fleet front run ``"completion"``
+    (see ``fleet/replica.py``)."""
 
     def __init__(self, sessions, max_batch: int = 64,
                  max_delay_ms: float = 2.0, max_queue: int = 256,
                  name: str = "", default_deadline_ms: Optional[float] = None,
                  breaker_threshold: int = 5,
                  breaker_cooldown_s: float = 5.0,
-                 est_batch_latency_s: Optional[float] = None):
+                 est_batch_latency_s: Optional[float] = None,
+                 admission_estimate: str = "wait"):
+        if admission_estimate not in ("wait", "completion"):
+            raise ValueError(
+                f"admission_estimate must be 'wait' or 'completion', "
+                f"got {admission_estimate!r}")
+        self.admission_estimate = admission_estimate
         if not isinstance(sessions, (list, tuple)):
             sessions = [sessions]
         if not sessions:
@@ -578,7 +622,9 @@ class BatchScheduler:
 
         ``deadline_ms`` (or the scheduler's ``default_deadline_ms``)
         bounds the request end-to-end: admission control fast-fails
-        when the estimated queue wait already exceeds it
+        when the admission estimate (queue wait, plus one batch's
+        service EWMA under ``admission_estimate="completion"``)
+        already exceeds it
         (:class:`DeadlineRejectedError`), a queued request whose
         deadline passes is failed without a device step, and a timed-out
         wait marks the request abandoned so it cannot be batched later.
@@ -625,6 +671,15 @@ class BatchScheduler:
         if dl_ms is not None and dl_ms > 0:
             deadline = time.perf_counter() + dl_ms / 1e3
             est = self.estimated_wait_s()
+            if self.admission_estimate == "completion":
+                # shed on predicted COMPLETION, not queue entry: a
+                # request admitted with the queue wait just under its
+                # deadline still pays its own batch's service time and
+                # would predictably complete late (burning a device
+                # step the deadline turns into a 504/SLO violation)
+                with self._stat_lock:
+                    svc = self._ewma_batch_s or 0.0
+                est += svc / max(1, self.num_instances)
             if est > dl_ms / 1e3:
                 if probe:
                     # the probe dies before execution: its outcome says
@@ -635,8 +690,11 @@ class BatchScheduler:
                 if trace is not None:
                     trace.finish("deadline-rejected", bucket=bucket,
                                  estimated_wait_ms=round(est * 1e3, 3))
+                what = ("estimated completion"
+                        if self.admission_estimate == "completion"
+                        else "estimated queue wait")
                 raise DeadlineRejectedError(
-                    f"estimated queue wait {est * 1e3:.0f} ms exceeds "
+                    f"{what} {est * 1e3:.0f} ms exceeds "
                     f"the request deadline {dl_ms:.0f} ms",
                     retry_after_s=max(est - dl_ms / 1e3, 0.1))
         r = _Request(arrs, rows, deadline, probe=probe, trace=trace,
@@ -702,6 +760,11 @@ class BatchScheduler:
         of ``GET /v2/metrics`` and the ``/healthz`` serving block)."""
         s = self.metrics.snapshot(self._q.qsize())
         s["instances"] = self.num_instances
+        # routing signal + mergeable sketches for a fleet front: wait
+        # BEFORE _stat_lock below (estimated_wait_s acquires it; the
+        # queue lock is not reentrant)
+        s["estimated_wait_s"] = self.estimated_wait_s()
+        s["sketches"] = self.metrics.sketch_docs()
         # benign: atomic read of the state string for a health probe —
         # /healthz must stay cheap (PR 5) and a probe racing a breaker
         # transition just reports the old state for one scrape

@@ -126,6 +126,13 @@ class InferenceSession:
         may be a per-row (batch,) array (ragged prompts). Batch is
         padded to the bucket (decode programs cache per bucket inside
         ``FFModel.generate``); the padded rows' outputs are sliced off."""
+        # same chaos hook as infer(): generate IS the serving path a
+        # fleet chaos plan (infer_fail@N / infer_crash@N) must reach.
+        # Each bucket-sized chunk of an oversized batch advances the
+        # call counter once (chunks are separate device dispatches),
+        # which keeps clause indices deterministic per workload.
+        if faults.active():
+            faults.raise_infer_fault()
         ids = np.ascontiguousarray(np.asarray(input_ids, np.int32))
         n = int(ids.shape[0])
         ragged = np.ndim(prompt_len) > 0

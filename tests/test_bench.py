@@ -134,6 +134,13 @@ def _scripted(default_probe_results):
                                     "8": "searched"},
                     "bitexact": True, "kv_gate_binds": True,
                     "buckets": [1, 4, 8], "ok": True}, None
+        if stage == "fleet":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            return {"deadline_ms": 100.0, "capacity_rps": 25.0,
+                    "goodput_scaling": 1.9, "fleet_p99_ms": 83.2,
+                    "continuous_vs_static": 1.4,
+                    "one_replica": {}, "two_replicas": {},
+                    "continuous": {}, "static": {}, "ok": True}, None
         if stage == "quantized_sync":
             assert env.get("JAX_PLATFORMS") == "cpu"
             assert "xla_force_host_platform_device_count" \
@@ -243,3 +250,8 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         assert out["serving_obs_enabled_over_bare"] == 1.0064
         assert out["serving_obs_disabled_over_bare"] == 1.0096
         assert any(a[1] == "serving_obs_overhead" for a, _ in calls)
+        # and the serving-fleet leg (ISSUE 18)
+        assert out["fleet_goodput_scaling"] == 1.9
+        assert out["fleet_p99_ms"] == 83.2
+        assert out["fleet_continuous_vs_static"] == 1.4
+        assert any(a[1] == "fleet" for a, _ in calls)

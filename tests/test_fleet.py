@@ -1,0 +1,368 @@
+"""Fleet routing, metric merging, and autoscaling policy
+(``serving/fleet/router.py`` + ``autoscale.py`` + ``tools/ffstat.py``).
+
+The router tests run against in-process fake replica HTTP servers so
+the deadline arithmetic, failover, and SLO dedupe are exercised over
+real sockets without spawning child processes. The merge tests pin the
+cross-process sketch-aggregation contract: quantiles of the merged
+serialized sketches equal single-stream ingestion EXACTLY (bin counts
+add), under label churn between replicas and replica unload between
+scrapes.
+"""
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs.sketch import QuantileSketch
+from flexflow_tpu.serving.fleet import (AutoscalerConfig, FleetRouter,
+                                        Replica, decide,
+                                        merge_replica_metrics,
+                                        serve_fleet)
+from flexflow_tpu.serving.fleet.router import DEAD_AFTER
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- fake replica servers -------------------------------------------
+
+
+def _fake_replica(wait_s=0.0, mode="echo", post_delay_s=0.0,
+                  model="m"):
+    """One in-process replica endpoint. ``mode``: ``echo`` answers
+    POSTs 200, ``shed`` answers 503, ``die`` drops the connection with
+    no response (transport death). Returns (server, url, received) —
+    ``received`` collects each POST's lower-cased headers."""
+    received = []
+    sk = QuantileSketch()
+    sk.add(0.01)
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, doc):
+            b = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+
+        def do_GET(self):
+            if self.path == "/v2/metrics":
+                self._send(200, {"models": {model: {
+                    "requests": len(received), "completed":
+                        len(received), "queue_depth": 0,
+                    "sketches": {"all": sk.to_dict()}}}})
+                return
+            self._send(200, {"status": "ok", "ready": True,
+                             "serving": {model: {
+                                 "estimated_wait_s": wait_s,
+                                 "circuit": "closed",
+                                 "queue_depth": 0}}})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            self.rfile.read(n)
+            received.append({k.lower(): v
+                             for k, v in self.headers.items()})
+            if post_delay_s:
+                time.sleep(post_delay_s)
+            if mode == "die":
+                self.connection.close()
+                return
+            if mode == "shed":
+                self._send(503, {"error": "queue full"})
+                return
+            self._send(200, {"ok": True})
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    return srv, url, received
+
+
+@pytest.fixture
+def quiet_router():
+    """A router whose background poller stays out of the way (one
+    long interval); tests drive polls explicitly via adopt()."""
+    r = FleetRouter(poll_interval_s=60.0)
+    yield r
+    r.close(drain_children=False)
+
+
+# -- candidate selection --------------------------------------------
+
+
+def _plant(router, name, wait, circuit="closed", draining=False,
+           dead=False):
+    r = Replica(name, f"http://127.0.0.1:1/{name}")
+    r.health = None if dead else {
+        "serving": {"m": {"estimated_wait_s": wait,
+                          "circuit": circuit}}}
+    if dead:
+        r.consecutive_errors = DEAD_AFTER
+    r.draining = draining
+    with router._lock:
+        router._replicas.append(r)
+    return r
+
+
+def test_candidates_least_wait_skips_breaker_drain_dead(quiet_router):
+    _plant(quiet_router, "slow", 0.5)
+    _plant(quiet_router, "fast", 0.01)
+    _plant(quiet_router, "open", 0.0, circuit="open")
+    _plant(quiet_router, "drain", 0.0, draining=True)
+    _plant(quiet_router, "dead", 0.0, dead=True)
+    assert [r.name for r in quiet_router.candidates("m")] \
+        == ["fast", "slow"]
+    assert quiet_router.candidates("unknown-model") == []
+
+
+def test_candidates_rotate_on_tied_wait(quiet_router):
+    _plant(quiet_router, "t1", 0.0)
+    _plant(quiet_router, "t2", 0.0)
+    firsts = {quiet_router.candidates("m")[0].name for _ in range(6)}
+    assert firsts == {"t1", "t2"}, \
+        "tied-wait replicas must rotate, not convoy onto one"
+
+
+# -- forwarding: deadline truth, failover, SLO dedupe ---------------
+
+
+def test_forward_shrinks_deadline_across_hops(quiet_router):
+    shed_srv, shed_url, shed_rx = _fake_replica(wait_s=0.0,
+                                                mode="shed")
+    echo_srv, echo_url, echo_rx = _fake_replica(wait_s=1.0)
+    try:
+        quiet_router.adopt(shed_url, name="shed")
+        quiet_router.adopt(echo_url, name="echo")
+        code, out, hdrs = quiet_router.forward(
+            "m", "/v2/models/m/infer", b"{}",
+            {"x-ff-timeout-ms": "5000", "x-ff-trace-id": "tr123"})
+        assert code == 200
+        # least wait first -> the shed replica, then failover
+        t_shed = float(shed_rx[0]["x-ff-timeout-ms"])
+        t_echo = float(echo_rx[0]["x-ff-timeout-ms"])
+        assert t_shed < 5000.0, "a hop must never extend the budget"
+        assert t_echo < t_shed, \
+            "the failover hop must carry only the REMAINING budget"
+        # trace id propagates across both attempts and the response
+        assert shed_rx[0]["x-ff-trace-id"] == "tr123"
+        assert echo_rx[0]["x-ff-trace-id"] == "tr123"
+        assert hdrs["x-ff-trace-id"] == "tr123"
+        st = quiet_router.fleet_health()["fleet"]
+        assert st["failovers"] == 1 and st["routed"] == 1
+    finally:
+        shed_srv.shutdown()
+        echo_srv.shutdown()
+
+
+def test_transport_death_strikes_health_and_fails_over(quiet_router):
+    die_srv, die_url, die_rx = _fake_replica(wait_s=0.0, mode="die")
+    echo_srv, echo_url, echo_rx = _fake_replica(wait_s=1.0)
+    try:
+        rd = quiet_router.adopt(die_url, name="die")
+        quiet_router.adopt(echo_url, name="echo")
+        code, out, _ = quiet_router.forward(
+            "m", "/v2/models/m/infer", b"{}", {})
+        assert code == 200 and len(echo_rx) == 1
+        with quiet_router._lock:
+            assert rd.consecutive_errors >= DEAD_AFTER
+            assert rd.health is None
+        assert quiet_router.fleet_health()["fleet"]["failovers"] == 1
+    finally:
+        die_srv.shutdown()
+        echo_srv.shutdown()
+
+
+def test_expired_at_fleet_counts_exactly_one_violation(quiet_router):
+    echo_srv, echo_url, echo_rx = _fake_replica()
+    try:
+        quiet_router.adopt(echo_url, name="echo")
+        code, out, _ = quiet_router.forward(
+            "m", "/v2/models/m/infer", b"{}",
+            {"x-ff-timeout-ms": "0"})
+        assert code == 504
+        assert not echo_rx, "expired request must never be dispatched"
+        st = quiet_router.fleet_health()["fleet"]
+        assert st["fleet_slo_violations"] == 1
+    finally:
+        echo_srv.shutdown()
+
+
+def test_late_replica_answer_not_double_counted(quiet_router):
+    # the replica received the remaining deadline and answers after it
+    # passed: the REPLICA owns that violation — the fleet layer must
+    # not count a second one for the same request
+    slow_srv, slow_url, slow_rx = _fake_replica(post_delay_s=0.15)
+    try:
+        quiet_router.adopt(slow_url, name="slowpoke")
+        code, out, _ = quiet_router.forward(
+            "m", "/v2/models/m/infer", b"{}",
+            {"x-ff-timeout-ms": "100"})
+        assert code == 200 and len(slow_rx) == 1
+        st = quiet_router.fleet_health()["fleet"]
+        assert st["fleet_slo_violations"] == 0
+    finally:
+        slow_srv.shutdown()
+
+
+def test_no_replica_503_counts_slo_only_with_deadline(quiet_router):
+    code, out, _ = quiet_router.forward(
+        "m", "/v2/models/m/infer", b"{}", {})
+    assert code == 503
+    st = quiet_router.fleet_health()["fleet"]
+    assert st["no_replica"] == 1 and st["fleet_slo_violations"] == 0
+    code, out, _ = quiet_router.forward(
+        "m", "/v2/models/m/infer", b"{}",
+        {"x-ff-timeout-ms": "1000"})
+    assert code == 503
+    st = quiet_router.fleet_health()["fleet"]
+    assert st["no_replica"] == 2 and st["fleet_slo_violations"] == 1
+
+
+# -- fleet front + live merge ---------------------------------------
+
+
+def test_fleet_front_health_models_and_merged_metrics(quiet_router):
+    s1, u1, rx1 = _fake_replica(wait_s=0.1)
+    s2, u2, rx2 = _fake_replica(wait_s=0.2)
+    handle = serve_fleet(quiet_router)
+    try:
+        quiet_router.adopt(u1, name="r1")
+        quiet_router.adopt(u2, name="r2")
+        import urllib.request
+        with urllib.request.urlopen(handle.url + "/healthz",
+                                    timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["converged"] and set(doc["replicas"]) == \
+            {"r1", "r2"}
+        with urllib.request.urlopen(handle.url + "/v2/models",
+                                    timeout=10) as resp:
+            assert json.loads(resp.read())["models"] == ["m"]
+        with urllib.request.urlopen(handle.url + "/v2/metrics",
+                                    timeout=10) as resp:
+            met = json.loads(resp.read())
+        assert met["models"]["m"]["replicas"] == 2
+        assert set(met["replicas"]) == {"r1", "r2"}
+        assert "all" in met["models"]["m"]["latency_ms"]
+    finally:
+        handle.stop(drain_children=False)
+        s1.shutdown()
+        s2.shutdown()
+
+
+# -- cross-process sketch aggregation -------------------------------
+
+
+def test_merge_replica_metrics_matches_single_stream():
+    rng = np.random.RandomState(7)
+    a = rng.gamma(2.0, 0.01, size=400)
+    b = rng.gamma(2.0, 0.02, size=300)
+    ska, skb, union = (QuantileSketch(), QuantileSketch(),
+                       QuantileSketch())
+    for v in a:
+        ska.add(float(v))
+        union.add(float(v))
+    for v in b:
+        skb.add(float(v))
+        union.add(float(v))
+    # the docs cross a process boundary as JSON — round-trip them
+    doc_a = json.loads(json.dumps(ska.to_dict()))
+    doc_b = json.loads(json.dumps(skb.to_dict()))
+    # label churn: each replica carries a bucket label the other has
+    # never seen (bucket programs compile lazily per replica)
+    per_replica = {
+        "r1": {"m": {"requests": 400, "completed": 400,
+                     "sketches": {"all": doc_a, "bucket:4": doc_a}}},
+        "r2": {"m": {"requests": 300, "completed": 299,
+                     "sketches": {"all": doc_b, "bucket:8": doc_b}}},
+    }
+    merged = merge_replica_metrics(per_replica)
+    m = merged["m"]
+    assert m["requests"] == 700 and m["completed"] == 699
+    assert m["replicas"] == 2
+    # EXACT equality vs single-stream ingestion: merge adds bin
+    # counts, it never averages percentiles
+    for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        assert m["latency_ms"]["all"][key] == \
+            round(union.quantile(q) * 1e3, 3)
+    assert m["latency_ms"]["bucket:4"]["p99"] == \
+        round(ska.quantile(0.99) * 1e3, 3)
+    assert m["latency_ms"]["bucket:8"]["p99"] == \
+        round(skb.quantile(0.99) * 1e3, 3)
+    # replica unload: r2 drops out between scrapes — the merged view
+    # falls back to r1's stream alone, still exact
+    m1 = merge_replica_metrics(
+        {"r1": per_replica["r1"]})["m"]
+    assert m1["requests"] == 400 and m1["replicas"] == 1
+    assert m1["latency_ms"]["all"]["p99"] == \
+        round(ska.quantile(0.99) * 1e3, 3)
+
+
+def test_ffstat_fleet_merge_and_down_replica_render():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ffstat
+    finally:
+        sys.path.pop(0)
+    rng = np.random.RandomState(11)
+    union = QuantileSketch()
+    docs = {}
+    for ep, n in (("http://h:8101", 200), ("http://h:8102", 150)):
+        sk = QuantileSketch()
+        for v in rng.gamma(2.0, 0.015, size=n):
+            sk.add(float(v))
+            union.add(float(v))
+        docs[ep] = {"m": {"requests": n, "completed": n,
+                          "queue_depth": 1, "instances": 1,
+                          "circuit": "closed", "slo_violations": 2,
+                          "sketches": {"all": json.loads(
+                              json.dumps(sk.to_dict()))}}}
+    merged = ffstat.merge_fleet_metrics(docs)
+    assert merged["m"]["requests"] == 350
+    assert merged["m"]["slo_violations"] == 4
+    assert merged["m"]["latency_p99_ms"] == \
+        round(union.quantile(0.99) * 1e3, 3)
+    health = {"serving": {"m": {"estimated_wait_s": 0.25}}}
+    frame = ffstat.render_fleet_frame({
+        "http://h:8101": (health, docs["http://h:8101"]),
+        "http://h:8102": None})
+    assert "ffstat fleet · 1/2" in frame
+    assert "DOWN" in frame and "m" in frame
+
+
+# -- autoscaler policy ----------------------------------------------
+
+
+def test_decide_policy_units():
+    cfg = AutoscalerConfig(min_replicas=2, max_replicas=4,
+                           sustain_polls=2, idle_polls=3)
+    # floor repair beats everything, even with a cold start pending
+    assert decide(cfg, alive=1, pending=0,
+                  hot_streak=0, idle_streak=0) == "repair"
+    assert decide(cfg, alive=0, pending=1,
+                  hot_streak=0, idle_streak=0) == "repair"
+    # one cold start in flight blocks further spawns
+    assert decide(cfg, alive=2, pending=1,
+                  hot_streak=99, idle_streak=0) == "hold"
+    # sustained heat scales up — until the ceiling
+    assert decide(cfg, alive=2, pending=0,
+                  hot_streak=2, idle_streak=0) == "scale_up"
+    assert decide(cfg, alive=2, pending=0,
+                  hot_streak=1, idle_streak=0) == "hold"
+    assert decide(cfg, alive=4, pending=0,
+                  hot_streak=99, idle_streak=0) == "hold"
+    # sustained idleness scales down — never below the floor
+    assert decide(cfg, alive=3, pending=0,
+                  hot_streak=0, idle_streak=3) == "scale_down"
+    assert decide(cfg, alive=2, pending=0,
+                  hot_streak=0, idle_streak=99) == "hold"

@@ -21,22 +21,35 @@ Everything comes from two GETs per frame (``/healthz`` +
 ``/v2/metrics``), both cheap by contract — safe to leave running
 against a production port.
 
+Fleet mode: pass ``--endpoint`` more than once to scrape several
+replicas and render ONE merged view — per-model counters summed and
+latency quantiles recomputed from the union of the replicas' serialized
+sketches (``QuantileSketch.merge``: exact, never an average of
+per-replica percentiles), plus a per-replica block with each replica's
+circuit state, queue depth, and estimated wait. A replica that stops
+answering shows as ``DOWN`` in the per-replica block; the merged view
+keeps rendering from the rest.
+
 Usage:
     python tools/ffstat.py --port 8000             # live, 2 s frames
     python tools/ffstat.py --port 8000 --once      # one frame (CI)
     python tools/ffstat.py --url http://host:8000 --interval 5
+    python tools/ffstat.py --endpoint http://h:8101 \
+        --endpoint http://h:8102 --once            # merged fleet view
 
-Exit status: 0 on a clean run, 2 when the server was unreachable.
+Exit status: 0 on a clean run, 2 when the server was unreachable
+(fleet mode: when EVERY endpoint was unreachable).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 _TIMEOUT_S = 5.0     # per-request bound: a stat tool must never hang
 
@@ -102,6 +115,108 @@ def render_frame(health: Dict[str, Any], metrics: Dict[str, Any],
     return "\n".join(lines)
 
 
+def _sketch_cls():
+    """The serving sketch class, imported lazily: only the fleet-merge
+    path needs it (single-endpoint ffstat stays stdlib-only)."""
+    try:
+        from flexflow_tpu.obs.sketch import QuantileSketch
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from flexflow_tpu.obs.sketch import QuantileSketch
+    return QuantileSketch
+
+
+#: counters that sum across replicas in the merged fleet view
+_FLEET_SUM = ("requests", "completed", "failed", "rejected",
+              "expired", "deadline_rejected", "slo_violations",
+              "queue_depth", "instances")
+
+
+def merge_fleet_metrics(per_endpoint: Dict[str, Dict[str, Any]]
+                        ) -> Dict[str, Dict[str, Any]]:
+    """Merge per-endpoint ``/v2/metrics`` model maps into one fleet
+    view: counters sum; ``latency_p*_ms`` are recomputed from the
+    merged ``sketches.all`` docs. Pure — the fleet tests feed canned
+    scrapes and compare against single-stream ingestion."""
+    QuantileSketch = _sketch_cls()
+    merged: Dict[str, Dict[str, Any]] = {}
+    sketches: Dict[str, Any] = {}
+    for metrics in per_endpoint.values():
+        for model, m in metrics.items():
+            agg = merged.setdefault(
+                model, {f: 0 for f in _FLEET_SUM})
+            agg["replicas"] = agg.get("replicas", 0) + 1
+            for f in _FLEET_SUM:
+                agg[f] += int(m.get(f, 0))
+            doc = (m.get("sketches") or {}).get("all")
+            if doc:
+                sk = QuantileSketch.from_dict(doc)
+                if model in sketches:
+                    sketches[model].merge(sk)
+                else:
+                    sketches[model] = sk
+    for model, agg in merged.items():
+        sk = sketches.get(model)
+        n = getattr(sk, "count", 0)
+        for q, field in ((0.5, "latency_p50_ms"),
+                         (0.99, "latency_p99_ms"),
+                         (0.999, "latency_p999_ms")):
+            agg[field] = round(sk.quantile(q) * 1e3, 3) if n else 0.0
+        agg["sketch_count"] = n
+    return merged
+
+
+def render_fleet_frame(per_endpoint: Dict[str, Optional[Tuple]],
+                       prev: Optional[Dict[str, Any]] = None,
+                       dt: float = 0.0) -> str:
+    """Render one merged fleet frame. ``per_endpoint`` maps endpoint
+    -> (health, metrics) or None for an unreachable replica. Pure,
+    like :func:`render_frame`."""
+    up = {ep: hm for ep, hm in per_endpoint.items() if hm is not None}
+    merged = merge_fleet_metrics(
+        {ep: hm[1] for ep, hm in up.items()})
+    lines = [f"ffstat fleet · {len(up)}/{len(per_endpoint)} "
+             f"endpoint(s) up · {len(merged)} model(s)"]
+    lines.append(f"{'MODEL':<14}{'REPL':>5}{'REQ/S':>8}{'P50MS':>8}"
+                 f"{'P99MS':>8}{'P99.9':>8}{'SLO':>6}{'EXP':>6}")
+    for name in sorted(merged):
+        m = merged[name]
+        lines.append(
+            f"{name[:13]:<14}"
+            f"{m.get('replicas', 0):>5}"
+            f"{_fmt_rate(m, (prev or {}).get(name), dt):>8}"
+            f"{m.get('latency_p50_ms', 0.0):>8.2f}"
+            f"{m.get('latency_p99_ms', 0.0):>8.2f}"
+            f"{m.get('latency_p999_ms', 0.0):>8.2f}"
+            f"{m.get('slo_violations', 0):>6}"
+            f"{m.get('expired', 0):>6}")
+    lines.append("per-replica:")
+    lines.append(f"  {'ENDPOINT':<26}{'MODEL':<14}{'CIRC':<10}"
+                 f"{'Q':>4}{'INST':>5}{'WAIT_S':>8}")
+    for ep in sorted(per_endpoint):
+        hm = per_endpoint[ep]
+        short = ep.replace("http://", "")[:25]
+        if hm is None:
+            lines.append(f"  {short:<26}{'-':<14}{'DOWN':<10}"
+                         f"{'-':>4}{'-':>5}{'-':>8}")
+            continue
+        health, metrics = hm
+        serving = (health.get("serving")
+                   or {}) if isinstance(health, dict) else {}
+        for name in sorted(metrics):
+            m = metrics[name]
+            wait = (serving.get(name) or {}).get(
+                "estimated_wait_s", 0.0)
+            lines.append(
+                f"  {short:<26}{name[:13]:<14}"
+                f"{str(m.get('circuit', '?'))[:9]:<10}"
+                f"{m.get('queue_depth', 0):>4}"
+                f"{m.get('instances', 0):>5}"
+                f"{wait:>8.3f}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ffstat", description=__doc__,
@@ -114,8 +229,15 @@ def main(argv=None) -> int:
                     help="seconds between frames (default 2)")
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit (CI / scripting)")
+    ap.add_argument("--endpoint", action="append", default=None,
+                    help="replica base url; repeat for a merged fleet "
+                         "view (sketch-merged quantiles + per-replica "
+                         "circuit/queue columns)")
     a = ap.parse_args(argv)
-    base = a.url or f"http://{a.host}:{a.port}"
+    if a.endpoint and len(a.endpoint) > 1:
+        return _main_fleet([e.rstrip("/") for e in a.endpoint], a)
+    base = (a.endpoint[0] if a.endpoint else None) \
+        or a.url or f"http://{a.host}:{a.port}"
     base = base.rstrip("/")
     prev: Optional[Dict[str, Any]] = None
     t_prev = 0.0
@@ -133,6 +255,33 @@ def main(argv=None) -> int:
         sys.stdout.flush()
         time.sleep(max(0.2, a.interval))
         # frame separator, not a screen clear: scrollback keeps history
+        print()
+
+
+def _main_fleet(endpoints: List[str], a) -> int:
+    prev: Optional[Dict[str, Any]] = None
+    t_prev = 0.0
+    while True:
+        frame: Dict[str, Optional[Tuple]] = {}
+        for ep in endpoints:
+            try:
+                frame[ep] = fetch(ep)
+            except (urllib.error.URLError, OSError, ValueError):
+                frame[ep] = None  # rendered as DOWN, not fatal
+        if all(v is None for v in frame.values()):
+            print(f"ffstat: all {len(endpoints)} endpoints "
+                  f"unreachable", file=sys.stderr)
+            return 2
+        now = time.perf_counter()
+        print(render_fleet_frame(frame, prev, now - t_prev))
+        if a.once:
+            return 0
+        prev = merge_fleet_metrics(
+            {ep: hm[1] for ep, hm in frame.items()
+             if hm is not None})
+        t_prev = now
+        sys.stdout.flush()
+        time.sleep(max(0.2, a.interval))
         print()
 
 

@@ -34,6 +34,8 @@ SECTIONS = [
     ("flexflow_tpu.kernels", "Pallas TPU kernels (flash/ring attention)"),
     ("flexflow_tpu.frontends", "Keras / torch.fx / ONNX importers"),
     ("flexflow_tpu.serving", "inference serving (sessions/batcher/HTTP)"),
+    ("flexflow_tpu.serving.fleet",
+     "serving fleet (continuous batching/router/autoscaler)"),
     ("flexflow_tpu.obs",
      "telemetry (spans, Prometheus metrics, strategy audit records)"),
     ("flexflow_tpu.resilience",

@@ -60,6 +60,14 @@ a step:
      generic resharding). The memory envelope (check 3) prices the
      optimizer slots per-parameter against the same assignment, so a
      plan that only fits *because* of ZeRO verifies.
+  7. **kernel** — per-op kernel-implementation soundness
+     (``strategy.kernel_impls``, kernels/registry.py): every adopted
+     impl must be registered and its availability predicate must hold
+     on the adopted mesh/shapes — ``ring`` without a mesh sequence
+     axis is the fixture-pinned rejection. The memory envelope
+     (check 3) counts ring-assigned attention ops at 1/seq-degree
+     activation residency, so a context that only fits *because* of
+     ring attention verifies.
 
 ``FFModel.compile`` runs this post-search (``FFConfig.plan_verify``,
 ``FF_PLAN_VERIFY=0`` to disable); failures raise
@@ -316,6 +324,22 @@ def verify_plan(strategy, layers: Sequence, *,
                  axis_sizes, have_layers=bool(by_name),
                  known_layers=set(by_name),
                  unaddressable=unaddressable)
+    kimpls = getattr(strategy, "kernel_impls", None) or {}
+    if kimpls:
+        from ..ffconst import OperatorType
+        from ..kernels import registry as kreg
+        seq_deg = int(axis_sizes.get("seq", 0) or 0)
+        attn_ctxs: Dict[str, Dict[str, Any]] = {}
+        for name, l in by_name.items():
+            if l.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
+                q_len = int(l.inputs[0].shape[1]) if l.inputs else 0
+                kv_len = int(l.inputs[1].shape[1]) \
+                    if len(l.inputs) > 1 else q_len
+                attn_ctxs[name] = kreg.attention_ctx(
+                    l.params, q_len, kv_len, seq_degree=seq_deg)
+        _check_kernel(report, kimpls, axis_sizes, attn_ctxs,
+                      have_layers=bool(by_name),
+                      known_layers=set(by_name))
     serving_doc = getattr(strategy, "serving", None)
     if serving_doc:
         _check_serving(report, serving_doc, by_name, axis_sizes, spec,
@@ -637,6 +661,8 @@ def memory_envelope(strategy, layers, axis_sizes, optimizer, *,
         for m in bk.members:
             bank_deg[m] = max(d, 1)
     slots = _opt_slots(optimizer)
+    kernel_impls = getattr(strategy, "kernel_impls", None) or {}
+    seq_degree = int(axis_sizes.get("seq", 1) or 1)
     params_local = 0.0
     opt_local = 0.0
     n_zero_sharded = 0
@@ -668,6 +694,14 @@ def memory_envelope(strategy, layers, axis_sizes, optimizer, *,
             # envelope by the sharding degree and false-fail the gate
             sp = tensor_spec(strategy, t)
             local += total / max(_spec_degree(sp, axis_sizes), 1)
+        if kernel_impls.get(layer.name) == "ring" and seq_degree > 1:
+            # ring attention (kernels/ring_attention.py) executes
+            # inside a shard_map over the sequence axis: each device
+            # holds only the 1/seq-degree chunk of q/k/v/output, and
+            # the K/V block rotates in place — the op's live residency
+            # divides by the seq degree. This is what lets a context
+            # that only fits BECAUSE of ring attention verify.
+            local /= seq_degree
         if local > act_peak:
             act_peak, act_op = local, layer.name
     total = params_local * 2 + opt_local + 2 * act_peak + reshard_peak
@@ -925,6 +959,71 @@ def _check_qsync(report, qsync_doc, axis_tiers, weight_specs,
                             f"only on its declared tier path (the "
                             f"accuracy-risk gate scoped the narrowing "
                             f"to {tier!r} fabric)", "qsync-plan")
+
+
+# -- check 3.7: per-op kernel implementations --------------------------------
+
+def _check_kernel(report, kimpls, axis_sizes: Dict[str, int],
+                  attn_ctxs: Dict[str, Dict[str, Any]], *,
+                  have_layers: bool, known_layers=()) -> None:
+    """Adopted kernel-impl assignment (``strategy.kernel_impls``,
+    kernels/registry.py): every impl name must be registered and its
+    availability predicate must hold on the adopted mesh/shapes —
+    ``ring`` on a mesh without a sequence axis is THE fixture-pinned
+    rejection (an imported plan would otherwise reach emit and fail
+    deep inside tracing). ``attn_ctxs`` maps attention layer names to
+    their predicate contexts; a name missing from it with layers known
+    is a kernel impl assigned to a non-attention op."""
+    from ..kernels import registry as kreg
+    seq_deg = int(axis_sizes.get("seq", 0) or 0)
+    for key, impl in (kimpls or {}).items():
+        if key == kreg.OPT_UPDATE:
+            if impl not in kreg.impl_names(kreg.OPT_UPDATE):
+                report.add(
+                    "kernel", "error", key,
+                    f"unknown opt_update impl {impl!r} (known: "
+                    f"{sorted(kreg.impl_names(kreg.OPT_UPDATE))})",
+                    "kernel-impl")
+            # the fused predicate is backend-gated (TPU-only): a
+            # runtime property, re-checked when the importing process
+            # plans (FFModel._plan_kernels), not statically here
+            continue
+        if impl not in kreg.impl_names(kreg.ATTENTION):
+            report.add(
+                "kernel", "error", key,
+                f"unknown attention impl {impl!r} (known: "
+                f"{sorted(kreg.impl_names(kreg.ATTENTION))})",
+                "kernel-impl")
+            continue
+        ctx = attn_ctxs.get(key)
+        if ctx is None:
+            if have_layers and key not in known_layers:
+                report.add(
+                    "kernel", "error", key,
+                    f"kernel impl {impl!r} is assigned to an op the "
+                    f"program does not contain", "kernel-impl")
+                continue
+            if have_layers:
+                report.add(
+                    "kernel", "error", key,
+                    f"kernel impl {impl!r} is assigned to a "
+                    f"non-attention op", "kernel-impl")
+                continue
+            # spec-only strategy file (no program block): shapes are
+            # unknown, but the one mesh-level requirement still binds
+            if impl == "ring" and seq_deg < 2:
+                report.add(
+                    "kernel", "error", key,
+                    "kernel impl 'ring' requires a mesh sequence axis "
+                    "('seq', degree >= 2); the strategy's mesh_axes "
+                    f"have {dict(axis_sizes)}", "kernel-impl")
+            continue
+        reason = kreg.get_impl(kreg.ATTENTION, impl).available(ctx)
+        if reason is not None:
+            report.add(
+                "kernel", "error", key,
+                f"kernel impl {impl!r} is not available on the "
+                f"adopted mesh/shapes: {reason}", "kernel-impl")
 
 
 # -- check 4: collective-ordering consistency --------------------------------
@@ -1315,14 +1414,35 @@ def _check_serving(report, serving_doc, by_name, axis_sizes, spec,
                     f"divide num_kv_heads {kvh} — a decode step cannot "
                     f"split a KV head across devices", "serving-kv")
                 continue
-            want = (2 * bucket * max_seq * kvh * hd * 4) // deg
+            sdeg = int(kv.get("seq_shard_degree") or 1)
+            if sdeg > 1:
+                # seq-sharded KV only executes on a mesh whose sequence
+                # axis carries the degree: the decode-step combine is a
+                # ppermute rotation OVER that axis
+                mesh_seq = int(axis_sizes.get("seq", 1) or 1)
+                if mesh_seq % sdeg != 0 or mesh_seq < sdeg:
+                    report.add(
+                        "serving", "error", name,
+                        f"serving[{ctx}]: KV seq shard degree {sdeg} "
+                        f"needs a mesh sequence axis of that degree "
+                        f"(mesh has seq={mesh_seq})", "serving-kv")
+                    continue
+                if max_seq and max_seq % sdeg != 0:
+                    report.add(
+                        "serving", "error", name,
+                        f"serving[{ctx}]: KV seq shard degree {sdeg} "
+                        f"does not divide max_seq {max_seq}",
+                        "serving-kv")
+                    continue
+            want = (2 * bucket * max_seq * kvh * hd * 4) \
+                // (deg * max(sdeg, 1))
             got = int(kv.get("bytes") or 0)
             if got and hd and got != want:
                 report.add(
                     "serving", "error", name,
                     f"serving[{ctx}]: recorded KV bytes {got} disagree "
                     f"with the geometry 2*{bucket}*{max_seq}*{kvh}*"
-                    f"{hd}*4/{deg} = {want}", "serving-kv")
+                    f"{hd}*4/({deg}*{sdeg}) = {want}", "serving-kv")
     # decode-resident envelope at the LARGEST bucket. Needs the layer
     # list for weight/output shapes; spec-only strategy files verify
     # structurally above and skip the gate.
@@ -1343,8 +1463,9 @@ def _check_serving(report, serving_doc, by_name, axis_sizes, spec,
             f"{env['weights_bytes'] / 2**20:.1f} MiB + KV cache "
             f"{env['kv_bytes'] / 2**20:.1f} MiB + 2 x peak activation "
             f"{env['peak_activation_bytes'] / 2**20:.1f} MiB [{act_op}])"
-            f" — shard the KV cache (head-parallel attention) or drop "
-            f"the bucket", "serving-memory")
+            f" — shard the KV cache (head-parallel attention, or "
+            f"seq-sharded KV on a sequence-axis mesh) or drop the "
+            f"bucket", "serving-memory")
 
 
 def serving_envelope(sub: Dict, bucket: int, by_name: Dict,
@@ -1594,6 +1715,38 @@ def verify_strategy_file(path: str, doc: Optional[Dict] = None
                 op_types[ls["name"]] = None
         _check_overlap(report, ovdoc, grouped=grouped, pos=pos,
                        op_types=op_types, have_layers=bool(op_types))
+    # per-op kernel implementations (doc["kernel_impls"]): registered
+    # impl names + availability predicates on the recorded mesh/shapes;
+    # 'ring' without a seq axis in mesh_axes is the pinned rejection
+    kdoc = doc.get("kernel_impls")
+    if kdoc:
+        from ..kernels import registry as kreg
+        attn_ctxs: Dict[str, Dict[str, Any]] = {}
+        known: set = set()
+        prog_layers = (prog or {}).get("layers") or ()
+        if prog_layers:
+            from ..search.serialization import _param_from_json
+            for ls in prog_layers:
+                known.add(ls["name"])
+                if ls.get("op_type") != "OP_MULTIHEAD_ATTENTION":
+                    continue
+                try:
+                    params = {k: _param_from_json(v)
+                              for k, v in ls.get("params", {}).items()}
+                    shapes = out_shapes.get(ls["name"])
+                    q_len = int(shapes[0][1]) \
+                        if shapes and len(shapes[0]) > 1 else 0
+                    attn_ctxs[ls["name"]] = kreg.attention_ctx(
+                        params, q_len, q_len,
+                        seq_degree=axis_sizes.get("seq", 0))
+                except Exception:  # noqa: BLE001 — shape unknown ≠ unsound
+                    # minimal ctx: mesh-level predicates (the ring seq
+                    # axis) still bind; shape-level ones pass open
+                    attn_ctxs[ls["name"]] = kreg.attention_ctx(
+                        {}, 0, 0, seq_degree=axis_sizes.get("seq", 0))
+        _check_kernel(report, kdoc, axis_sizes, attn_ctxs,
+                      have_layers=bool(prog_layers),
+                      known_layers=known)
     # per-(model, batch-class) serving block (doc["serving"]): bucket
     # structure, per-bucket spec soundness, and KV-shard/GQA
     # divisibility — the envelope gate needs live layer shapes and is

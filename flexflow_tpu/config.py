@@ -244,9 +244,26 @@ class FFConfig:
     # persistent XLA compilation cache dir; "" = off unless
     # JAX_COMPILATION_CACHE_DIR is set (see utils/compilation_cache.py)
     compilation_cache_dir: str = ""
-    # "auto": Pallas flash attention when compiled on TPU; "true": always
-    # (interpret mode off-TPU — slow, test-only); "false": plain XLA attention
+    # DEPRECATED tri-state (kept as a shim over the kernel tier): "true"
+    # forces attention:flash, "false" forces attention:xla, "auto" defers
+    # to the searched kernel_impls dimension (kernels/registry.py emits a
+    # DeprecationWarning for the non-auto values). See docs/kernels.md.
     use_flash_attention: str = "auto"
+    # searched per-op kernel-implementation tier (kernels/registry.py):
+    # "auto" lets FFModel._plan_kernels pick each op's impl from the
+    # calibrated (op, impl) costs; "<op>:<impl>[,...]" forces choices
+    # (e.g. "attention:ring,opt_update:fused"). FF_KERNEL_IMPL env and
+    # --kernel-impl override. Forced-but-unavailable impls are rejected
+    # by the plan verifier's `kernel` check with op attribution.
+    kernel_impls: str = "auto"
+    # sequence-parallel (context) mesh axis degree: N >= 2 carves a
+    # dedicated "seq" axis out of the device factorization; attention
+    # ops assigned the `ring` impl shard the context dimension over it
+    # (kernels/ring_attention.py lowered as one shard_map with ppermute
+    # ring hops). 0/1 = no seq axis. Unlike --sp (the GSPMD tp preset),
+    # this axis is reserved for ring attention — the general search
+    # never shards batch/params over it.
+    seq_parallel_degree: int = 0
     # measured DP-floor guard on search adoption: after the search picks a
     # strategy, compile+time a few real steps of it AND of plain data
     # parallel, and keep DP when the searched program measures slower (the
@@ -453,6 +470,14 @@ class FFConfig:
                 cfg.tensor_parallel = int(take())
             elif a in ("--sp", "--sequence-parallel"):
                 cfg.sequence_parallel = True
+            elif a == "--seq-parallel":
+                cfg.seq_parallel_degree = int(take())
+            elif a == "--kernel-impl":
+                # repeated flags accumulate: --kernel-impl attention:ring
+                # --kernel-impl opt_update:fused
+                v = take()
+                cfg.kernel_impls = v if cfg.kernel_impls == "auto" \
+                    else f"{cfg.kernel_impls},{v}"
             elif a == "--bf16-activations":
                 cfg.bf16_activations = True
             elif a in ("--zero", "--shard-optimizer-states"):

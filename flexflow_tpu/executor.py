@@ -430,6 +430,13 @@ class Executor:
         # _plan_qsync) re-resolves via attach_qsync().
         self._qsync = None
         self.attach_qsync()
+        # searched kernel tier (kernels/registry.py): the adopted
+        # strategy's per-op impl map, threaded through EmitCtx so
+        # attention emission resolves its impl (ring lowers one
+        # shard_map over the mesh's seq axis) and the optimizer update
+        # dispatches fused/unfused. Empty = default impls everywhere.
+        self._kernel_impls: Dict[str, str] = dict(
+            getattr(strategy, "kernel_impls", None) or {})
         # pipeline region (parallel/pipeline_lowering): pre/post layer
         # split + GPipe lowering of the repeated-block region
         self.pipe = getattr(strategy, "pipeline", None)
@@ -1008,6 +1015,15 @@ class Executor:
                 rngs[layer.name] = jax.random.fold_in(base, li)
         return rngs
 
+    def _attach_kernel_ctx(self, ctx):
+        """Thread the adopted kernel tier (kernels/registry.py) plus the
+        seq-axis mesh context into an EmitCtx — ring attention lowers
+        its shard_map against ctx.mesh/ctx.seq_axis."""
+        if self._kernel_impls:
+            ctx.kernel_impls = self._kernel_impls
+        ctx.mesh = self.dmesh.mesh
+        ctx.seq_axis = self.dmesh.seq_axis
+
     def _forward(self, params, state, batch, training: bool, step,
                  strategy="__use_own__", shard_index=None):
         """``strategy`` overrides the emission strategy — the quantized-
@@ -1021,6 +1037,7 @@ class Executor:
         rngs = self._rngs_for_step(step, shard_index) if training else {}
         ctx = EmitCtx(training=training, rngs=rngs, state=state,
                       config=self.config)
+        self._attach_kernel_ctx(ctx)
         if shard_index is not None:
             ctx.local_shape = True
         capture: Dict[int, Any] = {}
@@ -1077,6 +1094,7 @@ class Executor:
                                state=ctx.state, config=self.config,
                                seq_length=ctx.seq_length)
                 bctx.local_shape = getattr(ctx, "local_shape", False)
+                self._attach_kernel_ctx(bctx)
                 self.program.emit_layers(_block, benv, p_, bctx,
                                          st, None)
                 if bctx.new_state or bctx.aux_losses:
@@ -1208,6 +1226,18 @@ class Executor:
                 new_params, new_opt_state = overlap_mod.overlapped_update(
                     self.optimizer, params, grads, opt_state, step + 1,
                     self._overlap_schedule, self.opt_state_constraints)
+            elif self._kernel_impls.get("opt_update") == "fused":
+                # searched kernel tier: one-HBM-pass Pallas Adam update
+                # (kernels/opt_update.py) — bit-equal math to
+                # AdamOptimizer.update, adopted only when the registry
+                # predicate held (TPU backend, adam) at plan time
+                from .runtime.optimizers import fused_adam_tree_update
+                new_params, new_opt_state = fused_adam_tree_update(
+                    self.optimizer, params, grads, opt_state, step + 1)
+                if self.opt_state_constraints is not None:
+                    new_opt_state = jax.tree.map(
+                        jax.lax.with_sharding_constraint,
+                        new_opt_state, self.opt_state_constraints)
             else:
                 new_params, new_opt_state = self.optimizer.update(
                     params, grads, opt_state, step + 1)
@@ -1279,6 +1309,7 @@ class Executor:
         seed their O(window) ring-buffer cache. NOT jitted."""
         ctx = EmitCtx(training=False, rngs={}, state=state,
                       config=self.config)
+        self._attach_kernel_ctx(ctx)
         ctx.kv_mode = "prefill"
         ctx.kv_prefill_len = prefill_len
         capture: Dict[int, Any] = {}
@@ -1295,6 +1326,7 @@ class Executor:
         NOT jitted — called inside the generate scan."""
         ctx = EmitCtx(training=False, rngs={}, state=state,
                       config=self.config)
+        self._attach_kernel_ctx(ctx)
         ctx.kv_mode = "decode"
         ctx.kv_cache = cache
         ctx.kv_index = index

@@ -13,18 +13,37 @@ with:
   - ``ulysses_attention``: all-to-all (DeepSpeed-Ulysses style) sequence
     parallelism: swap seq-sharding for head-sharding around local flash
     attention.
+  - ``opt_update``: fused one-HBM-pass Adam update for the ZeRO-sharded
+    optimizer path (the ``opt_update:fused`` kernel tier).
+
+``registry`` makes the implementation choice a searched dimension: per-op
+variants with availability predicates and calibrated cost entry points
+(docs/kernels.md).
 
 All kernels run compiled on TPU and in Pallas interpret mode on CPU, so the
 test suite exercises them without hardware.
 """
 from .flash_attention import (dropout_keep_mask, flash_attention,
                               mha_reference)
+from .opt_update import fused_adam_update
+from .registry import (DEFAULT_IMPLS, KernelImpl, REGISTRY, attention_ctx,
+                       available_impls, get_impl, parse_forced,
+                       resolve_forced)
 from .ring_attention import ring_attention, ulysses_attention
 
 __all__ = [
+    "DEFAULT_IMPLS",
+    "KernelImpl",
+    "REGISTRY",
+    "attention_ctx",
+    "available_impls",
     "dropout_keep_mask",
     "flash_attention",
+    "fused_adam_update",
+    "get_impl",
     "mha_reference",
+    "parse_forced",
+    "resolve_forced",
     "ring_attention",
     "ulysses_attention",
 ]

@@ -434,6 +434,17 @@ class MultiHeadAttentionOp(OpDef):
         return getattr(getattr(ctx, "config", None), "use_flash_attention",
                        "auto")
 
+    @staticmethod
+    def _impl_for(ctx, name: str):
+        """This op's kernel impl from the adopted plan (the executor
+        threads ``strategy.kernel_impls`` through EmitCtx): the
+        layer-name key wins over the "attention" kind key; None = no
+        plan, keep the legacy ``use_flash_attention`` resolution."""
+        plan = getattr(ctx, "kernel_impls", None)
+        if not plan:
+            return None
+        return plan.get(name, plan.get("attention"))
+
     # Measured on v5e (BERT-base, head_dim=64, tuned 512x512-fwd /
     # 128x128-bwd blocks, unpadded d=64): XLA's fused attention still
     # wins the train step below ~1024 tokens; at 1024 the Pallas kernel
@@ -442,8 +453,8 @@ class MultiHeadAttentionOp(OpDef):
     FLASH_AUTO_MIN_SEQ = 1024
 
     @classmethod
-    def _flash_enabled(cls, ctx, seq_len: int = 0) -> bool:
-        mode = cls._flash_mode(ctx)
+    def _flash_enabled(cls, ctx, seq_len: int = 0, mode: str = None) -> bool:
+        mode = mode or cls._flash_mode(ctx)
         if mode == "false":
             return False
         if mode == "true":
@@ -532,8 +543,22 @@ class MultiHeadAttentionOp(OpDef):
         # this code runs inside shard_map with LOCAL head counts
         kh = self._expand_kv(kh, qh.shape[2])
         vh = self._expand_kv(vh, qh.shape[2])
-        flash_mode = self._flash_mode(ctx)
-        if self._flash_enabled(ctx, seq_len=max(qh.shape[1], kh.shape[1])) \
+        impl = self._impl_for(ctx, name)
+        if impl == "ring" and kv_mode is None:
+            if rate > 0.0:
+                raise ValueError(
+                    f"{name}: kernel impl 'ring' has no in-kernel "
+                    f"dropout (the registry predicate rejects it; a "
+                    f"forced plan must not bypass the verifier)")
+            return self._emit_ring(weights, ctx, name, qh, kh, vh, mdt,
+                                   cdt, causal)
+        # a planned impl overrides the legacy tri-state: "flash" forces
+        # the kernel path (in-kernel dropout included), "xla" forces the
+        # reference path
+        flash_mode = {"flash": "true", "xla": "false"}.get(
+            impl, self._flash_mode(ctx))
+        if self._flash_enabled(ctx, seq_len=max(qh.shape[1], kh.shape[1]),
+                               mode=flash_mode) \
                 and not (causal and qh.shape[1] != kh.shape[1]) \
                 and not params.get("sliding_window", 0):
             # (sliding-window masking stays on the XLA path — the Pallas
@@ -608,6 +633,51 @@ class MultiHeadAttentionOp(OpDef):
         if kvh == h:
             return x
         return jnp.repeat(x, h // kvh, axis=2)
+
+    def _emit_ring(self, weights, ctx, name, qh, kh, vh, mdt, cdt,
+                   causal):
+        """Ring-attention lowering: ONE shard_map over the mesh's
+        dedicated ``seq`` axis. Each device holds a (B, L/deg, H, D)
+        context chunk; the K/V blocks rotate ring-wise with explicit
+        ``ppermute`` hops (kernels/ring_attention.py) while block
+        compute hides the next block's KV transfer. The (seq, seq)
+        score matrix never materializes and per-device activation
+        residency drops by the seq degree — the 1/deg envelope the
+        plan verifier accounts (docs/kernels.md)."""
+        from ..kernels import ring_attention
+        from ..utils.jax_compat import shard_map
+        mesh = getattr(ctx, "mesh", None)
+        ax = getattr(ctx, "seq_axis", None)
+        if mesh is None or ax is None:
+            raise ValueError(
+                f"{name}: kernel impl 'ring' requires a mesh sequence "
+                f"axis (--seq-parallel N >= 2); this compile has none")
+        deg = dict(zip(mesh.axis_names, mesh.devices.shape))[ax]
+        if qh.shape[1] % deg != 0:
+            raise ValueError(
+                f"{name}: sequence length {qh.shape[1]} is not "
+                f"divisible by the seq-axis degree {deg}")
+
+        from jax.sharding import PartitionSpec as P
+
+        def _ring(qc, kc, vc):
+            o = ring_attention(
+                jnp.swapaxes(qc, 1, 2).astype(mdt),
+                jnp.swapaxes(kc, 1, 2).astype(mdt),
+                jnp.swapaxes(vc, 1, 2).astype(mdt),
+                ax, causal=causal)
+            return jnp.swapaxes(o, 1, 2)
+
+        spec = P(None, ax, None, None)
+        o = shard_map(_ring, mesh=mesh, in_specs=(spec,) * 3,
+                      out_specs=spec, check_vma=False)(qh, kh, vh)
+        ctxv = o.astype(jnp.float32)
+        out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(mdt),
+                         weights["wo"].astype(mdt),
+                         preferred_element_type=jnp.float32)
+        if "bo" in weights:
+            out = out + weights["bo"].astype(jnp.float32)
+        return [out.astype(cdt)]
 
     def _emit_decode(self, params, weights, ctx, name, qh, kh, vh, mdt,
                      cdt):

@@ -55,6 +55,13 @@ class EmitCtx:
         # by the shard factor ONLY when this is set — global emission
         # keeps the exact historical error behavior
         self.local_shape: bool = False
+        # searched kernel tier (kernels/registry.py): the adopted
+        # strategy's per-op impl map plus the mesh context ring
+        # attention lowers its shard_map against. None/empty = default
+        # impls (the legacy use_flash_attention resolution).
+        self.kernel_impls: Optional[Dict[str, str]] = None
+        self.mesh = None                  # jax.sharding.Mesh
+        self.seq_axis: Optional[str] = None
 
     def rng_for(self, name: str):
         return self.rngs.get(name)

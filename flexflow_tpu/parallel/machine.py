@@ -208,33 +208,67 @@ class DeviceMesh:
     prime factorization of the device count (largest factor first)."""
 
     def __init__(self, spec: MachineSpec, devices=None,
-                 mesh_shape: Optional[Sequence[int]] = None):
+                 mesh_shape: Optional[Sequence[int]] = None,
+                 seq: int = 0):
         import jax
         from jax.sharding import Mesh
         self.spec = spec
         devices = devices if devices is not None else jax.devices()
         devices = devices[: spec.num_devices]
         self.dcn_axis: Optional[str] = None
+        # dedicated sequence-parallel (context) axis: carved as the
+        # TRAILING axis so its devices are contiguous (fastest fabric —
+        # ring-attention hops belong on ICI). Reserved: the general
+        # search never shards batch/params over it (allocate_axes /
+        # valid_degrees exclude it); only ring attention consumes it.
+        self.seq_axis: Optional[str] = None
         n = len(devices)
+        seq = int(seq or 0)
+        if seq > 1:
+            if n % seq != 0:
+                raise ValueError(
+                    f"--seq-parallel {seq} does not divide {n} devices")
+            n_rest = n // seq
+        else:
+            seq, n_rest = 0, n
         slices = spec.num_slices if (spec.num_slices > 1
                                      and n % spec.num_slices == 0) else 1
+        if seq and slices > 1 and (n_rest % slices != 0):
+            raise ValueError(
+                f"--seq-parallel {seq} does not compose with "
+                f"{slices} slices over {n} devices (the seq axis must "
+                f"stay inside a slice)")
         if mesh_shape is not None:
             factors = [int(s) for s in mesh_shape if int(s) > 1] or [1]
-            self.axis_sizes: Dict[str, int] = {
-                f"x{i}": f for i, f in enumerate(factors)}
+            if seq and int(np.prod(factors)) * seq == n:
+                # an explicit mesh_shape describes the non-seq axes
+                self.axis_sizes: Dict[str, int] = {
+                    f"x{i}": f for i, f in enumerate(factors)}
+            else:
+                self.axis_sizes = {
+                    f"x{i}": f for i, f in enumerate(factors)}
+                seq = 0
         elif slices > 1:
             # leading "dcn" axis spans slices/hosts: jax.devices() orders
             # devices process-major, so the reshape puts each slice's
             # devices contiguous along the inner (ICI) axes
-            inner = _prime_factors(n // slices) or [1]
+            inner = _prime_factors(n_rest // slices) or [1]
             self.axis_sizes = {"dcn": slices,
                                **{f"x{i}": f for i, f in enumerate(inner)}}
             self.dcn_axis = "dcn"
         else:
-            factors = _prime_factors(n) or [1]
+            factors = _prime_factors(n_rest) or [1]
             self.axis_sizes = {f"x{i}": f for i, f in enumerate(factors)}
+        if seq:
+            self.axis_sizes["seq"] = seq
+            self.seq_axis = "seq"
         arr = np.asarray(devices).reshape(tuple(self.axis_sizes.values()))
         self.mesh = Mesh(arr, tuple(self.axis_sizes.keys()))
+
+    @property
+    def seq_degree(self) -> int:
+        """Size of the dedicated sequence axis (1 = no seq axis)."""
+        return self.axis_sizes.get("seq", 1) if self.seq_axis else 1
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
@@ -313,7 +347,8 @@ class DeviceMesh:
             items = self.axes_by_tier(innermost_first=(prefer == "inner"))
         else:
             items = list(self.axis_sizes.items())
-        avail = [(a, s) for a, s in items if a not in used]
+        avail = [(a, s) for a, s in items
+                 if a not in used and a != self.seq_axis]
         picked: List[str] = []
         rem = degree
 
@@ -335,8 +370,21 @@ class DeviceMesh:
         return None
 
     def valid_degrees(self) -> List[int]:
-        """All degrees realizable as subset products of atomic axes."""
+        """All degrees realizable as subset products of atomic axes
+        (the reserved seq axis, when present, is not in the pool)."""
         degs = {1}
-        for s in self.axis_sizes.values():
+        for a, s in self.axis_sizes.items():
+            if a == self.seq_axis:
+                continue
             degs |= {d * s for d in degs}
         return sorted(degs)
+
+    @property
+    def sharding_axes(self) -> Tuple[str, ...]:
+        """Axes the general search may shard over (all but ``seq``)."""
+        return tuple(a for a in self.axis_sizes if a != self.seq_axis)
+
+    @property
+    def sharding_devices(self) -> int:
+        """Device count across the general sharding axes."""
+        return max(1, self.num_devices // self.seq_degree)

@@ -209,7 +209,12 @@ def bandwidth_multiplier(collective: str, degree: int) -> float:
     and the flat baseline they are compared against can never drift."""
     return {"all_reduce": 2.0, "all_gather": 1.0,
             "reduce_scatter": 1.0, "all_to_all": 1.0 / max(degree, 1),
-            "permute": 1.0 / max(degree, 1)}[collective]
+            "permute": 1.0 / max(degree, 1),
+            # ring-attention rotation: (d-1) neighbor exchanges, each
+            # moving the FULL per-hop payload (``volume``) — times the
+            # callers' shared (d-1)/d fraction this yields exactly
+            # (d-1) x volume / bw, the serial ring-hop traffic
+            "ppermute": float(degree)}[collective]
 
 
 def tree_bandwidth_cost(phases: Sequence[Phase],

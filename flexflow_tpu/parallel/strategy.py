@@ -99,6 +99,16 @@ class ShardingStrategy:
         # serving pass (KV sharding sound, envelope fits at the largest
         # bucket).
         self.serving = None
+        # searched per-op kernel-implementation assignment
+        # (kernels/registry.py, planned by FFModel._plan_kernels):
+        # op kind -> impl for graph-wide kinds ("opt_update": "fused")
+        # and layer-name -> impl for attention ops ("attn0": "ring").
+        # {} / missing key = the kind's default impl. Serializes as the
+        # artifact's "kernel_impls" block (--import honors it verbatim)
+        # and is statically checked by analysis/plan_verifier's kernel
+        # pass (every chosen impl's availability predicate must hold on
+        # the adopted mesh/shapes).
+        self.kernel_impls: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     def set_op(self, layer_name: str, outputs: Sequence[Optional[P]],
@@ -132,17 +142,20 @@ class ShardingStrategy:
         weights replicated. Analog of the reference's
         ``--only-data-parallel`` canonical view (``graph.cc:1939-1964``)."""
         st = cls(dmesh)
-        axes = dmesh.axis_names
+        # the reserved seq axis (ring attention's context axis) never
+        # carries the batch dim — DP spans the general sharding axes
+        axes = dmesh.sharding_axes
+        nd = dmesh.sharding_devices
         batch_axes = axes if len(axes) > 1 else (axes[0] if axes else None)
-        if dmesh.num_devices == 1:
+        if nd == 1:
             return st  # single device: everything unsharded
         for t in input_tensors:
-            if t.shape and t.shape[0] % dmesh.num_devices == 0:
+            if t.shape and t.shape[0] % nd == 0:
                 st.inputs[t.name] = P(batch_axes)
         for layer in layers:
             outs = []
             for o in layer.outputs:
-                if o.shape and o.shape[0] % dmesh.num_devices == 0:
+                if o.shape and o.shape[0] % nd == 0:
                     outs.append(P(batch_axes))
                 else:
                     outs.append(None)
@@ -186,6 +199,8 @@ class ShardingStrategy:
             lines.append(
                 f"qsync: {s['n_quantized']}/{s['n_params']} grad syncs "
                 f"quantized ({s['mode']}, wire {s['wire']})")
+        if self.kernel_impls:
+            lines.append(f"kernel impls: {dict(self.kernel_impls)}")
         for name, os in self.ops.items():
             lines.append(f"  {name}: out={os.outputs} w={os.weights}")
         for bk in self.banks:

@@ -112,3 +112,27 @@ class AdamOptimizer(Optimizer):
         return (jax.tree.map(lambda t3: t3[0], flat, is_leaf=is_t),
                 {"m": jax.tree.map(lambda t3: t3[1], flat, is_leaf=is_t),
                  "v": jax.tree.map(lambda t3: t3[2], flat, is_leaf=is_t)})
+
+
+def fused_adam_tree_update(opt: AdamOptimizer, params, grads, state, step):
+    """Adam update through the one-HBM-pass Pallas kernel
+    (kernels/opt_update.py fused_adam_update), selected by the searched
+    kernel tier (``opt_update: fused``). Bit-equal update math to
+    ``AdamOptimizer.update`` — w/g/m/v stream through VMEM once instead
+    of XLA's per-term HBM round trips."""
+    from ..kernels.opt_update import fused_adam_update
+
+    t = step.astype(jnp.float32)
+    alpha_t = opt.alpha * jnp.sqrt(1.0 - opt.beta2 ** t) \
+        / (1.0 - opt.beta1 ** t)
+
+    def upd(w, g, m, v):
+        return fused_adam_update(
+            w, g, m, v, alpha_t, beta1=opt.beta1, beta2=opt.beta2,
+            eps=opt.epsilon, wd=opt.weight_decay)
+
+    flat = jax.tree.map(upd, params, grads, state["m"], state["v"])
+    is_t = lambda x: isinstance(x, tuple)
+    return (jax.tree.map(lambda t3: t3[0], flat, is_leaf=is_t),
+            {"m": jax.tree.map(lambda t3: t3[1], flat, is_leaf=is_t),
+             "v": jax.tree.map(lambda t3: t3[2], flat, is_leaf=is_t)})

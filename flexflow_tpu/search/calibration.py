@@ -56,6 +56,17 @@ COLLECTIVE_SIZES = (1 << 16, 1 << 20, 1 << 23)
 
 COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
 
+#: ring-attention hop payloads measured over the dedicated seq axis
+#: (coll_ppermute rows): one neighbor-exchange of the local K/V block
+PPERMUTE_SIZES = (1 << 16, 1 << 20, 1 << 23)
+
+#: attention-core payload sizes for the kernel-impl rows
+#: (op_attention@<impl>): q bytes at (b=1, h=8, d=64) — the two classes
+#: span s=128..512; larger contexts extrapolate on the measured pair.
+#: Kept small on purpose: the flash row times the Pallas kernel in
+#: interpret mode on CPU hosts, which is minutes-slow at long s.
+ATTN_IMPL_SIZES = (1 << 16, 1 << 20)
+
 
 def shape_class(nbytes: int) -> int:
     """Power-of-two size bucket: measurements and lookups for payloads
@@ -291,7 +302,8 @@ def _bench_parallel_eff(mesh, n_dev: int) -> float:
 
 def _bench_collective(mesh, coll: str, nbytes: int,
                       n_axes: Optional[int] = None,
-                      dtype: str = "float32") -> float:
+                      dtype: str = "float32",
+                      axes: Optional[Tuple[str, ...]] = None) -> float:
     """One logical collective over the first ``n_axes`` mesh axes (all
     by default) at ``nbytes`` payload per group, on the live backend.
     With a subset, the remaining axes run the same collective
@@ -310,14 +322,22 @@ def _bench_collective(mesh, coll: str, nbytes: int,
            "float8_e4m3": jnp.float8_e4m3fn,
            "float8_e5m2": jnp.float8_e5m2}[dtype]
     isz = np.dtype(jdt).itemsize
-    axes = tuple(mesh.axis_names)
-    coll_axes = axes[:n_axes] if n_axes else axes
+    all_axes = tuple(mesh.axis_names)
+    coll_axes = axes if axes is not None \
+        else (all_axes[:n_axes] if n_axes else all_axes)
+    axes = all_axes
     n_dev = int(np.prod([mesh.shape[a] for a in axes]))
     deg = int(np.prod([mesh.shape[a] for a in coll_axes]))
-    # ``nbytes`` is the PER-GROUP payload (what xfer_cost queries); a
-    # subset collective has n_dev/deg concurrent groups, so the global
-    # array scales up to keep each group's volume at nbytes
-    m = max(nbytes // isz * (n_dev // deg), n_dev * n_dev)
+    if coll == "ppermute":
+        # ring-hop exchange: every device sends its WHOLE local block
+        # to its +1 neighbor on the ring axis — ``nbytes`` is the
+        # per-device (= per-hop per-link) payload
+        m = max(nbytes // isz * n_dev, n_dev * n_dev)
+    else:
+        # ``nbytes`` is the PER-GROUP payload (what xfer_cost queries);
+        # a subset collective has n_dev/deg concurrent groups, so the
+        # global array scales up to keep each group's volume at nbytes
+        m = max(nbytes // isz * (n_dev // deg), n_dev * n_dev)
     m -= m % (n_dev * n_dev)       # shardable + all_to_all reshapable
     x = jnp.ones((m,), jdt)
 
@@ -342,12 +362,138 @@ def _bench_collective(mesh, coll: str, nbytes: int,
         def body(xl):
             return acc(jax.lax.all_to_all(
                 xl.reshape(deg, -1), coll_axes, 0, 0))
+    elif coll == "ppermute":
+        # one ring hop (the unit step of ring attention's K/V
+        # rotation): a single named axis only — a ring over a
+        # flattened multi-axis prefix is not a neighbor exchange
+        if len(coll_axes) != 1:
+            raise ValueError("ppermute benches a single mesh axis")
+        ax = coll_axes[0]
+        perm = [(i, (i + 1) % deg) for i in range(deg)]
+
+        def body(xl):
+            return acc(jax.lax.ppermute(xl, ax, perm))
     else:
         raise ValueError(coll)
 
     f = jax.jit(shard_map(body, mesh=mesh, in_specs=P(axes),
                           out_specs=P(axes)))
     return _timed(f, (x,), repeats=3)
+
+
+def _attn_seq_len(nbytes: int, deg: int = 1) -> int:
+    """Sequence length whose q payload is ``nbytes`` at the canonical
+    bench geometry (b=1, h=8, d=64, f32), rounded so flash blocks and
+    ring chunks both divide."""
+    s = max(nbytes // (4 * 8 * 64), 128)
+    step = 128 * max(deg, 1)
+    return max(s - s % step, step)
+
+
+def _bench_attention_impl(impl: str, s: int, mesh=None,
+                          seq_axis: Optional[str] = None) -> float:
+    """Forward time of one attention core at sequence length ``s`` and
+    the canonical bench geometry (b=1, h=8, d=64, f32) — the measured
+    anchor for the searchable kernel tier (``op_attention@<impl>``
+    rows). ``xla`` is the materialized-scores reference, ``flash`` the
+    Pallas kernel (interpret mode off-TPU), ``ring`` one shard_map over
+    the mesh's seq axis with ppermute hops (requires
+    ``mesh``/``seq_axis``)."""
+    import jax
+    import jax.numpy as jnp
+
+    b, h, d = 1, 8, 64
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)) * 0.02, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)) * 0.02, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)) * 0.02, jnp.float32)
+    sc = 1.0 / math.sqrt(d)
+
+    if impl == "xla":
+        def f(q_, k_, v_):
+            sm = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) * sc
+            i = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+            j = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            sm = jnp.where(j <= i, sm, -1e9)
+            p = jax.nn.softmax(sm, axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v_))[None]
+    elif impl == "flash":
+        from ..kernels import flash_attention
+
+        def f(q_, k_, v_):
+            o = flash_attention(
+                q_, k_, v_, causal=True,
+                interpret=None if jax.default_backend() == "tpu"
+                else True)
+            return jnp.sum(o.astype(jnp.float32))[None]
+    elif impl == "ring":
+        if mesh is None or seq_axis is None:
+            raise ValueError("ring bench needs a mesh with a seq axis")
+        from jax.sharding import PartitionSpec as P
+
+        from ..kernels import ring_attention
+        from ..utils.jax_compat import shard_map
+        spec = P(None, None, seq_axis, None)
+
+        def body(q_, k_, v_):
+            o = ring_attention(q_, k_, v_, seq_axis, causal=True)
+            return jnp.sum(o.astype(jnp.float32))[None]
+
+        inner = shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+                          out_specs=P(seq_axis), check_vma=False)
+
+        def f(q_, k_, v_):
+            return jnp.sum(inner(q_, k_, v_))[None]
+    else:
+        raise ValueError(impl)
+
+    return _timed(jax.jit(f), (q, k, v), repeats=3)
+
+
+def calibrate_kernel_impls(dmesh=None,
+                           table: Optional[CalibrationTable] = None,
+                           cache_dir: Optional[str] = None,
+                           impls: Tuple[str, ...] = ("xla", "flash",
+                                                     "ring"),
+                           sizes: Tuple[int, ...] = ATTN_IMPL_SIZES
+                           ) -> CalibrationTable:
+    """Measure (or warm-load) the kernel-impl rows the searchable
+    kernel tier prices from: ``op_attention@<impl>`` keyed by the q
+    payload's shape class (``ring`` additionally by the seq degree).
+    Persisted like every other calibration row — a warm table makes
+    this call measurement-free. Called by ``FFModel._plan_kernels``
+    (not the base ``calibrate_mesh``) so searches without the kernel
+    tier pay nothing new."""
+    import jax
+    tab = table if table is not None else CalibrationTable(cache_dir)
+    backend = jax.default_backend()
+    mesh = dmesh.mesh if dmesh is not None else None
+    seq_axis = getattr(dmesh, "seq_axis", None) if dmesh is not None \
+        else None
+    for impl in impls:
+        deg = 0
+        if impl == "ring":
+            if mesh is None or seq_axis is None:
+                continue               # no seq axis: no ring row
+            deg = int(mesh.shape[seq_axis])
+            # ring's chunking floor (128*deg) collapses the small size
+            # classes onto one sequence length — bench two DISTINCT
+            # lengths so the row interpolates instead of degenerating
+            # to a single point
+            seqs = (128 * deg, 256 * deg)
+        else:
+            seqs = tuple(_attn_seq_len(nb) for nb in sizes)
+        for s in sorted(set(seqs)):
+            # keyed by the ACTUAL q payload of the benched shape, not
+            # the requested class — ring's rounding must not file an
+            # s=512 measurement under the s=128 class
+            qbytes = 4 * 8 * 64 * s
+            tab.get_or_measure(
+                backend, f"op_attention@{impl}", "float32",
+                shape_class(qbytes), deg,
+                lambda i=impl, n=s: _bench_attention_impl(
+                    i, n, mesh=mesh, seq_axis=seq_axis))
+    return tab
 
 
 # ----------------------------------------------------------------------
@@ -472,6 +618,26 @@ class MeshCalibration:
             if not (0.5 <= near / degree <= 2.0):
                 return None          # too far to stand in
             pts = self._points(coll, near)
+        return self._interp(pts, nbytes)
+
+    def op_time(self, kind: str, nbytes: float,
+                degree: int = 0) -> Optional[float]:
+        """Measured time of one kernel-impl row (``op_<kind>`` —
+        e.g. ``attention@ring``), interpolated across the measured
+        shape classes. ``degree`` keys the rows that depend on a mesh
+        axis size (ring's seq degree); 0 for degree-free impls. None =
+        never measured — the cost model falls back to its analytic
+        curve for that impl."""
+        if self.table is None or nbytes <= 0:
+            return None
+        key = (f"op:{kind}", degree, self.dtype)
+        pts = self._pts.get(key)
+        if pts is None:
+            pts = self.table.entries(self.backend, f"op_{kind}",
+                                     self.dtype, axis_size=degree)
+            self._pts[key] = pts
+        if not pts:
+            return None
         return self._interp(pts, nbytes)
 
     @staticmethod
@@ -673,6 +839,30 @@ def _calibrate_mesh(backend, dmesh, cache_dir, collectives, sizes,
                                             deg) is None:
                             tab.put(backend, f"coll_{coll}@{tier}",
                                     wdt, shape_class(nbytes), deg, vw)
+        # ring-hop rows (coll_ppermute): ONE neighbor exchange over a
+        # single mesh axis — the unit step ring attention's K/V
+        # rotation pays (degree-1) times. Measured over the dedicated
+        # seq axis when the mesh has one (that IS the ring), else the
+        # innermost axis; tier-mirrored like the grouped collectives so
+        # placement-path pricing stays strict per tier.
+        ring_ax = getattr(dmesh, "seq_axis", None) or axis_names[-1]
+        ring_deg = int(mesh.shape[ring_ax])
+        if ring_deg > 1:
+            ring_tier = axis_tiers.get(ring_ax, "ici") \
+                if multi_tier else None
+            for nbytes in PPERMUTE_SIZES:
+                v = tab.get_or_measure(
+                    backend, "coll_ppermute", "float32",
+                    shape_class(nbytes), ring_deg,
+                    lambda s=nbytes, a=ring_ax:
+                        _bench_collective(mesh, "ppermute", s,
+                                          axes=(a,)))
+                if v is not None and ring_tier is not None and tab.get(
+                        backend, f"coll_ppermute@{ring_tier}",
+                        "float32", shape_class(nbytes),
+                        ring_deg) is None:
+                    tab.put(backend, f"coll_ppermute@{ring_tier}",
+                            "float32", shape_class(nbytes), ring_deg, v)
     return calib
 
 

@@ -68,6 +68,12 @@ def save_strategy(path: str, strategy: ShardingStrategy,
         # ffcheck --verify-strategies runs the overlapped-ordering
         # check on the exported artifact
         doc["overlap"] = dict(strategy.overlap)
+    if getattr(strategy, "kernel_impls", None):
+        # per-op kernel implementations (kernels/registry.py): layer
+        # names -> attention impl, plus the graph-wide "opt_update"
+        # kind; --import honors it verbatim and the plan verifier
+        # re-checks every predicate on the importing mesh
+        doc["kernel_impls"] = dict(strategy.kernel_impls)
     banks_doc = banks_to_json(strategy)
     if banks_doc:
         doc["banks"] = banks_doc
@@ -496,6 +502,9 @@ def load_strategy(path: str, layers, dmesh: DeviceMesh) -> ShardingStrategy:
         st.qsync = QsyncPlan.from_json(doc["qsync"])
     if doc.get("overlap"):
         st.overlap = dict(doc["overlap"])
+    if doc.get("kernel_impls"):
+        st.kernel_impls = {str(k): str(v)
+                           for k, v in doc["kernel_impls"].items()}
     if doc.get("banks"):
         from ..parallel.banks import BankSpec
         st.banks = [BankSpec(list(b["members"]), tuple(b["axes"]),

@@ -20,6 +20,17 @@ target:
   GQA), sharded along the attention head-parallel degree, counted in
   the serving memory envelope (``analysis/plan_verifier``) and read
   once per decode step on the HBM side of the roofline.
+* **Seq-sharded KV as a scored option** (long-prompt buckets): when
+  the mesh carries a sequence axis (``DeviceMesh.seq_degree >= 2``),
+  each cache-carrying layer may additionally shard its KV cache over
+  the CONTEXT dimension — per-device residency (and the decode-step
+  cache-read floor) drops by the seq degree, paid for by a per-step
+  flash-decoding-style combine of partial attention outputs rotated
+  over the seq axis (priced from the calibrated per-tier
+  ``coll_ppermute`` rows when present). Adopted when the cache-read
+  saving beats the combine, or when the head-sharded cache alone
+  cannot fit HBM; recorded as ``seq_shard_degree`` in the KV plan and
+  re-checked by the verifier.
 * **Per-(model, batch-class) plans** (``optimize_serving_strategy``):
   one searched assignment per bucket — small buckets lean tensor-
   parallel (batch can't shard), large buckets lean data-parallel —
@@ -144,6 +155,8 @@ class ServingCostEvaluator:
         # compile-time (batch, seq) the graph was built at — cost
         # scaling maps compile-shape op costs to serving shapes
         self.compile_batch, self.compile_seq = self._graph_shape()
+        self._n_cache = sum(1 for l in self.layers
+                            if kv_cache_spec(l) is not None)
 
     def _graph_shape(self) -> Tuple[int, int]:
         for l in self.layers:
@@ -178,8 +191,9 @@ class ServingCostEvaluator:
 
     def kv_plan(self, assign: Dict[str, Tuple[int, ...]]
                 ) -> Dict[str, Dict[str, int]]:
-        """layer name -> {shard_degree, bytes (per device, this
-        bucket), num_kv_heads, head_dim} for every cache-carrying op."""
+        """layer name -> {shard_degree, seq_shard_degree, bytes (per
+        device, this bucket), num_kv_heads, head_dim} for every
+        cache-carrying op."""
         plan: Dict[str, Dict[str, int]] = {}
         for l in self.layers:
             spec = kv_cache_spec(l)
@@ -187,13 +201,70 @@ class ServingCostEvaluator:
                 continue
             deg = kv_shard_degree(l, self.options[l.name],
                                   assign.get(l.name, ()))
+            sdeg = self.kv_seq_degree(l, assign)
             plan[l.name] = {
                 "shard_degree": deg,
+                "seq_shard_degree": sdeg,
                 "bytes": kv_cache_bytes(l, self.bucket, self.max_seq,
-                                        deg),
+                                        deg * sdeg),
                 "num_kv_heads": spec["num_kv_heads"],
                 "head_dim": spec["head_dim"]}
         return plan
+
+    def _seq_combine_cost(self, act_bytes: int, sdeg: int) -> float:
+        """Per-decode-step price of combining seq-sharded partial
+        attention outputs: a (sdeg-1)-hop ppermute rotation of the
+        (bucket × embed) partial output + running softmax statistics
+        (flash-decoding style) over the sequence axis. Priced from the
+        calibrated per-tier ``coll_ppermute`` rows when the table has
+        them; otherwise through the decode-latency collective path
+        (per-dispatch floor included — these fire once per token)."""
+        if sdeg <= 1 or act_bytes <= 0:
+            return 0.0
+        cm = self.cost
+        tier = getattr(self.dmesh, "axis_tiers", {}).get(
+            getattr(self.dmesh, "seq_axis", None))
+        hop = None
+        if cm.calib is not None:
+            hop = cm.calib.collective_time("ppermute", sdeg, act_bytes,
+                                           tier=tier)
+            if hop is None and tier is not None:
+                hop = cm.calib.collective_time("ppermute", sdeg,
+                                               act_bytes)
+        if hop is not None:
+            floor = cm.calib.dispatch_s or 0.0
+            return max((sdeg - 1) * float(hop), floor)
+        return cm.decode_collective_cost(act_bytes, "all_gather", sdeg)
+
+    def kv_seq_degree(self, layer: Layer,
+                      assign: Dict[str, Tuple[int, ...]]) -> int:
+        """Sequence-dim KV shard degree scored for this layer: the
+        mesh's seq degree when context sharding WINS — the per-step
+        cache-read saving beats the per-step partial-output combine —
+        or when the head-sharded cache alone cannot fit this model's
+        HBM share (the long-prompt bucket a flat cache would reject);
+        1 otherwise. Deterministic in (layer, assign) so ``evaluate``,
+        ``kv_plan`` and the audit all agree."""
+        sdeg = int(getattr(self.dmesh, "seq_degree", 0) or 0)
+        if sdeg < 2:
+            return 1
+        spec = kv_cache_spec(layer)
+        if spec is None or self.max_seq % sdeg != 0:
+            return 1
+        kv_deg = kv_shard_degree(layer, self.options[layer.name],
+                                 assign.get(layer.name, ()))
+        flat = kv_cache_bytes(layer, self.bucket, self.max_seq, kv_deg)
+        saved = self.cost.kv_read_time(flat) \
+            - self.cost.kv_read_time(flat // sdeg)
+        act = self.bucket * spec["embed_dim"] * KV_DTYPE_BYTES
+        if saved > self._seq_combine_cost(act, sdeg):
+            return sdeg
+        # memory-bound adoption: head-sharded residency across all
+        # cache layers busts HBM — seq sharding is what makes the
+        # bucket feasible at all
+        if flat * max(self._n_cache, 1) > self.cost.spec.hbm_bytes:
+            return sdeg
+        return 1
 
     def evaluate(self, assign: Dict[str, Tuple[int, ...]]) -> ServingCost:
         prefill = dec_compute = dec_comm = 0.0
@@ -227,9 +298,17 @@ class ServingCostEvaluator:
             # decode step re-reads the full local weights and KV cache
             kv_deg = kv_shard_degree(layer, opts,
                                      assign.get(layer.name, ()))
+            kv_sdeg = self.kv_seq_degree(layer, assign)
             kv_local = kv_cache_bytes(layer, self.bucket, self.max_seq,
-                                      kv_deg)
+                                      kv_deg * kv_sdeg)
             kv_total += kv_local
+            if kv_sdeg > 1:
+                # seq-sharded KV: each step combines partial outputs
+                # over the sequence axis (flash-decoding rotation)
+                spec_l = kv_cache_spec(layer) or {}
+                dec_comm += self._seq_combine_cost(
+                    self.bucket * int(spec_l.get("embed_dim") or 0)
+                    * KV_DTYPE_BYTES, kv_sdeg)
             seq_scale = 1.0 / seq \
                 if self._carries_seq(layer.outputs[0].shape
                                      if layer.outputs else None) else 1.0

@@ -554,3 +554,84 @@ def test_zero_assignment_on_bank_member_rejected():
     assert any(f.check == "zero" and "bank" in f.message
                and "replicated" in f.message for f in report.errors), \
         [f.format() for f in report.errors]
+
+
+# ===========================================================================
+# searchable kernel tier: known-bad fixture + seq-aware envelope (ISSUE 19)
+# ===========================================================================
+
+def test_badplan_kernel_ring_noseq_rejected():
+    """The pinned kernel-check rejection: a strategy assigning the
+    'ring' attention impl on a mesh whose axes carry no sequence axis.
+    The kernel check must reject with the op attributed; the same doc
+    with a seq axis added verifies clean."""
+    path = os.path.join(FIXTURES, "badplan_kernel_ring_noseq.json")
+    report = verify_strategy_file(path)
+    assert not report.ok()
+    hits = [f for f in report.errors if f.check == "kernel"]
+    assert hits, [f.format() for f in report.errors]
+    assert any(f.op == "op_multihead_attention_0"
+               and f.seam == "kernel-impl"
+               and "sequence axis" in f.message for f in hits), \
+        [f.format() for f in hits]
+    with open(path) as f:
+        doc = json.load(f)
+    doc["mesh_axes"] = {"x0": 2, "seq": 4}
+    assert verify_strategy_file(path, doc=doc).ok()
+
+
+def test_badplan_kernel_ring_noseq_rejected_via_ffcheck_cli(tmp_path):
+    """The same fixture through `ffcheck --verify-strategies` (the ci.sh
+    gate's entry point): exit 1 with the kernel finding printed."""
+    import shutil
+    d = tmp_path / "strategies"
+    d.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "badplan_kernel_ring_noseq.json"),
+                str(d / "badplan_kernel_ring_noseq.json"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ffcheck.py"),
+         "--verify-strategies", str(d)],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "kernel" in r.stdout \
+        and "op_multihead_attention_0" in r.stdout, r.stdout
+
+
+def test_kernel_unknown_impl_and_unknown_op_rejected():
+    from flexflow_tpu.analysis.plan_verifier import (PlanReport,
+                                                     _check_kernel)
+    report = PlanReport()
+    _check_kernel(report, {"attn_0": "warp", "ghost_op": "flash",
+                           "opt_update": "mega"},
+                  {"x0": 4}, {}, have_layers=True,
+                  known_layers={"attn_0"})
+    msgs = " | ".join(f.message for f in report.errors)
+    assert "unknown attention impl 'warp'" in msgs
+    assert "does not contain" in msgs
+    assert "unknown opt_update impl 'mega'" in msgs
+
+
+def test_memory_envelope_ring_divides_attention_residency():
+    """A ring-assigned attention op's activation residency counts at
+    1/seq-degree — the arithmetic that lets a context which only fits
+    BECAUSE of ring attention verify."""
+    from flexflow_tpu.analysis.plan_verifier import memory_envelope
+    cfg = FFConfig()
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    q = ff.create_tensor((2, 256, 64), name="q")
+    ff.multihead_attention(q, q, q, embed_dim=64, num_heads=4)
+    ff.compile(SGDOptimizer(0.01), "identity", [])
+    layers = ff.executor.program.layers
+    axis_sizes = {"x0": 1, "seq": 4}
+    opt = SGDOptimizer(0.01)
+    ff.strategy.kernel_impls = {}
+    flat = memory_envelope(ff.strategy, layers, axis_sizes, opt)
+    attn = next(l.name for l in layers
+                if "attention" in l.op_type.name.lower())
+    ff.strategy.kernel_impls = {attn: "ring"}
+    ring = memory_envelope(ff.strategy, layers, axis_sizes, opt)
+    assert flat["peak_activation_op"] == attn
+    assert ring["peak_activation_bytes"] \
+        <= flat["peak_activation_bytes"] / 4 + 1e-6

@@ -51,6 +51,20 @@ def _scripted(default_probe_results):
             return {"n": 8, "virtual_searched_vs_dp": 2.5,
                     "fidelity_spearman": 0.7, "fidelity_rows": 8,
                     "rows": []}, None
+        if stage == "long_context":
+            assert env.get("FF_CALIBRATION_V2") == "1"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"n": 8, "kernel_impl": "ring",
+                    "envelope_binds": True,
+                    "envelope_xla_mb": 900.0, "envelope_ring_mb": 300.0,
+                    "hbm_gate_mb": 600.0, "verified": True,
+                    "step_s_ring": 6.8, "step_s_xla": 16.0,
+                    "loss": 1.0, "loss_finite": True,
+                    "fidelity_row": {"workload": "long_context",
+                                     "ranker": "kernel",
+                                     "predicted": 5.5, "measured": 2.4},
+                    "ok": True}, None
         if stage == "obs_overhead":
             assert env.get("JAX_PLATFORMS") == "cpu"
             assert "xla_force_host_platform_device_count" \
@@ -255,3 +269,39 @@ def test_virtual_leg_fields_always_present(monkeypatch, capsys):
         assert out["fleet_p99_ms"] == 83.2
         assert out["fleet_continuous_vs_static"] == 1.4
         assert any(a[1] == "fleet" for a, _ in calls)
+        # and the ring-attention long-context leg (ISSUE 19); the
+        # scripted virtual leg carries no rows, so its spearman must
+        # pass through un-refolded
+        assert out["long_context_kernel_impl"] == "ring"
+        assert out["long_context_envelope_binds"] is True
+        assert out["long_context_verified"] is True
+        assert any(a[1] == "long_context" for a, _ in calls)
+
+
+def test_long_context_row_folds_into_fidelity(monkeypatch, capsys):
+    """When the virtual leg carries scored rows, the long-context
+    kernel-choice row joins them and the spearman is recomputed over
+    the combined set (concordant ranks here -> stays 1.0 at 4 rows)."""
+    tpu = {"platform": "tpu", "n": 1, "device_kind": "v5e"}
+    fake, calls = _scripted([tpu])
+    rows = [{"workload": "mlp", "ranker": "tasksim",
+             "predicted": 1.2, "measured": 1.1},
+            {"workload": "dlrm", "ranker": "tasksim",
+             "predicted": 2.5, "measured": 2.2},
+            {"workload": "xdl", "ranker": "tasksim",
+             "predicted": 1.8, "measured": 1.5}]
+
+    def fake2(args, timeout, env=None):
+        if args[1] == "virtual":
+            return {"n": 8, "virtual_searched_vs_dp": 2.2,
+                    "fidelity_spearman": 1.0, "fidelity_rows": 3,
+                    "rows": rows}, None
+        return fake(args, timeout, env)
+
+    monkeypatch.setattr(bench, "_run_stage", fake2)
+    monkeypatch.setattr(bench.subprocess, "Popen", _popen_raises)
+    monkeypatch.setenv("BENCH_DEADLINE_S", "1200")
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["virtual_fidelity_rows"] == 4
+    assert out["virtual_fidelity_spearman"] == 1.0

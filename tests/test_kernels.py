@@ -122,6 +122,100 @@ def test_ulysses_attention_matches_reference(causal):
 
 
 # ---------------------------------------------------------------------------
+# kernel-tier numerics satellites: ragged lengths, GQA head layouts,
+# ring at both supported seq degrees
+# ---------------------------------------------------------------------------
+def test_flash_ragged_cross_lengths_match_reference():
+    """Ragged q/kv lengths (cross-attention), neither a block multiple:
+    the kv_len mask must keep padded keys out of the softmax."""
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((2, 4, 96, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 4, 200, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 4, 200, 64)), jnp.float32)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def _gqa_qkv(b=1, h=8, kvh=2, s=128, d=32, seed=3):
+    """GQA layout the op layer feeds the kernels: kv projected at kvh
+    heads, repeated up to h query heads (ops/nn_ops.py _repeat_kv)."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kvh, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kvh, s, d)), jnp.float32)
+    rep = h // kvh
+    return q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_head_layout_matches_reference(causal):
+    q, k, v = _gqa_qkv()
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(mha_reference(q, k, v, causal=causal)),
+        atol=2e-5, rtol=2e-5)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-4, rtol=5e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("degree", [2, 4])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_seq_degrees(degree, causal):
+    """Ring attention at both supported seq degrees, fwd + grad."""
+    mesh = Mesh(np.asarray(jax.devices()[:degree]), ("sp",))
+    q, k, v = _rand_qkv(b=1, h=2, s=32 * degree, d=16, seed=degree)
+
+    ring = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None))
+    out = jax.jit(ring)(q, k, v)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-4, rtol=5e-4, err_msg=name)
+
+
+def test_ring_attention_gqa_head_layout():
+    mesh = _seq_mesh()
+    q, k, v = _gqa_qkv(h=4, kvh=2, s=128, d=16, seed=9)
+    ring = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None))
+    out = jax.jit(ring)(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
 def test_mha_op_flash_path_matches_xla_path():
     """The MultiHeadAttention op emits the Pallas flash kernel when
     use_flash_attention is on; numerics must match the XLA path."""

@@ -1188,6 +1188,123 @@ def stage_recovery(steps: int):
            "ok": async_pct <= 5.0})
 
 
+def stage_replan(budget: int, steps: int):
+    """Closed-loop adaptation leg (ISSUE 20 acceptance): a degraded
+    fleet must heal itself through ``resilience/replan.py`` — and the
+    swap must be worth it.
+
+    On the 2-slice virtual mesh the incumbent is pinned to the plain
+    data-parallel plan, a ``degrade_link`` drill slows the ici tier 6x
+    mid-training, every collective calibration row is drift-marked and
+    re-measured under the active drill, and the controller re-searches,
+    gates and hot-swaps. Gate: the healed/degraded ratio is >= 1.1x
+    MEASURED when real step time moves, else the swap must have been
+    admitted gate-deferred with a predicted ratio >= 1.1x asserted from
+    the strategy audit record (a virtual drill degrades the cost model,
+    not real CPU step time, so the measured ratio is reported but its
+    gate defers to the predicted one — the same contract the
+    controller's own A/B guard records).
+    """
+    _apply_platform_env()
+    import statistics
+    import tempfile
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.models import build_mlp
+    from flexflow_tpu.obs.audit import load_strategy_audit
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.resilience import (ReplanController, ReplanPolicy,
+                                         faults)
+    from flexflow_tpu.search import calibration
+
+    calibration._DEFAULT_DIR = tempfile.mkdtemp(prefix="ff_bench_replan_")
+    spec = MachineSpec.detect()
+    spec.num_devices = 8
+    spec.num_slices = 2
+    spec.num_hosts = 2
+    spec.dcn_bandwidth_gbps = 1.0
+    spec.dcn_latency_us = 20.0
+
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.search_budget = 8
+    cfg.search_floor_guard = "false"
+    cfg.trace = "true"
+    cfg.calibration_v2 = "true"
+    ff = FFModel(cfg)
+    out = build_mlp(ff, 32, in_dim=64, hidden=(256, 256), num_classes=10)
+    ff.compile(SGDOptimizer(0.01), "sparse_categorical_crossentropy", [],
+               machine_spec=spec, output_tensor=out)
+    from flexflow_tpu.search.costmodel import OpCostModel
+    from flexflow_tpu.search.mcmc import (StrategySimulator,
+                                          assignment_to_strategy,
+                                          data_parallel_assignment)
+    sim = StrategySimulator(ff.layers, ff.dmesh, OpCostModel(ff.dmesh.spec))
+    dp = assignment_to_strategy(
+        ff.layers, ff.graph_inputs,
+        data_parallel_assignment(ff.layers, ff.dmesh, sim.options),
+        ff.dmesh, sim)
+    ReplanController._install(ff, dp)
+
+    faults.install("degrade_link@2:ici:6.0")
+    rng = np.random.default_rng(0)
+    batch = {"input": rng.normal(size=(32, 64)).astype(np.float32),
+             "label": rng.integers(0, 10, size=(32, 1)).astype(np.int32)}
+
+    def time_steps(n):
+        step = ff.executor.make_train_step()
+        bm = ff._run_train_step(step, batch)
+        _sync_fetch(bm["loss"])  # compile + sync
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            bm = ff._run_train_step(step, batch)
+            _sync_fetch(bm["loss"])
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    degraded_s = time_steps(max(4, steps))
+    assert faults.degraded_links() == {"ici": 6.0}
+
+    table = calibration.CalibrationTable()
+    import jax
+    coll = sorted(k for k in table._load()
+                  if k.startswith(jax.default_backend() + "|coll_"))
+    table.mark_stale(coll)
+
+    ctl = ReplanController(ff, ReplanPolicy(
+        debounce_polls=1, search_budget=max(budget, 1500),
+        measured_guard=False))
+    t0 = time.perf_counter()
+    outcome = ctl.step_once()
+    adapt_s = time.perf_counter() - t0
+    healed_s = time_steps(max(4, steps))
+    faults.clear()
+
+    rec = ctl.history[-1] if ctl.history else {}
+    audit = load_strategy_audit(ff._strategy_audit_path) \
+        .get("replan", {}).get("events", [])
+    audit_rec = audit[-1] if audit else {}
+    measured_ratio = degraded_s / max(healed_s, 1e-12)
+    predicted = float(audit_rec.get("predicted_ratio") or 0.0)
+    measured_win = measured_ratio >= 1.1
+    deferred_win = (audit_rec.get("gate") == "deferred"
+                    and predicted >= 1.1)
+    _emit({"outcome": outcome,
+           "trigger": rec.get("trigger"),
+           "gate": audit_rec.get("gate"),
+           "predicted_ratio": round(predicted, 4),
+           "incumbent_basis": audit_rec.get("incumbent_basis"),
+           "rows_remeasured": len(rec.get("remeasured") or ()),
+           "degraded_step_s": round(degraded_s, 6),
+           "healed_step_s": round(healed_s, 6),
+           "measured_healed_ratio": round(measured_ratio, 4),
+           "time_to_adapt_s": round(adapt_s, 3),
+           "replans": ctl.replans, "rollbacks": ctl.rollbacks,
+           "ok": outcome == "adopted" and ctl.replans == 1
+           and (measured_win or deferred_win)})
+
+
 def stage_zero_memory(steps: int):
     """Per-parameter ZeRO leg (ISSUE 10 acceptance): measured per-device
     optimizer-state bytes under the searched assignment vs replicated —
@@ -2597,6 +2714,33 @@ def main():
         else:
             errors.append(f"recovery: {err}")
 
+    # -- stage 5.46: closed-loop plan adaptation ----------------------
+    # ISSUE 20 acceptance: a degrade_link drill must heal through the
+    # replan controller — adopted swap, healed/degraded >= 1.1x measured
+    # or admitted gate-deferred with predicted ratio >= 1.1x from the
+    # strategy audit record, exactly one adoption (no flapping)
+    if remaining() > 90:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            xf = (xf + " --xla_force_host_platform_device_count=8").strip()
+        penv = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": xf}
+        rp, err = stage(["--stage", "replan", "--steps", "8",
+                         "--budget", "1500"], 240, penv)
+        if rp is not None:
+            out["replan_outcome"] = rp["outcome"]
+            out["replan_predicted_ratio"] = rp["predicted_ratio"]
+            out["replan_measured_ratio"] = rp["measured_healed_ratio"]
+            out["replan_gate"] = rp["gate"]
+            out["time_to_adapt_s"] = rp["time_to_adapt_s"]
+            if not rp["ok"]:
+                errors.append(
+                    f"replan: outcome={rp['outcome']} predicted "
+                    f"{rp['predicted_ratio']}x (gate={rp['gate']}) "
+                    f"measured {rp['measured_healed_ratio']}x — no "
+                    f">=1.1x win on either gate")
+        else:
+            errors.append(f"replan: {err}")
+
     # -- stage 5.5: flash-off point on the recovered platform ---------
     if out.get("reprobe") == "recovered" and remaining() > 420:
         foff, err = stage(bert_args + ["--flash", "false"], 420, env)
@@ -2710,6 +2854,8 @@ if __name__ == "__main__":
         stage_comm_overlap(a.steps)
     elif a.stage == "recovery":
         stage_recovery(a.steps)
+    elif a.stage == "replan":
+        stage_replan(a.budget, a.steps)
     elif a.stage == "serving_overload":
         stage_serving_overload(a.steps)
     elif a.stage == "serving_obs_overhead":

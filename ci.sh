@@ -123,6 +123,15 @@ case "${1:-fast}" in
     # must re-converge at 2 replicas, and the merged multi-endpoint
     # ffstat fleet view must render against the live fleet
     python tools/fleet_smoke.py
+    # closed-loop replan smoke: a degrade_link drill fires mid-training
+    # on the 2-slice virtual mesh, drift-marked calibration rows are
+    # re-measured in place under the active drill, the re-search on the
+    # refreshed tables must produce a candidate the predicted-win gate
+    # admits (>= 1.1x, recorded gate="deferred" — virtual drills slow
+    # the cost model, not real CPU steps), the hot-swap must carry the
+    # training state over bit-exactly, and the armed cooldown must hold
+    # the loop to exactly one adoption (no flapping)
+    python tools/replan_smoke.py
     ;;
   slow)
     python -m pytest tests/ -q -m slow

@@ -113,6 +113,19 @@ def _weight_degree(strategy, lname: str, wname: str,
     return max(1, deg)
 
 
+def _degradation(tiers) -> float:
+    """Max active chaos-drill link slowdown over a sync group's tiers
+    (resilience/faults.py ``degrade_link``): the virtual mesh cannot
+    physically slow the modeled link, so the measured sync wall time is
+    scaled instead — the drift detector then sees exactly what a real
+    degraded fabric would show it."""
+    try:
+        from ..parallel.topology import link_degradation_factor
+        return max([link_degradation_factor(t) for t in tiers] or [1.0])
+    except Exception:  # noqa: BLE001 — no drill machinery = healthy
+        return 1.0
+
+
 def _axes_for_degree(axis_sizes: Dict[str, int], deg: int
                      ) -> Optional[Tuple[str, ...]]:
     """A contiguous mesh-axis run whose sizes multiply to ``deg`` —
@@ -252,7 +265,16 @@ class _SubStepHarness:
         f = jax.jit(shard_map(body, mesh=mesh, in_specs=P(),
                               out_specs=P(all_axes)))
         x = jnp.ones((max(8, n_elems),), jnp.float32)
-        fn = self._sync_fns[key] = (f, x)
+        # the tier names this sync group spans: measured wall times are
+        # scaled by any active degrade_link drill on them at ACCRUAL
+        # time (the drill may fire mid-run, after this fn is built) —
+        # the CPU-sim mesh has no physical link to slow
+        try:
+            tiers = frozenset(dict(self.dmesh.axis_tiers).get(a, "ici")
+                              for a in axes)
+        except Exception:  # noqa: BLE001 — untrier'd mesh
+            tiers = frozenset()
+        fn = self._sync_fns[key] = (f, x, tiers)
         return fn
 
 
@@ -367,7 +389,8 @@ def _measure_spans(ff, steps: int, predicted: List[Dict[str, Any]]
             if fx is not None:
                 t0 = time.perf_counter()
                 _sync(fx[0](fx[1]))
-                acc[layer.name]["sync"] += time.perf_counter() - t0
+                acc[layer.name]["sync"] += \
+                    (time.perf_counter() - t0) * _degradation(fx[2])
         t0 = time.perf_counter()
         p2, o2 = upd(ff.params, g0, ff.opt_state)
         h._jax.block_until_ready(o2)

@@ -34,7 +34,8 @@ import dataclasses
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .topology import Tier, TierGraph, TIER_ORDER, TIER_RANK
+from .topology import (Tier, TierGraph, TIER_ORDER, TIER_RANK,
+                       effective_tier_bandwidth)
 
 __all__ = ["AxisPlacement", "Phase", "TreeChoice",
            "choose_reduction_tree", "tree_algorithms",
@@ -231,7 +232,7 @@ def tree_bandwidth_cost(phases: Sequence[Phase],
         total += (bandwidth_multiplier(p.collective, p.degree)
                   * (p.degree - 1) / p.degree
                   * p.volume_bytes * wire_byte_scale(p.wire)
-                  / tier.bandwidth)
+                  / effective_tier_bandwidth(tier))
     return total
 
 
@@ -253,7 +254,8 @@ def _leg(cost_model, collective: str, degree: int, volume: float,
     frac = (degree - 1) / degree
     mult = bandwidth_multiplier(collective, degree)
     n_lat = rounds if rounds is not None else (degree - 1)
-    return mult * frac * volume / tier.bandwidth + n_lat * tier.latency_s
+    return mult * frac * volume / effective_tier_bandwidth(tier) \
+        + n_lat * tier.latency_s
 
 
 def _ring_tree(collective, volume, path) -> Tuple[float, List[Phase]]:
@@ -267,7 +269,7 @@ def _ring_tree(collective, volume, path) -> Tuple[float, List[Phase]]:
     bottleneck = path[-1][0]
     frac = (total_deg - 1) / total_deg
     mult = bandwidth_multiplier(collective, total_deg)
-    cost = mult * frac * volume / bottleneck.bandwidth \
+    cost = mult * frac * volume / effective_tier_bandwidth(bottleneck) \
         + (total_deg - 1) * bottleneck.latency_s
     return cost, [Phase(collective, bottleneck.name, total_deg, volume)]
 

@@ -163,6 +163,37 @@ class TierGraph:
         return cls(tiers)
 
 
+# ----------------------------------------------------------------------
+# chaos-drill link degradation (resilience/faults.py ``degrade_link``)
+# ----------------------------------------------------------------------
+
+#: memoized accessor into the fault registry — pricing paths call this
+#: 1e4-1e6 times per search, so the import resolves once
+_ld_fn = None
+
+
+def link_degradation_factor(name: str) -> float:
+    """Active chaos-drill slowdown factor of one tier name (1.0 =
+    healthy fabric). Registered by ``degrade_link@N:tier:factor``
+    clauses (resilience/faults.py); every analytic tier-priced leg
+    divides its bandwidth by this so predictions — and therefore the
+    re-plan search — see the degraded link the moment the drill fires."""
+    global _ld_fn
+    if _ld_fn is None:
+        try:
+            from ..resilience.faults import link_degradation
+            _ld_fn = link_degradation
+        except Exception:  # noqa: BLE001 — no drill machinery
+            _ld_fn = lambda t: 1.0  # noqa: E731
+    return _ld_fn(name)
+
+
+def effective_tier_bandwidth(tier: Tier) -> float:
+    """``tier.bandwidth`` after any active chaos-drill degradation."""
+    f = link_degradation_factor(tier.name)
+    return tier.bandwidth / f if f > 1.0 else tier.bandwidth
+
+
 def flat_ring_links(topo, devices: Tuple[int, ...]):
     """Flattened ring-collective routes over ``devices``, cached on the
     topology: ``(offsets, links, factors-or-None)`` where ``links`` is

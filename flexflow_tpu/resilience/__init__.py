@@ -30,23 +30,30 @@ are preemptible by design, so this layer makes failure a normal input:
     shrunken mesh, re-run the strategy search warm from the persistent
     calibration tables, and reshard the restored state onto the new
     strategy via the checkpoint replace path;
+  - :mod:`.replan` — closed-loop plan adaptation: drift-marked
+    calibration rows re-measured in place, a background re-search on
+    the refreshed tables, and verifier-gated hot-swap with bit-exact
+    state carryover, a measured A/B guard, and hysteresis + exponential
+    cooldown so a degraded fleet heals without flapping;
   - :mod:`.status` — always-on restart/fault/checkpoint/world facts,
     merged into both HTTP front-ends' ``/healthz``.
 
 See docs/resilience.md and docs/distributed.md.
 """
-from . import coord, elastic, faults, status
+from . import coord, elastic, faults, replan, status
 from .coord import EXIT_RANK_FAILURE, Coordinator, RankFailure
 from .faults import (DeviceLoss, FaultError, FaultPlan, SimulatedCrash,
                      install as install_fault_plan)
+from .replan import ReplanController, ReplanPolicy
 from .supervisor import (RestartBudgetExceeded, Supervisor, WorldFailure,
                          WorldSupervisor, run_supervised,
                          run_world_member)
 
 __all__ = [
-    "faults", "status", "elastic", "coord",
+    "faults", "status", "elastic", "coord", "replan",
     "FaultPlan", "FaultError", "SimulatedCrash", "DeviceLoss",
     "install_fault_plan",
+    "ReplanController", "ReplanPolicy",
     "Supervisor", "run_supervised", "RestartBudgetExceeded",
     "Coordinator", "RankFailure", "EXIT_RANK_FAILURE",
     "WorldSupervisor", "WorldFailure", "run_world_member",

@@ -9,13 +9,15 @@ tests and CI instead of waiting for a real preemption.
 Fault plan grammar (``FF_FAULT_PLAN`` env var or :func:`install`)::
 
     plan   := clause (';' clause)*          # ',' also accepted
-    clause := kind '@' step [':' arg]
+    clause := kind '@' step (':' arg)*
     kind   := crash | nan | inf | corrupt_ckpt | truncate_ckpt
               | lose_device | infer_fail     # aliases: nan_grad, corrupt,
               | rank_crash | rank_hang       # truncate, lose, infer
               | corrupt_shard | crash_after_stage
               | infer_crash                  # hard replica death on the
                                              # N-th inference call
+              | degrade_link                 # tier bandwidth drill
+              | workload_shift               # live batch-shape drill
 
 Examples::
 
@@ -47,6 +49,22 @@ never consume the clause)::
                                              # its step-2 shard and the
                                              # manifest commit (torn-
                                              # checkpoint drill)
+
+Closed-loop adaptation drills (ISSUE 20) — the chaos inputs the
+``ReplanController`` (resilience/replan.py) heals. ``degrade_link``
+scales a fabric tier's modeled bandwidth mid-run (the CPU-sim timing
+path scales measured collective seconds by the factor, since a virtual
+mesh has no physical link to slow), so prediction-vs-reality drift
+fires deterministically; ``workload_shift`` changes the live global
+batch shape. Both are one-shot and rank-scopable via a trailing rank
+arg::
+
+    FF_FAULT_PLAN="degrade_link@3:dcn:4"     # before step 3 the dcn tier
+                                             # runs 4x slower (factor >= 1)
+    FF_FAULT_PLAN="degrade_link@3:dcn:4:1"   # ...only in rank 1's process
+    FF_FAULT_PLAN="workload_shift@5:16"      # before step 5 the live
+                                             # global batch becomes 16
+    FF_FAULT_PLAN="workload_shift@5:16:0"    # ...only in rank 0's process
 
 Semantics:
 
@@ -91,6 +109,8 @@ _KINDS = {
     "rank_hang": "rank_hang",
     "corrupt_shard": "corrupt_shard",
     "crash_after_stage": "crash_after_stage",
+    "degrade_link": "degrade_link", "degrade": "degrade_link",
+    "workload_shift": "workload_shift", "shift": "workload_shift",
 }
 
 #: exit code of an injected hard rank crash (``rank_crash`` /
@@ -98,7 +118,10 @@ _KINDS = {
 #: of the world it is indistinguishable from a SIGKILL'd process.
 RANK_CRASH_EXIT = 13
 
-_CLAUSE_RE = re.compile(r"^([a-z_]+)@(\d+)(?::([A-Za-z0-9_]+))?$")
+#: multi-arg clauses (``degrade_link@N:tier:factor[:rank]``) extend the
+#: original single-arg grammar; ``.`` is an arg char so float factors
+#: parse. ``Fault.arg`` stays the FIRST arg for back-compat.
+_CLAUSE_RE = re.compile(r"^([a-z_]+)@(\d+)((?::[A-Za-z0-9_.]+)*)$")
 
 
 class FaultError(RuntimeError):
@@ -131,6 +154,15 @@ class Fault:
     step: int
     arg: Optional[str] = None
     fired: bool = False
+    #: full arg tuple of a multi-arg clause; synced with ``arg`` (the
+    #: first element) so hand-built single-arg faults keep working
+    args: tuple = ()
+
+    def __post_init__(self):
+        if not self.args and self.arg is not None:
+            self.args = (self.arg,)
+        elif self.args and self.arg is None:
+            self.arg = self.args[0]
 
 
 class FaultPlan:
@@ -149,14 +181,16 @@ class FaultPlan:
             m = _CLAUSE_RE.match(raw)
             if m is None:
                 raise ValueError(
-                    f"bad fault clause {raw!r} (grammar: kind@step[:arg], "
+                    f"bad fault clause {raw!r} (grammar: "
+                    f"kind@step[:arg]*, "
                     f"kinds: {sorted(set(_KINDS.values()))})")
             kind = _KINDS.get(m.group(1))
             if kind is None:
                 raise ValueError(
                     f"unknown fault kind {m.group(1)!r} in {raw!r} "
                     f"(known: {sorted(_KINDS)})")
-            faults.append(Fault(kind, int(m.group(2)), m.group(3)))
+            args = tuple(m.group(3).split(":")[1:]) if m.group(3) else ()
+            faults.append(Fault(kind, int(m.group(2)), args=args))
         return cls(faults)
 
     @classmethod
@@ -177,22 +211,29 @@ class FaultPlan:
         return sum(1 for f in self.faults if not f.fired)
 
     def fire(self, kind: str, step: int,
-             rank: Optional[int] = None) -> Optional[Fault]:
+             rank: Optional[int] = None,
+             rank_index: int = 0) -> Optional[Fault]:
         """Consume and return the first unfired clause of ``kind`` due
         at ``step``; None otherwise. ``rank`` (rank-scoped kinds: the
-        caller's process index) must match the clause's arg — a clause
-        targeting another rank is left unfired for THAT rank's process
-        to consume."""
+        caller's process index) must match the clause's rank arg — the
+        arg at ``rank_index`` (0 for the classic single-arg kinds; the
+        trailing position for multi-arg kinds like ``degrade_link``) —
+        a clause targeting another rank is left unfired for THAT rank's
+        process to consume."""
         for f in self.faults:
-            if not f.fired and f.kind == kind and f.step == step \
-                    and (rank is None or f.arg is None
-                         or int(f.arg) == rank):
-                f.fired = True
-                status.record_fault(kind, step)
-                obs_events.counter(f"resilience.fault.{kind}")
-                obs_events.instant("resilience.fault_injected",
-                                   kind=kind, step=step, arg=f.arg)
-                return f
+            if f.fired or f.kind != kind or f.step != step:
+                continue
+            if rank is not None:
+                a = f.args[rank_index] \
+                    if len(f.args) > rank_index else None
+                if a is not None and int(a) != rank:
+                    continue
+            f.fired = True
+            status.record_fault(kind, step)
+            obs_events.counter(f"resilience.fault.{kind}")
+            obs_events.instant("resilience.fault_injected",
+                               kind=kind, step=step, arg=f.arg)
+            return f
         return None
 
 
@@ -223,10 +264,13 @@ def install(plan) -> FaultPlan:
 
 def clear() -> None:
     """Drop the installed plan; the env var is re-read on next use.
-    Also restarts the inference-call counter (see :func:`install`)."""
-    global _plan, _infer_calls
+    Also restarts the inference-call counter (see :func:`install`) and
+    heals any registered link degradation / pending workload shift."""
+    global _plan, _infer_calls, _workload_shift
     _plan = None
     _infer_calls = itertools.count()
+    _link_degradation.clear()
+    _workload_shift = None
 
 
 def active() -> bool:
@@ -235,8 +279,81 @@ def active() -> bool:
 
 
 # ---------------------------------------------------------------------------
-# injection sites
+# closed-loop adaptation drills (ISSUE 20): link degradation + workload
+# shift state the replan controller and the CPU-sim timing path consult
 # ---------------------------------------------------------------------------
+
+#: tier name -> slowdown factor (>= 1.0); empty = healthy fabric
+_link_degradation: dict = {}
+
+#: global batch size requested by a fired workload_shift clause, until
+#: a reader consumes it via :func:`pending_workload_shift`
+_workload_shift: Optional[int] = None
+
+
+def set_link_degradation(tier: str, factor: float) -> None:
+    """Register (or heal, with ``factor <= 1``) a modeled bandwidth
+    slowdown for one fabric tier (``"ici"`` / ``"dcn"`` / ``"host"``).
+    Consulted by the analytic cost model's tier pricing and by the
+    calibration microbenches, so predictions AND fresh measurements
+    both see the degraded link."""
+    f = float(factor)
+    if f <= 1.0:
+        _link_degradation.pop(tier, None)
+    else:
+        _link_degradation[tier] = f
+
+
+def link_degradation(tier: str) -> float:
+    """Current slowdown factor of one tier (1.0 = healthy)."""
+    return _link_degradation.get(tier, 1.0)
+
+
+def degraded_links() -> dict:
+    """``{tier: factor}`` of every currently degraded tier."""
+    return dict(_link_degradation)
+
+
+def pending_workload_shift() -> Optional[int]:
+    """The new global batch size requested by a fired
+    ``workload_shift`` clause; consumed (cleared) by the read — the
+    replan controller treats it as a live-shape trigger."""
+    global _workload_shift
+    b, _workload_shift = _workload_shift, None
+    return b
+
+
+def maybe_degrade(step: int) -> Optional[tuple]:
+    """``degrade_link@N:tier:factor[:rank]`` clauses due before ``step``
+    executes: register the tier slowdown and return ``(tier, factor)``
+    (None = no clause due). One-shot like every clause; the degradation
+    itself persists until :func:`clear` or a healing
+    :func:`set_link_degradation` call."""
+    f = get_plan().fire("degrade_link", step, rank=_rank(),
+                        rank_index=2)
+    if f is None:
+        return None
+    tier = (f.args[0] if len(f.args) > 0 else "") or "dcn"
+    factor = float(f.args[1]) if len(f.args) > 1 and f.args[1] else 2.0
+    set_link_degradation(tier, factor)
+    return (tier, factor)
+
+
+def maybe_workload_shift(step: int) -> Optional[int]:
+    """``workload_shift@N[:batch][:rank]`` clauses due before ``step``
+    executes: record the requested global batch size (default: double
+    the unknown current one, encoded as 0 for 'caller decides') and
+    return it (None = no clause due)."""
+    global _workload_shift
+    f = get_plan().fire("workload_shift", step, rank=_rank(),
+                        rank_index=1)
+    if f is None:
+        return None
+    b = int(f.args[0]) if len(f.args) > 0 and f.args[0] else 0
+    _workload_shift = b
+    return b
+
+
 def _rank() -> int:
     """This process's rank; 0 when jax is not importable yet."""
     try:
@@ -248,7 +365,11 @@ def _rank() -> int:
 
 def raise_pending(step: int) -> None:
     """Crash / device-loss / rank-scoped clauses due before ``step``
-    executes."""
+    executes. The non-raising adaptation drills (``degrade_link`` /
+    ``workload_shift``) fire here too — one injection site in the
+    train-step driver covers every step-indexed kind."""
+    maybe_degrade(step)
+    maybe_workload_shift(step)
     plan = get_plan()
     if plan.fire("crash", step) is not None:
         raise SimulatedCrash(step)

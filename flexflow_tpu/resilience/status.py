@@ -40,6 +40,16 @@ def _fresh() -> Dict[str, Any]:
         # crash sites, referenced from /healthz so a probe can point an
         # operator straight at the evidence
         "last_flight_record": None,
+        # closed-loop plan adaptation (resilience/replan.py): the
+        # controller mirrors its state machine here so /healthz answers
+        # "is the fleet healing itself, and did the last swap stick"
+        "replans": 0,                     # adopted plan swaps
+        "replan_rollbacks": 0,            # A/B-guard reverts
+        "replan_last_trigger": None,      # "drift" | "degraded" | ...
+        "replan_last_outcome": None,      # "adopted" | "rolled_back" |
+                                          # "rejected" | "no_win" | ...
+        "replan_candidate": None,         # "idle"|"searching"|"pending"
+        "replan_cooldown_until_unix_s": None,
     }
 
 
@@ -95,4 +105,9 @@ def health_fields() -> Dict[str, Any]:
     age = checkpoint_age_s()
     if age is not None:
         out["checkpoint_age_s"] = round(age, 3)
+    # cooldown as a remaining-seconds age (probes alert on remaining,
+    # not on a unix timestamp), clamped at 0 once it elapsed
+    until = out.pop("replan_cooldown_until_unix_s", None)
+    out["replan_cooldown_remaining_s"] = \
+        0.0 if until is None else round(max(0.0, until - time.time()), 3)
     return out

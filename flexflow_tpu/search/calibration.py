@@ -76,6 +76,21 @@ def shape_class(nbytes: int) -> int:
     return 1 << int(round(math.log2(nbytes)))
 
 
+#: process-wide staleness generation: bumped whenever any table
+#: instance rewrites a stale sidecar (mark_stale / put superseding a
+#: mark). Every ``CalibrationTable`` revalidates its in-memory sidecar
+#: set against this counter (and the sidecar file's mtime, for marks
+#: written by ANOTHER process), and every ``MeshCalibration`` drops its
+#: lookup memos — so an in-process stale mark written by the drift
+#: detector through a fresh table object is a miss IMMEDIATELY, not
+#: after the next process restart.
+_stale_gen = 0
+
+
+def stale_generation() -> int:
+    return _stale_gen
+
+
 class CalibrationTable:
     """Persistent microbenchmark results, one JSON file per cache dir.
 
@@ -89,6 +104,8 @@ class CalibrationTable:
         self._cache_dir = cache_dir or _DEFAULT_DIR
         self._data: Optional[Dict[str, float]] = None
         self._stale: Optional[set] = None
+        self._stale_seen_gen = -1      # _stale_gen at last sidecar read
+        self._stale_mtime = None       # sidecar mtime_ns at last read
         self.measured = 0          # live measurements this process
 
     @property
@@ -118,22 +135,42 @@ class CalibrationTable:
                 self._data = {}
         return self._data
 
+    def _stale_sidecar_mtime(self):
+        try:
+            return os.stat(self.stale_path).st_mtime_ns
+        except OSError:
+            return None
+
     def _load_stale(self) -> set:
-        if self._stale is None:
+        # revalidate against the process-wide staleness generation (a
+        # mark written through ANY table object this process created)
+        # and the sidecar mtime (a mark written by another process) —
+        # a live table must treat fresh stale marks as misses without
+        # waiting for a restart
+        mt = self._stale_mtime
+        if self._stale is not None and self._stale_seen_gen != _stale_gen:
+            mt = self._stale_sidecar_mtime()
+        if self._stale is None or mt != self._stale_mtime:
             try:
                 with open(self.stale_path) as f:
                     self._stale = {str(k) for k in json.load(f)}
             except Exception:  # noqa: BLE001 — no sidecar = none stale
                 self._stale = set()
+            self._stale_mtime = self._stale_sidecar_mtime()
+        self._stale_seen_gen = _stale_gen
         return self._stale
 
     def _write_stale(self) -> None:
+        global _stale_gen
+        _stale_gen += 1
+        self._stale_seen_gen = _stale_gen
         try:
             os.makedirs(self._cache_dir, exist_ok=True)
             tmp = self.stale_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(sorted(self._load_stale()), f)
+                json.dump(sorted(self._stale or set()), f)
             os.replace(tmp, self.stale_path)
+            self._stale_mtime = self._stale_sidecar_mtime()
         except Exception:  # noqa: BLE001 — persistence is best-effort
             pass
 
@@ -217,6 +254,160 @@ class CalibrationTable:
                     and k not in stale:
                 out.append((int(k[len(prefix):-len(suffix)]), v))
         return sorted(out)
+
+    # ------------------------------------------------------------------
+    # targeted in-process re-measurement (the drift detector's heal)
+    # ------------------------------------------------------------------
+    def remeasure_stale(self, dmesh=None, keys=None) -> Dict[str, float]:
+        """Re-measure exactly the stale-marked rows on the live backend,
+        in-process — no table delete, no restart. Each re-measured value
+        is re-filed via :meth:`put` (which clears its stale mark), so
+        attached ``MeshCalibration`` objects answer from the fresh row
+        on their next lookup. Rows this process cannot realize — another
+        backend's keys, collective degrees with no matching mesh-axis
+        prefix, ring rows without a seq axis — are left stale for a
+        process that can. Returns ``{key: seconds}`` for the rows
+        actually re-measured; ``keys`` narrows the work to a subset
+        (default: every stale key)."""
+        import jax
+        backend = jax.default_backend()
+        todo = [str(k) for k in (keys if keys is not None
+                                 else self.stale_keys())]
+        stale = self._load_stale()
+        mesh = dmesh.mesh if dmesh is not None else None
+        axis_names = list(mesh.shape.keys()) if mesh is not None else []
+        try:
+            axis_tiers = dict(dmesh.axis_tiers) \
+                if dmesh is not None else {}
+        except Exception:  # noqa: BLE001 — tiers are best-effort
+            axis_tiers = {}
+        out: Dict[str, float] = {}
+        with obs_events.span("calibration.remeasure_stale",
+                             n_stale=len(todo)):
+            for key in todo:
+                if key not in stale:
+                    continue
+                parts = key.split("|")
+                if len(parts) != 5 or parts[0] != backend:
+                    continue
+                _, kind, dtype, sc_s, ax_s = parts
+                try:
+                    sclass, axis_size = int(sc_s), int(ax_s)
+                except ValueError:
+                    continue
+                try:
+                    with obs_events.span("calibration.measure",
+                                         kind=kind, axis_size=axis_size,
+                                         sclass=sclass):
+                        v = self._remeasure_one(
+                            kind, dtype, sclass, axis_size, dmesh,
+                            mesh, axis_names, axis_tiers)
+                except Exception:  # noqa: BLE001 — best-effort per row
+                    v = None
+                if v is None:
+                    continue
+                self.measured += 1
+                # filed under the PARSED key (not the re-derived shape
+                # class): the stale row itself must be superseded
+                self.put(backend, kind, dtype, sclass, axis_size,
+                         float(v))
+                out[key] = float(v)
+        if out:
+            try:
+                from ..obs.metrics_registry import REGISTRY
+                REGISTRY.counter(
+                    "ff_calibration_rows_remeasured_total",
+                    "Stale calibration rows re-measured in-process by "
+                    "remeasure_stale").inc(len(out))
+            except Exception:  # noqa: BLE001 — metering is best-effort
+                pass
+        return out
+
+    def _remeasure_one(self, kind: str, dtype: str, sclass: int,
+                       axis_size: int, dmesh, mesh, axis_names,
+                       axis_tiers) -> Optional[float]:
+        """One stale row's fresh measurement (seconds / bytes-per-s /
+        efficiency), or None when this process cannot realize it."""
+        if kind == "host_dispatch":
+            return _bench_dispatch()
+        if kind == "host_membw":
+            return _bench_membw()
+        if kind == "parallel_eff":
+            if mesh is None or dmesh.num_devices != axis_size:
+                return None
+            return _bench_parallel_eff(mesh, axis_size)
+        if kind.startswith("op_attention@"):
+            impl = kind.split("@", 1)[1]
+            seq_axis = getattr(dmesh, "seq_axis", None) \
+                if dmesh is not None else None
+            if impl == "ring":
+                if mesh is None or seq_axis is None \
+                        or int(mesh.shape[seq_axis]) != axis_size:
+                    return None
+                s = _attn_seq_len(sclass, axis_size)
+            else:
+                s = _attn_seq_len(sclass)
+            return _bench_attention_impl(impl, s, mesh=mesh,
+                                         seq_axis=seq_axis)
+        if kind.startswith("coll_"):
+            if mesh is None:
+                return None
+            coll, _, tier = kind[len("coll_"):].partition("@")
+            tier = tier or None
+            if coll == "ppermute":
+                # single-axis ring: the dedicated seq axis when its
+                # size matches, else the innermost axis of that size
+                ring_ax = getattr(dmesh, "seq_axis", None)
+                if ring_ax is None \
+                        or int(mesh.shape[ring_ax]) != axis_size:
+                    ring_ax = next(
+                        (a for a in reversed(axis_names)
+                         if int(mesh.shape[a]) == axis_size), None)
+                if ring_ax is None:
+                    return None
+                tiers = {axis_tiers.get(ring_ax, "ici")}
+                if tier is not None and tiers != {tier}:
+                    return None
+                v = _bench_collective(mesh, "ppermute", sclass,
+                                      axes=(ring_ax,), dtype=dtype)
+            else:
+                if coll not in COLLECTIVES:
+                    return None
+                # realize the degree as a mesh-axis prefix product —
+                # the same grid _calibrate_mesh measured
+                p, n_axes = 1, None
+                for k, a in enumerate(axis_names, start=1):
+                    p *= int(mesh.shape[a])
+                    if p == axis_size:
+                        n_axes = k
+                        break
+                    if p > axis_size:
+                        break
+                if n_axes is None:
+                    return None
+                tiers = {axis_tiers.get(a, "ici")
+                         for a in axis_names[:n_axes]}
+                if tier is not None and tiers != {tier}:
+                    return None
+                v = _bench_collective(mesh, coll, sclass,
+                                      n_axes=n_axes, dtype=dtype)
+            return v * _link_degradation_factor(tiers)
+        return None
+
+
+def _link_degradation_factor(tiers) -> float:
+    """Max registered chaos-drill bandwidth degradation across
+    ``tiers`` (resilience/faults.py ``degrade_link@N:tier:factor``).
+    The CPU-sim substrate cannot physically slow a modeled link, so the
+    timing path scales measured collective seconds by this factor
+    instead — a measurement taken while a drill is active reflects the
+    degraded fabric exactly as a real slow link would."""
+    try:
+        from ..resilience.faults import link_degradation
+        return max([float(link_degradation(t)) for t in tiers]
+                   or [1.0])
+    except Exception:  # noqa: BLE001 — no drill machinery = healthy
+        return 1.0
 
 
 # ----------------------------------------------------------------------
@@ -518,10 +709,18 @@ class MeshCalibration:
     dtype: str = "float32"
     # lookup memos — collective_time sits inside xfer_cost, the
     # search's hottest evaluator loop (1e4-1e6 calls per search), and
-    # the table is immutable once calibrate_mesh returns, so the
-    # full-table key scans are done once per (coll, degree)
+    # the table only changes when a drift verdict lands, so the
+    # full-table key scans are done once per (coll, degree) per
+    # staleness generation (stale marks / re-measurements drop them)
     _pts: Dict = dataclasses.field(default_factory=dict, repr=False)
     _degs: Dict = dataclasses.field(default_factory=dict, repr=False)
+    _seen_gen: int = dataclasses.field(default=-1, repr=False)
+
+    def _sync_gen(self) -> None:
+        if self._seen_gen != _stale_gen:
+            self._pts.clear()
+            self._degs.clear()
+            self._seen_gen = _stale_gen
 
     def _points(self, coll: str, degree: int,
                 tier: Optional[str] = None,
@@ -534,6 +733,7 @@ class MeshCalibration:
         ``dtype`` selects wire-dtype rows (``int8``/``float8_*``,
         measured by :func:`calibrate_mesh` when quantized collectives
         are enabled) instead of the default element dtype."""
+        self._sync_gen()
         kind = f"{coll}@{tier}" if tier else coll
         dt = dtype or self.dtype
         key = (kind, degree, dt)
@@ -566,6 +766,7 @@ class MeshCalibration:
     def _degrees_measured(self, coll: str) -> List[int]:
         if self.table is None:
             return []
+        self._sync_gen()
         hit = self._degs.get(coll)
         if hit is None:
             prefix = f"{self.backend}|coll_{coll}|{self.dtype}|"
@@ -630,6 +831,7 @@ class MeshCalibration:
         curve for that impl."""
         if self.table is None or nbytes <= 0:
             return None
+        self._sync_gen()
         key = (f"op:{kind}", degree, self.dtype)
         pts = self._pts.get(key)
         if pts is None:
@@ -810,8 +1012,10 @@ def _calibrate_mesh(backend, dmesh, cache_dir, collectives, sizes,
                     v = tab.get_or_measure(
                         backend, f"coll_{coll}", "float32",
                         shape_class(nbytes), deg,
-                        lambda c=coll, s=nbytes, k=n_axes:
-                            _bench_collective(mesh, c, s, n_axes=k))
+                        lambda c=coll, s=nbytes, k=n_axes,
+                        pt=frozenset(prefix_tiers):
+                            _bench_collective(mesh, c, s, n_axes=k)
+                            * _link_degradation_factor(pt))
                     # mirror the measurement under the tier key (no
                     # re-measurement): tier-aware lookups answer from
                     # coll_<kind>@<tier> first, flat stays the fallback
@@ -829,9 +1033,11 @@ def _calibrate_mesh(backend, dmesh, cache_dir, collectives, sizes,
                         vw = tab.get_or_measure(
                             backend, f"coll_{coll}", wdt,
                             shape_class(nbytes), deg,
-                            lambda c=coll, s=nbytes, k=n_axes, w=wdt:
+                            lambda c=coll, s=nbytes, k=n_axes, w=wdt,
+                            pt=frozenset(prefix_tiers):
                                 _bench_collective(mesh, c, s, n_axes=k,
-                                                  dtype=w))
+                                                  dtype=w)
+                                * _link_degradation_factor(pt))
                         if vw is not None and tier is not None \
                                 and tab.get(backend,
                                             f"coll_{coll}@{tier}", wdt,
@@ -856,7 +1062,9 @@ def _calibrate_mesh(backend, dmesh, cache_dir, collectives, sizes,
                     shape_class(nbytes), ring_deg,
                     lambda s=nbytes, a=ring_ax:
                         _bench_collective(mesh, "ppermute", s,
-                                          axes=(a,)))
+                                          axes=(a,))
+                        * _link_degradation_factor(
+                            {axis_tiers.get(a, "ici")}))
                 if v is not None and ring_tier is not None and tab.get(
                         backend, f"coll_ppermute@{ring_tier}",
                         "float32", shape_class(nbytes),

@@ -32,6 +32,7 @@ from ..ffconst import OperatorType, PARALLEL_OPS
 from ..obs import events as obs_events
 from ..ops import get_op_def
 from ..parallel.machine import DeviceMesh, MachineSpec
+from ..parallel.topology import link_degradation_factor
 
 
 @dataclasses.dataclass
@@ -242,7 +243,8 @@ class OpCostModel:
                 t = self.calib.collective_marginal("all_reduce", degree,
                                                    wb)
         if t is None:
-            ici_bw = self.coll_bw or self.spec.ici_bandwidth
+            ici_bw = (self.coll_bw or self.spec.ici_bandwidth) \
+                / link_degradation_factor("ici")
             ici_lat = self.coll_lat if self.coll_lat is not None \
                 else self.spec.ici_latency_us * 1e-6
             # two wire collectives (reduce leg + gather leg) pay twice
@@ -304,6 +306,7 @@ class OpCostModel:
             if bw is None:
                 bw = self.spec.dcn_bandwidth if tier == "dcn" \
                     else (self.coll_bw or self.spec.ici_bandwidth)
+            bw /= link_degradation_factor(tier)
             return 2.0 * (d - 1) / d * volume * wire_byte_scale(w) / bw
 
         def total_cost(phase_wires) -> float:
@@ -1069,7 +1072,8 @@ class OpCostModel:
                     hop_t = self.calib.collective_time(
                         "ppermute", deg, hop_bytes)
             if hop_t is None:
-                ici_bw = self.coll_bw or self.spec.ici_bandwidth
+                ici_bw = (self.coll_bw or self.spec.ici_bandwidth) \
+                    / link_degradation_factor(tier or "ici")
                 ici_lat = self.coll_lat if self.coll_lat is not None \
                     else self.spec.ici_latency_us * 1e-6
                 hop_t = hop_bytes / max(ici_bw, 1.0) + ici_lat
@@ -1170,7 +1174,8 @@ class OpCostModel:
         if self.provenance is not None and degree > 1 \
                 and volume_bytes > 0:
             self._prov("xfer", None)     # analytic ring model
-        ici_bw = self.coll_bw or self.spec.ici_bandwidth
+        ici_bw = (self.coll_bw or self.spec.ici_bandwidth) \
+            / link_degradation_factor("ici")
         ici_lat = self.coll_lat if self.coll_lat is not None \
             else self.spec.ici_latency_us * 1e-6
         per_slice = self.spec.devices_per_slice
@@ -1181,7 +1186,8 @@ class OpCostModel:
                                  ici_bw, ici_lat)
                  + self._ring_cost(volume_bytes / max(d_in, 1),
                                    collective, d_out,
-                                   self.spec.dcn_bandwidth,
+                                   self.spec.dcn_bandwidth
+                                   / link_degradation_factor("dcn"),
                                    self.spec.dcn_latency_us * 1e-6))
         else:
             t = self._ring_cost(volume_bytes, collective, degree,

@@ -164,6 +164,17 @@ def _scripted(default_probe_results):
                     "loss_gap": 2e-05, "bitexact_off": True,
                     "n_quantized": 6, "runtime_on": True,
                     "ok": True}, None
+        if stage == "replan":
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "xla_force_host_platform_device_count" \
+                in env.get("XLA_FLAGS", "")
+            return {"outcome": "adopted", "trigger": "drift",
+                    "gate": "deferred", "predicted_ratio": 3.36,
+                    "incumbent_basis": "specs", "rows_remeasured": 54,
+                    "degraded_step_s": 0.003, "healed_step_s": 0.0022,
+                    "measured_healed_ratio": 1.36,
+                    "time_to_adapt_s": 9.1,
+                    "replans": 1, "rollbacks": 0, "ok": True}, None
         raise AssertionError(f"unexpected stage {args}")
 
     return fake_run_stage, calls

@@ -16,6 +16,10 @@ they share the routes) and renders one line per model:
 
 A second block lists per-bucket p99s for any model whose sketch has
 per-bucket traffic, so a single hot bucket is visible without Grafana.
+Once the closed-loop replan controller (``resilience/replan.py``) has
+decided anything, a ``replan:`` status line shows its candidate state,
+adoption/rollback counts, newest outcome and remaining cooldown; the
+fleet view adds a per-replica ``REPLAN`` column (``adoptions/last``).
 
 Everything comes from two GETs per frame (``/healthz`` +
 ``/v2/metrics``), both cheap by contract — safe to leave running
@@ -85,6 +89,20 @@ def render_frame(health: Dict[str, Any], metrics: Dict[str, Any],
             f"{' · DRAINING' if draining else ''}"
             f" · trace={'on' if trace.get('enabled') else 'off'}")
     lines.append(head)
+    # closed-loop plan adaptation (resilience/replan.py): shown once the
+    # controller has ever decided anything, so a healing — or flapping —
+    # fleet is visible in the same screen as the symptom it heals
+    res = health.get("resilience") or {}
+    if res.get("replans") or res.get("replan_rollbacks") \
+            or res.get("replan_last_outcome"):
+        cool = res.get("replan_cooldown_remaining_s") or 0.0
+        lines.append(
+            f"replan: {res.get('replan_candidate') or 'idle'}"
+            f" · adoptions={res.get('replans', 0)}"
+            f" rollbacks={res.get('replan_rollbacks', 0)}"
+            f" last={res.get('replan_last_outcome')}"
+            f"({res.get('replan_last_trigger')})"
+            f" cooldown={cool:.0f}s")
     lines.append(f"{'MODEL':<14}{'CIRC':<10}{'Q':>4}{'INST':>5}"
                  f"{'REQ/S':>8}{'P50MS':>8}{'P99MS':>8}{'P99.9':>8}"
                  f"{'SLO':>6}{'EXP':>6}")
@@ -193,17 +211,27 @@ def render_fleet_frame(per_endpoint: Dict[str, Optional[Tuple]],
             f"{m.get('expired', 0):>6}")
     lines.append("per-replica:")
     lines.append(f"  {'ENDPOINT':<26}{'MODEL':<14}{'CIRC':<10}"
-                 f"{'Q':>4}{'INST':>5}{'WAIT_S':>8}")
+                 f"{'Q':>4}{'INST':>5}{'WAIT_S':>8}{'REPLAN':>12}")
     for ep in sorted(per_endpoint):
         hm = per_endpoint[ep]
         short = ep.replace("http://", "")[:25]
         if hm is None:
             lines.append(f"  {short:<26}{'-':<14}{'DOWN':<10}"
-                         f"{'-':>4}{'-':>5}{'-':>8}")
+                         f"{'-':>4}{'-':>5}{'-':>8}{'-':>12}")
             continue
         health, metrics = hm
         serving = (health.get("serving")
                    or {}) if isinstance(health, dict) else {}
+        res = (health.get("resilience")
+               or {}) if isinstance(health, dict) else {}
+        # per-process adaptation state (resilience/replan.py): adopted
+        # swap count plus the newest outcome, so a replica that healed
+        # itself — or keeps rolling back — stands out in the fleet view
+        replan = "-"
+        if res.get("replans") or res.get("replan_rollbacks") \
+                or res.get("replan_last_outcome"):
+            replan = (f"{res.get('replans', 0)}/"
+                      f"{res.get('replan_last_outcome') or '-'}")
         for name in sorted(metrics):
             m = metrics[name]
             wait = (serving.get(name) or {}).get(
@@ -213,7 +241,8 @@ def render_fleet_frame(per_endpoint: Dict[str, Optional[Tuple]],
                 f"{str(m.get('circuit', '?'))[:9]:<10}"
                 f"{m.get('queue_depth', 0):>4}"
                 f"{m.get('instances', 0):>5}"
-                f"{wait:>8.3f}")
+                f"{wait:>8.3f}"
+                f"{replan[:11]:>12}")
     return "\n".join(lines)
 
 
